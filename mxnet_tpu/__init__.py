@@ -1,0 +1,68 @@
+"""mxnet_tpu — a TPU-native deep learning framework with the capabilities of
+Apache MXNet (the reference at /root/reference), re-designed for the
+JAX/XLA/Pallas era.
+
+Architecture (SURVEY.md §7): a Python-first API whose eager path dispatches
+op-by-op through XLA, whose symbolic/hybridized paths trace whole graphs into
+single XLA HloModules, and whose distribution story is jax.sharding Meshes
+with ICI collectives instead of parameter servers.
+
+Import as::
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+"""
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import base
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+
+from .ndarray import NDArray
+
+# Subsystems below land in build order (SURVEY.md §7.2); each import is
+# guarded so the core stays usable while the surface grows.
+import importlib as _importlib
+
+for _m in (
+    "engine",
+    "initializer",
+    "optimizer",
+    "lr_scheduler",
+    "metric",
+    "symbol",
+    "executor",
+    "io",
+    "recordio",
+    "kvstore",
+    "gluon",
+    "module",
+    "model",
+    "callback",
+    "monitor",
+    "profiler",
+    "visualization",
+    "image",
+    "parallel",
+    "test_utils",
+    "util",
+):
+    try:
+        globals()[_m] = _importlib.import_module("." + _m, __name__)
+    except ImportError:
+        pass
+
+if hasattr(globals().get("symbol"), "Symbol"):
+    sym = globals()["symbol"]
+    Symbol = sym.Symbol
+    var = sym.var
+if "module" in globals():
+    mod = globals()["module"]
+if hasattr(globals().get("model"), "save_checkpoint"):
+    save_checkpoint = globals()["model"].save_checkpoint
+    load_checkpoint = globals()["model"].load_checkpoint

@@ -1,0 +1,111 @@
+"""Process-global trace-time state: train/predict mode and the RNG stream.
+
+The reference keeps train-mode on the autograd tape (`Imperative::is_training`,
+reference `include/mxnet/imperative.h`) and RNG state in per-context Resource
+pools (`src/resource.cc`, `src/common/random_generator.h`). On the XLA stack,
+ops are pure functions, so:
+
+* train-mode is a Python-level flag read at *trace* time (each executor /
+  CachedOp traces separately for train and predict, mirroring the reference's
+  `is_train` executor flag);
+* randomness flows through an explicit jax PRNG key. Eagerly the key lives
+  here and is split per call. Inside a jit trace, the executor pushes a
+  *traced* key so compiled graphs receive fresh randomness as an argument on
+  every execution instead of baking one sample into the HloModule.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+__all__ = [
+    "is_train",
+    "set_train",
+    "train_mode_scope",
+    "seed",
+    "next_key",
+    "push_rng_key",
+    "pop_rng_key",
+    "current_rng_key",
+]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.train_mode = False
+        self.recording = False
+        self.key_stack = []  # innermost last; each entry is a jax PRNG key
+        self.base_key = None
+
+
+_STATE = _State()
+
+
+def _state() -> _State:
+    return _STATE
+
+
+def is_train() -> bool:
+    return _STATE.train_mode
+
+
+def set_train(mode: bool) -> bool:
+    prev = _STATE.train_mode
+    _STATE.train_mode = bool(mode)
+    return prev
+
+
+class train_mode_scope:
+    def __init__(self, mode: bool):
+        self.mode = mode
+        self.prev = None
+
+    def __enter__(self):
+        self.prev = set_train(self.mode)
+        return self
+
+    def __exit__(self, *a):
+        set_train(self.prev)
+
+
+def seed(seed_val: int):
+    """Global seed (reference `mx.random.seed`)."""
+    _STATE.base_key = jax.random.PRNGKey(int(seed_val))
+    _STATE.key_stack = []
+
+
+def _base_key():
+    if _STATE.base_key is None:
+        _STATE.base_key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+    return _STATE.base_key
+
+
+def next_key():
+    """Split a fresh subkey from the innermost RNG stream.
+
+    Eager: advances the global key. Under an executor trace (push_rng_key):
+    advances the traced key so each compiled run draws new randomness.
+    """
+    if _STATE.key_stack:
+        k = _STATE.key_stack[-1]
+        k, sub = jax.random.split(k)
+        _STATE.key_stack[-1] = k
+        return sub
+    k = _base_key()
+    k, sub = jax.random.split(k)
+    _STATE.base_key = k
+    return sub
+
+
+def push_rng_key(key):
+    _STATE.key_stack.append(key)
+
+
+def pop_rng_key():
+    return _STATE.key_stack.pop()
+
+
+def current_rng_key():
+    return _STATE.key_stack[-1] if _STATE.key_stack else _base_key()

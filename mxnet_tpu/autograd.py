@@ -1,0 +1,318 @@
+"""Autograd: define-by-run tape over eager NDArray ops.
+
+Re-design of reference `src/imperative/imperative.cc` (RecordOp/Backward) and
+`python/mxnet/autograd.py`. Each recorded op stores a jax.vjp closure — i.e.
+the transposed XLA computation — instead of a symbolic gradient graph; the
+backward pass walks the tape in reverse topological order and accumulates
+into leaf `.grad` buffers, honoring per-leaf grad_req write/add/null
+(reference `AGInfo` + `Imperative::Backward`, imperative.cc:183,270).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from . import _global
+from .base import MXNetError
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "get_symbol",
+    "Function",
+]
+
+
+class _TapeNode:
+    """One recorded op: input snapshots, vjp closure, output metadata.
+
+    Inputs are stored as (ndarray, entry-at-record-time) pairs: an in-place
+    rebind of the array after recording (e.g. ``x += 1`` inside record())
+    must not retroactively change this node's producers, otherwise the node
+    becomes its own ancestor and gradients are silently dropped."""
+
+    __slots__ = ("vjp_fn", "inputs", "out_shapes", "single", "op_name")
+
+    def __init__(self, vjp_fn, inputs, out_shapes, single, op_name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = [(nd, nd._entry) for nd in inputs]
+        self.out_shapes = out_shapes  # [(shape, dtype), ...]
+        self.single = single
+        self.op_name = op_name
+
+
+# ---------------------------------------------------------------------------
+# recording / train-mode scopes (reference python/mxnet/autograd.py:92-195)
+# ---------------------------------------------------------------------------
+
+
+def is_recording() -> bool:
+    return _global._state().recording
+
+
+def is_training() -> bool:
+    return _global.is_train()
+
+
+def set_recording(flag: bool) -> bool:
+    st = _global._state()
+    prev = st.recording
+    st.recording = bool(flag)
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    return _global.set_train(flag)
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record: Optional[bool], train_mode_flag: Optional[bool]):
+        self._enter_record = is_record
+        self._enter_train = train_mode_flag
+        self._prev_record = None
+        self._prev_train = None
+
+    def __enter__(self):
+        if self._enter_record is not None:
+            self._prev_record = set_recording(self._enter_record)
+        if self._enter_train is not None:
+            self._prev_train = set_training(self._enter_train)
+        return self
+
+    def __exit__(self, *a):
+        if self._prev_record is not None:
+            set_recording(self._prev_record)
+        if self._prev_train is not None:
+            set_training(self._prev_train)
+
+
+def record(train_mode: bool = True):
+    """``with autograd.record():`` — turn on recording (+train mode)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Reference autograd.py:197 — associate grads with existing arrays."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._marked = True
+        v._grad_req = req
+        v._grad = g
+        v._entry = None
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _topo_nodes(heads) -> List[_TapeNode]:
+    """Reverse-topological order of tape nodes reachable from head arrays."""
+    visited = set()
+    order: List[_TapeNode] = []
+
+    stack = []
+    for h in heads:
+        if h._entry is not None and id(h._entry[0]) not in visited:
+            stack.append((h._entry[0], False))
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for _inp, entry in node.inputs:
+            if entry is not None and id(entry[0]) not in visited:
+                stack.append((entry[0], False))
+    return list(reversed(order))
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run backward from `heads`, accumulating into leaf .grad buffers."""
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+
+    node_grads = {}  # id(node) -> list of output grads (jnp arrays or None)
+    leaf_grads = {}  # id(leaf) -> (leaf, summed grad) — summed within this pass
+
+    def _add_out_grad(node, idx, g):
+        lst = node_grads.setdefault(id(node), [None] * len(node.out_shapes))
+        lst[idx] = g if lst[idx] is None else lst[idx] + g
+
+    def _add_leaf_grad(leaf, g):
+        prev = leaf_grads.get(id(leaf))
+        leaf_grads[id(leaf)] = (leaf, g if prev is None else prev[1] + g)
+
+    any_head = False
+    for h, hg in zip(heads, head_grads):
+        if h._entry is None:
+            # head is itself a leaf: gradient is just the head grad
+            if h._marked and h._grad_req != "null":
+                g = jnp.ones_like(h._data) if hg is None else hg._data
+                _add_leaf_grad(h, g)
+            continue
+        any_head = True
+        node, idx = h._entry
+        g = jnp.ones_like(h._data) if hg is None else hg._data
+        _add_out_grad(node, idx, g)
+    if not any_head and not any(h._marked for h in heads):
+        raise MXNetError("cannot differentiate: no recorded graph reaches the heads "
+                         "(did you call attach_grad() and compute inside autograd.record()?)")
+
+    for node in _topo_nodes(heads):
+        grads_out = node_grads.pop(id(node), None)
+        if grads_out is None:
+            continue
+        filled = tuple(
+            g if g is not None else jnp.zeros(shape, dtype)
+            for g, (shape, dtype) in zip(grads_out, node.out_shapes)
+        )
+        in_grads = node.vjp_fn(filled[0] if node.single else filled)
+        for (inp, entry), ig in zip(node.inputs, in_grads):
+            if ig is None:
+                continue
+            if entry is not None:
+                n2, i2 = entry
+                _add_out_grad(n2, i2, ig)
+            elif inp._marked and inp._grad_req != "null":
+                _add_leaf_grad(inp, ig)
+
+    # apply summed grads to leaves: grad_req governs accumulation ACROSS
+    # backward calls (reference kWriteTo / kAddTo semantics)
+    from .ndarray.ndarray import NDArray
+
+    for leaf, g in leaf_grads.values():
+        g = g.astype(leaf._data.dtype)
+        if leaf._grad is None:
+            leaf._grad = NDArray(jnp.zeros_like(leaf._data), leaf._ctx)
+        if leaf._grad_req == "add":
+            leaf._grad._data = leaf._grad._data + g
+        else:
+            leaf._grad._data = g
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Functional gradient API (reference autograd.py:270). Returns grads of
+    `heads` w.r.t. `variables` without touching .grad buffers.
+
+    create_graph (higher-order) is supported by replaying vjp closures, which
+    are themselves differentiable jax functions — not yet wired; round 2.
+    """
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    if create_graph:
+        raise MXNetError("create_graph=True not supported yet")
+
+    # temporarily swap out grad buffers, run backward in 'add' mode
+    saved = [(v._grad, v._grad_req, v._marked) for v in variables]
+    for v in variables:
+        v._marked = True
+        v._grad_req = "add"
+        v._grad = NDArray(jnp.zeros_like(v._data), v._ctx)
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph), train_mode=train_mode)
+        return [v._grad for v in variables]
+    finally:
+        for v, (g, req, marked) in zip(variables, saved):
+            v._grad, v._grad_req, v._marked = g if g is not None else v._grad, req, marked
+
+
+def get_symbol(x):
+    raise MXNetError("autograd.get_symbol is not supported on the TPU stack; "
+                     "use gluon HybridBlock tracing instead")
+
+
+class Function:
+    """Custom differentiable function (reference autograd.py:363).
+
+    Subclass and implement forward(self, *inputs) and backward(self,
+    *output_grads); both operate on NDArrays with autograd paused.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = isinstance(outputs, NDArray)
+        outs_t = (outputs,) if single else tuple(outputs)
+
+        if is_recording() and any(isinstance(i, NDArray) and i._in_graph for i in inputs):
+            nd_inputs = [i for i in inputs if isinstance(i, NDArray)]
+            fn_self = self
+
+            def vjp_fn(gs):
+                g_nd = [NDArray(g, nd_inputs[0]._ctx) for g in (gs if isinstance(gs, tuple) else (gs,))]
+                with pause():
+                    igs = fn_self.backward(*g_nd)
+                if isinstance(igs, NDArray):
+                    igs = (igs,)
+                return tuple(ig._data if ig is not None else None for ig in igs)
+
+            node = _TapeNode(
+                vjp_fn=vjp_fn,
+                inputs=nd_inputs,
+                out_shapes=[(o.shape, o._data.dtype) for o in outs_t],
+                single=single,
+                op_name="_CustomFunction",
+            )
+            new_outs = []
+            for idx, o in enumerate(outs_t):
+                no = NDArray(o._data, o._ctx)
+                no._entry = (node, idx)
+                new_outs.append(no)
+            return new_outs[0] if single else tuple(new_outs)
+        return outputs

@@ -1,0 +1,168 @@
+"""Base types, errors, env config and dtype plumbing for mxnet_tpu.
+
+TPU-native re-design of the roles played in the reference by
+`include/mxnet/base.h`, dmlc-core's logging/`GetEnv`/`Parameter`
+(see reference `src/operator/control_flow.cc:35-61` for the Parameter idiom)
+and `python/mxnet/base.py` (MXNetError plumbing). No code is shared with the
+reference; the C ABI/ctypes layer is replaced by direct Python-on-JAX.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "MXNetError",
+    "get_env",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "DTYPE_NP",
+    "DTYPE_NAMES",
+    "np_dtype",
+    "dtype_name",
+]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (name kept for API parity with the
+    reference's ``python/mxnet/base.py:MXNetError``)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+
+_ENV_CACHE: Dict[str, Any] = {}
+
+
+def get_env(name: str, default: Any = None, typ: Callable = str) -> Any:
+    """Read an ``MXNET_*`` environment knob (reference: dmlc::GetEnv usage,
+    documented in ``docs/faq/env_var.md``)."""
+    if name in _ENV_CACHE:
+        return _ENV_CACHE[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        val = default
+    else:
+        try:
+            val = typ(raw)
+        except (TypeError, ValueError):
+            val = default
+    _ENV_CACHE[name] = val
+    return val
+
+
+# ---------------------------------------------------------------------------
+# dtype plumbing. The reference maps int codes <-> numpy dtypes in
+# python/mxnet/base.py / mshadow; we keep the same user-visible names.
+# ---------------------------------------------------------------------------
+import jax.numpy as jnp  # noqa: E402
+
+DTYPE_NP = {
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "bool": jnp.bool_,
+}
+DTYPE_NAMES = {np.dtype(v).name if n != "bfloat16" else "bfloat16": n for n, v in DTYPE_NP.items()}
+
+
+def np_dtype(dtype) -> Any:
+    """Normalize a user dtype spec (string / numpy dtype / jnp dtype) to a jnp dtype."""
+    if dtype is None:
+        return jnp.float32
+    if isinstance(dtype, str):
+        if dtype not in DTYPE_NP:
+            raise MXNetError("unknown dtype %r" % (dtype,))
+        return DTYPE_NP[dtype]
+    return dtype
+
+
+def dtype_name(dtype) -> str:
+    """Canonical string name for a dtype."""
+    try:
+        return "bfloat16" if dtype == jnp.bfloat16 else np.dtype(dtype).name
+    except TypeError:
+        return str(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Attribute (string) parsing — the counterpart of dmlc::Parameter's typed
+# fields. Symbol JSON stores every op attribute as a string; these parsers
+# recover typed values.
+# ---------------------------------------------------------------------------
+
+def parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, np.integer)):
+        return bool(v)
+    s = str(v).strip().lower()
+    if s in ("true", "1"):
+        return True
+    if s in ("false", "0", "none"):
+        return False
+    raise MXNetError("cannot parse bool from %r" % (v,))
+
+
+def parse_int(v) -> int:
+    if isinstance(v, (int, np.integer)) and not isinstance(v, bool):
+        return int(v)
+    return int(float(str(v)))
+
+
+def parse_float(v) -> float:
+    return float(v) if not isinstance(v, str) else float(str(v))
+
+
+def parse_shape(v) -> tuple:
+    """Parse a shape/tuple attr: accepts (2,2), [2,2], "(2, 2)", "2", 2."""
+    if v is None:
+        return None
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    if isinstance(v, (int, np.integer)):
+        return (int(v),)
+    s = str(v).strip()
+    if s in ("None", ""):
+        return None
+    val = ast.literal_eval(s)
+    if isinstance(val, (int, float)):
+        return (int(val),)
+    return tuple(int(x) for x in val)
+
+
+def parse_str(v) -> str:
+    return str(v)
+
+
+def parse_dtype(v):
+    if v is None:
+        return None
+    if isinstance(v, str) and v in ("None", ""):
+        return None
+    return np_dtype(v if isinstance(v, str) else dtype_name(v))
+
+
+_PARSERS = {
+    bool: parse_bool,
+    int: parse_int,
+    float: parse_float,
+    tuple: parse_shape,
+    str: parse_str,
+    "dtype": parse_dtype,
+    "shape_or_none": parse_shape,
+}
+
+
+def parser_for(typ) -> Callable:
+    return _PARSERS.get(typ, typ if callable(typ) else parse_str)

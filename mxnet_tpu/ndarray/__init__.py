@@ -1,0 +1,75 @@
+"""`mx.nd` namespace: NDArray plus one generated function per registered op —
+the counterpart of the reference's import-time codegen from the C op registry
+(`python/mxnet/ndarray/register.py`)."""
+import sys as _sys
+
+from ..ops.registry import OP_REGISTRY as _REG
+from .ndarray import (
+    NDArray,
+    invoke,
+    array,
+    zeros,
+    ones,
+    full,
+    empty,
+    arange,
+    eye,
+    concat,
+    stack,
+    waitall,
+    onehot_encode,
+)
+from . import random  # noqa: F401
+from . import sparse  # noqa: F401
+from .sparse import RowSparseNDArray, CSRNDArray
+
+
+def _make_op_func(_name):
+    _param_names = list(_REG[_name].params.keys())
+
+    def _fn(*args, out=None, **kwargs):
+        # MXNet generated-wrapper convention: leading positional args that are
+        # arrays are op inputs; trailing positional scalars map onto the op's
+        # parameters in declaration order (e.g. nd.clip(x, 0, 1)).
+        arrays = []
+        scalars = []
+        for a in args:
+            if isinstance(a, NDArray) or a is None or (
+                not isinstance(a, (int, float, str, tuple, list, bool)) and hasattr(a, "shape")
+            ):
+                arrays.append(a)
+            else:
+                scalars.append(a)
+        if scalars:
+            free = [p for p in _param_names if p not in kwargs]
+            for p, v in zip(free, scalars):
+                kwargs[p] = v
+        return invoke(_name, *arrays, out=out, **kwargs)
+
+    _fn.__name__ = _name
+    _fn.__qualname__ = _name
+    _fn.__doc__ = _REG[_name].doc
+    return _fn
+
+
+_mod = _sys.modules[__name__]
+for _opname in list(_REG):
+    if not hasattr(_mod, _opname):
+        setattr(_mod, _opname, _make_op_func(_opname))
+
+# common aliases kept by the reference nd namespace
+add = getattr(_mod, "broadcast_add")
+subtract = getattr(_mod, "broadcast_sub")
+multiply = getattr(_mod, "broadcast_mul")
+divide = getattr(_mod, "broadcast_div")
+power = getattr(_mod, "broadcast_power")
+maximum = getattr(_mod, "broadcast_maximum")
+minimum = getattr(_mod, "broadcast_minimum")
+equal = getattr(_mod, "broadcast_equal")
+not_equal = getattr(_mod, "broadcast_not_equal")
+greater = getattr(_mod, "broadcast_greater")
+greater_equal = getattr(_mod, "broadcast_greater_equal")
+lesser = getattr(_mod, "broadcast_lesser")
+lesser_equal = getattr(_mod, "broadcast_lesser_equal")
+negative = getattr(_mod, "negative")
+split = getattr(_mod, "SliceChannel")

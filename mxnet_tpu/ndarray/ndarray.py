@@ -1,0 +1,648 @@
+"""NDArray: the imperative value type, backed by a jax.Array on CPU or TPU.
+
+Re-design of reference `include/mxnet/ndarray.h` + `python/mxnet/ndarray/
+ndarray.py`. The reference NDArray is a ref-counted chunk plus an engine
+variable for async RW-dependency scheduling; on this stack the XLA/PJRT
+runtime already executes asynchronously and tracks buffer dependencies, so
+`wait_to_read` maps to `jax.Array.block_until_ready` and the dependency
+engine bookkeeping disappears from the hot path (SURVEY.md §7.1).
+
+Known deviation: basic `__getitem__` returns a copy, not an aliasing view
+(jax buffers are immutable); `__setitem__` rebinds the underlying buffer via
+a functional scatter.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import _global
+from ..base import MXNetError, dtype_name, np_dtype
+from ..context import Context, current_context
+from ..ops.registry import get_op
+
+__all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty", "arange", "eye", "concat", "stack", "waitall"]
+
+
+class NDArray:
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_entry", "_marked", "__weakref__")
+
+    def __init__(self, data, ctx: Optional[Context] = None):
+        if isinstance(data, NDArray):
+            data = data._data
+        self._data = data
+        self._ctx = ctx or current_context()
+        self._grad: Optional["NDArray"] = None
+        self._grad_req = "null"
+        self._entry: Optional[Tuple[Any, int]] = None  # (tape node, output index)
+        self._marked = False  # True once attach_grad() marks this as a leaf
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype) if self._data.dtype != jnp.bfloat16 else self._data.dtype
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self) -> str:
+        return "default"
+
+    @property
+    def T(self) -> "NDArray":
+        return NDArray(jnp.transpose(self._data), self._ctx)
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    @property
+    def data_jax(self):
+        """The underlying jax.Array (TPU-native escape hatch)."""
+        return self._data
+
+    # ------------------------------------------------------------------
+    # conversion / sync
+    # ------------------------------------------------------------------
+    def asnumpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    item = asscalar
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise MXNetError("ambiguous truth value of multi-element NDArray")
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    def astype(self, dtype, copy=True) -> "NDArray":
+        d = np_dtype(dtype) if isinstance(dtype, str) else dtype
+        if not copy and self._data.dtype == d:
+            return self
+        return invoke("Cast", self, dtype=dtype_name(d))
+
+    def copy(self) -> "NDArray":
+        return invoke("_copy", self)
+
+    def copyto(self, other) -> "NDArray":
+        if isinstance(other, Context):
+            out = NDArray(jax.device_put(self._data, other.jax_device()), other)
+            return out
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data, other._ctx.jax_device())
+            return other
+        raise MXNetError("copyto: unsupported target %r" % (other,))
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self._ctx:
+            return self
+        return self.copyto(ctx)
+
+    as_in_ctx = as_in_context
+
+    def astuple(self):
+        return tuple(self.asnumpy())
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from .sparse import cast_storage
+
+        return cast_storage(self, stype)
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        """Mark this array as a gradient leaf (reference ndarray.py:2122)."""
+        self._marked = True
+        self._grad_req = grad_req
+        self._entry = None  # attaching grad detaches from any recorded graph
+        self._grad = NDArray(jnp.zeros_like(self._data), self._ctx)
+
+    def detach(self) -> "NDArray":
+        out = NDArray(self._data, self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    @property
+    def _in_graph(self) -> bool:
+        return self._marked or self._entry is not None
+
+    # ------------------------------------------------------------------
+    # shape ops (methods mirror reference NDArray methods)
+    # ------------------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if not shape:
+            shape = kwargs.get("shape")
+        return invoke("Reshape", self, shape=shape, reverse=kwargs.get("reverse", False))
+
+    def reshape_like(self, other):
+        return invoke("reshape_like", self, other)
+
+    def expand_dims(self, axis):
+        return invoke("expand_dims", self, axis=axis)
+
+    def squeeze(self, axis=None):
+        return invoke("squeeze", self, axis=axis)
+
+    def flatten(self):
+        return invoke("Flatten", self)
+
+    def transpose(self, axes=None):
+        return invoke("transpose", self, axes=axes)
+
+    def swapaxes(self, dim1, dim2):
+        return invoke("SwapAxis", self, dim1=dim1, dim2=dim2)
+
+    def flip(self, axis):
+        return invoke("reverse", self, axis=axis)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return invoke("SliceChannel", self, num_outputs=num_outputs, axis=axis, squeeze_axis=squeeze_axis)
+
+    def slice(self, begin, end, step=None):
+        return invoke("slice", self, begin=begin, end=end, step=step)
+
+    def slice_axis(self, axis, begin, end):
+        return invoke("slice_axis", self, axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        return invoke("take", self, indices, axis=axis, mode=mode)
+
+    def pick(self, index, axis=-1, keepdims=False):
+        return invoke("pick", self, index, axis=axis, keepdims=keepdims)
+
+    def one_hot(self, depth, **kw):
+        return invoke("one_hot", self, depth=depth, **kw)
+
+    def tile(self, reps):
+        return invoke("tile", self, reps=reps)
+
+    def repeat(self, repeats, axis=None):
+        return invoke("repeat", self, repeats=repeats, axis=axis)
+
+    def broadcast_to(self, shape):
+        return invoke("broadcast_to", self, shape=shape)
+
+    def broadcast_like(self, other):
+        return invoke("broadcast_like", self, other)
+
+    def pad(self, mode, pad_width, constant_value=0.0):
+        return invoke("Pad", self, mode=mode, pad_width=pad_width, constant_value=constant_value)
+
+    def clip(self, a_min, a_max):
+        return invoke("clip", self, a_min=a_min, a_max=a_max)
+
+    def abs(self):
+        return invoke("abs", self)
+
+    def sign(self):
+        return invoke("sign", self)
+
+    def sqrt(self):
+        return invoke("sqrt", self)
+
+    def square(self):
+        return invoke("square", self)
+
+    def exp(self):
+        return invoke("exp", self)
+
+    def log(self):
+        return invoke("log", self)
+
+    def relu(self):
+        return invoke("relu", self)
+
+    def sigmoid(self):
+        return invoke("sigmoid", self)
+
+    def tanh(self):
+        return invoke("tanh", self)
+
+    def softmax(self, axis=-1):
+        return invoke("softmax", self, axis=axis)
+
+    def log_softmax(self, axis=-1):
+        return invoke("log_softmax", self, axis=axis)
+
+    def sum(self, axis=None, keepdims=False, **kw):
+        return invoke("sum", self, axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        return invoke("mean", self, axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return invoke("prod", self, axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return invoke("max", self, axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return invoke("min", self, axis=axis, keepdims=keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return invoke("norm", self, ord=ord, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        return invoke("argmax", self, axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return invoke("argmin", self, axis=axis, keepdims=keepdims)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return invoke("argsort", self, axis=axis, is_ascend=is_ascend)
+
+    def sort(self, axis=-1, is_ascend=True):
+        return invoke("sort", self, axis=axis, is_ascend=is_ascend)
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return invoke("topk", self, axis=axis, k=k, ret_typ=ret_typ, is_ascend=is_ascend)
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return invoke("dot", self, other, transpose_a=transpose_a, transpose_b=transpose_b)
+
+    # ------------------------------------------------------------------
+    # arithmetic operators (broadcast semantics, reference ndarray.py)
+    # ------------------------------------------------------------------
+    def _binop(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return invoke(op, a, b)
+        if isinstance(other, (int, float, np.generic)):
+            return invoke(scalar_op, self, scalar=float(other))
+        if isinstance(other, np.ndarray):
+            o = array(other, ctx=self._ctx, dtype=self._data.dtype)
+            a, b = (o, self) if reverse else (self, o)
+            return invoke(op, a, b)
+        return NotImplemented
+
+    def __add__(self, o):
+        return self._binop(o, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        if isinstance(o, (int, float, np.generic)):
+            return invoke("_rminus_scalar", self, scalar=float(o))
+        return self._binop(o, "broadcast_sub", "_minus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        if isinstance(o, (int, float, np.generic)):
+            return invoke("_rdiv_scalar", self, scalar=float(o))
+        return self._binop(o, "broadcast_div", "_div_scalar", reverse=True)
+
+    def __mod__(self, o):
+        return self._binop(o, "broadcast_mod", "_mod_scalar")
+
+    def __rmod__(self, o):
+        if isinstance(o, (int, float, np.generic)):
+            return invoke("_rmod_scalar", self, scalar=float(o))
+        return self._binop(o, "broadcast_mod", "_mod_scalar", reverse=True)
+
+    def __pow__(self, o):
+        return self._binop(o, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        if isinstance(o, (int, float, np.generic)):
+            return invoke("_rpower_scalar", self, scalar=float(o))
+        return NotImplemented
+
+    def __neg__(self):
+        return invoke("negative", self)
+
+    def __abs__(self):
+        return invoke("abs", self)
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binop(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binop(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binop(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binop(o, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binop(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binop(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    # in-place: rebind the buffer (XLA buffers are immutable)
+    def __iadd__(self, o):
+        out = self.__add__(o)
+        self._data = out._data
+        self._entry = out._entry
+        return self
+
+    def __isub__(self, o):
+        out = self.__sub__(o)
+        self._data = out._data
+        self._entry = out._entry
+        return self
+
+    def __imul__(self, o):
+        out = self.__mul__(o)
+        self._data = out._data
+        self._entry = out._entry
+        return self
+
+    def __itruediv__(self, o):
+        out = self.__truediv__(o)
+        self._data = out._data
+        self._entry = out._entry
+        return self
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def _conv_index(self, key):
+        if isinstance(key, NDArray):
+            return key._data.astype(jnp.int32) if jnp.issubdtype(key._data.dtype, jnp.floating) else key._data
+        if isinstance(key, tuple):
+            return tuple(self._conv_index(k) for k in key)
+        if isinstance(key, list):
+            return np.asarray(key)
+        return key
+
+    def __getitem__(self, key):
+        return NDArray(self._data[self._conv_index(key)], self._ctx)
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            v = value._data
+        elif isinstance(value, (int, float, np.generic)):
+            v = value
+        else:
+            v = jnp.asarray(value)
+        if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
+            if isinstance(v, (int, float)):
+                self._data = jnp.full_like(self._data, v)
+            else:
+                self._data = jnp.broadcast_to(jnp.asarray(v, dtype=self._data.dtype), self.shape)
+            return
+        self._data = self._data.at[self._conv_index(key)].set(v)
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    def __repr__(self):
+        return "%s\n<NDArray %s @%s>" % (
+            str(self.asnumpy()),
+            "x".join(str(s) for s in self.shape),
+            self._ctx,
+        )
+
+    # numpy interop
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+
+# ---------------------------------------------------------------------------
+# eager dispatch
+# ---------------------------------------------------------------------------
+
+
+def invoke(op_name: str, *inputs, out=None, **kwargs):
+    """Eager op invocation — counterpart of the reference's
+    `MXImperativeInvokeEx` → `Imperative::Invoke` path
+    (`src/c_api/c_api_ndarray.cc:132`, `src/imperative/imperative.cc:87`).
+    Dispatches the registered fcompute on jax arrays and, when autograd is
+    recording, tapes a jax.vjp closure (the whole-graph XLA equivalent of the
+    reference's per-op FGradient)."""
+    opdef = get_op(op_name)
+    attrs = opdef.parse_attrs(kwargs)
+    nd_inputs: List[Optional[NDArray]] = []
+    datas = []
+    for i in inputs:
+        if isinstance(i, NDArray):
+            nd_inputs.append(i)
+            datas.append(i._data)
+        elif i is None:
+            nd_inputs.append(None)
+            datas.append(None)
+        else:
+            nd_inputs.append(None)
+            datas.append(jnp.asarray(i))
+
+    ctx = None
+    for nd in nd_inputs:
+        if nd is not None:
+            ctx = nd._ctx
+            break
+    if ctx is None:
+        ctx = kwargs.get("ctx") or current_context()
+        if isinstance(ctx, str) and ctx:
+            dev, _, idx = ctx.partition("(")
+            ctx = Context(dev, int(idx.rstrip(")")) if idx else 0)
+        elif not isinstance(ctx, Context):
+            ctx = current_context()
+
+    from .. import autograd
+
+    record = autograd.is_recording() and any(
+        nd is not None and nd._in_graph for nd in nd_inputs
+    )
+
+    if record:
+        diff_pos = [k for k, nd in enumerate(nd_inputs) if nd is not None]
+        diff_datas = [datas[k] for k in diff_pos]
+
+        def fn(*xs):
+            full = list(datas)
+            for p, x in zip(diff_pos, xs):
+                full[p] = x
+            return opdef.fcompute(attrs, *full)
+
+        outputs, vjp_fn = jax.vjp(fn, *diff_datas)
+        single = not isinstance(outputs, (tuple, list))
+        outs_t = (outputs,) if single else tuple(outputs)
+        nd_outs = [NDArray(o, ctx) for o in outs_t]
+        node = autograd._TapeNode(
+            vjp_fn=vjp_fn,
+            inputs=[nd_inputs[k] for k in diff_pos],
+            out_shapes=[(o.shape, o.dtype) for o in outs_t],
+            single=single,
+            op_name=op_name,
+        )
+        for idx, nd in enumerate(nd_outs):
+            nd._entry = (node, idx)
+        result = nd_outs[0] if single else nd_outs
+    else:
+        outputs = opdef.fcompute(attrs, *datas)
+        # nullary ops (init/random) materialize on the default device; honor
+        # the requested context explicitly
+        if not any(nd is not None for nd in nd_inputs):
+            dev = ctx.jax_device()
+            if isinstance(outputs, (tuple, list)):
+                outputs = [jax.device_put(o, dev) for o in outputs]
+            else:
+                outputs = jax.device_put(outputs, dev)
+        if isinstance(outputs, (tuple, list)):
+            result = [NDArray(o, ctx) for o in outputs]
+        else:
+            result = NDArray(outputs, ctx)
+
+    if out is not None:
+        if isinstance(out, NDArray) and isinstance(result, NDArray):
+            out._data = result._data
+            out._entry = result._entry
+            return out
+        if isinstance(out, (list, tuple)):
+            for o, r in zip(out, result):
+                o._data = r._data
+                o._entry = r._entry
+            return out
+    return result
+
+
+# ---------------------------------------------------------------------------
+# creation functions (reference python/mxnet/ndarray/utils.py + ndarray.py)
+# ---------------------------------------------------------------------------
+
+
+def _put(npdata, ctx):
+    ctx = ctx or current_context()
+    return NDArray(jax.device_put(npdata, ctx.jax_device()), ctx)
+
+
+def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    if isinstance(source, NDArray):
+        data = source.asnumpy()
+    else:
+        data = np.asarray(source)
+    if dtype is None:
+        dtype = data.dtype if data.dtype != np.float64 else np.float32
+    d = np_dtype(dtype) if isinstance(dtype, str) else dtype
+    return _put(data.astype(d) if data.dtype != d else data, ctx)
+
+
+def zeros(shape, ctx=None, dtype=None, **kw) -> NDArray:
+    return _put(np.zeros(shape, dtype=_npd(dtype)), ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kw) -> NDArray:
+    return _put(np.ones(shape, dtype=_npd(dtype)), ctx)
+
+
+def full(shape, val, ctx=None, dtype=None, **kw) -> NDArray:
+    return _put(np.full(shape, val, dtype=_npd(dtype)), ctx)
+
+
+def empty(shape, ctx=None, dtype=None) -> NDArray:
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None) -> NDArray:
+    a = np.arange(start, stop, step, dtype=_npd(dtype))
+    if repeat > 1:
+        a = np.repeat(a, repeat)
+    return _put(a, ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None) -> NDArray:
+    return _put(np.eye(N, M or None, k, dtype=_npd(dtype)), ctx)
+
+
+def _npd(dtype):
+    if dtype is None:
+        return np.float32
+    d = np_dtype(dtype) if isinstance(dtype, str) else dtype
+    return d
+
+
+def concat(*args, dim=1):
+    arrs = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    return invoke("Concat", *arrs, num_args=len(arrs), dim=dim)
+
+
+def stack_arrays(*args, axis=0):
+    arrs = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    return invoke("stack", *arrs, num_args=len(arrs), axis=axis)
+
+
+stack = stack_arrays
+
+
+def waitall():
+    """Block until all async computation completes (reference mx.nd.waitall)."""
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def onehot_encode(indices, out):
+    res = invoke("one_hot", indices, depth=out.shape[1])
+    out._data = res._data
+    return out

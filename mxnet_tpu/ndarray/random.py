@@ -1,0 +1,74 @@
+"""`mx.nd.random` namespace (reference python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+from ..base import dtype_name
+from .ndarray import NDArray, invoke
+
+__all__ = [
+    "uniform", "normal", "randn", "exponential", "gamma", "poisson",
+    "negative_binomial", "generalized_negative_binomial", "multinomial",
+    "shuffle", "randint",
+]
+
+
+def _sample(op, shape, dtype, ctx, **kw):
+    kwargs = dict(kw)
+    if shape is not None:
+        kwargs["shape"] = shape if isinstance(shape, (tuple, list)) else (shape,)
+    if dtype is not None:
+        kwargs["dtype"] = dtype if isinstance(dtype, str) else dtype_name(dtype)
+    if ctx is not None:
+        kwargs["ctx"] = ctx
+    return invoke(op, **kwargs)
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), dtype=None, ctx=None, out=None, **kw):
+    if isinstance(low, NDArray):
+        return invoke("_sample_uniform", low, high, shape=None if shape == (1,) else shape, out=out)
+    return _sample("_random_uniform", shape, dtype, ctx, low=low, high=high)
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), dtype=None, ctx=None, out=None, **kw):
+    if isinstance(loc, NDArray):
+        return invoke("_sample_normal", loc, scale, shape=None if shape == (1,) else shape, out=out)
+    return _sample("_random_normal", shape, dtype, ctx, loc=loc, scale=scale)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None):
+    return _sample("_random_normal", shape or (1,), dtype, ctx, loc=loc, scale=scale)
+
+
+def exponential(scale=1.0, shape=(1,), dtype=None, ctx=None, out=None):
+    return _sample("_random_exponential", shape, dtype, ctx, lam=1.0 / scale)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype=None, ctx=None, out=None):
+    if isinstance(alpha, NDArray):
+        return invoke("_sample_gamma", alpha, beta, shape=None if shape == (1,) else shape, out=out)
+    return _sample("_random_gamma", shape, dtype, ctx, alpha=alpha, beta=beta)
+
+
+def poisson(lam=1.0, shape=(1,), dtype=None, ctx=None, out=None):
+    if isinstance(lam, NDArray):
+        return invoke("_sample_poisson", lam, shape=None if shape == (1,) else shape, out=out)
+    return _sample("_random_poisson", shape, dtype, ctx, lam=lam)
+
+
+def negative_binomial(k=1, p=1.0, shape=(1,), dtype=None, ctx=None, out=None):
+    return _sample("_random_negative_binomial", shape, dtype, ctx, k=k, p=p)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,), dtype=None, ctx=None, out=None):
+    return _sample("_random_generalized_negative_binomial", shape, dtype, ctx, mu=mu, alpha=alpha)
+
+
+def multinomial(data, shape=(1,), get_prob=False, dtype="int32", out=None):
+    return invoke("_sample_multinomial", data, shape=shape, get_prob=get_prob, dtype=dtype, out=out)
+
+
+def shuffle(data, out=None):
+    return invoke("_shuffle", data, out=out)
+
+
+def randint(low, high, shape=(1,), dtype=None, ctx=None, out=None):
+    return _sample("_random_randint", shape, dtype, ctx, low=low, high=high)

@@ -1,0 +1,187 @@
+"""Sparse NDArray storage types: row_sparse and csr.
+
+Capability parity with reference `include/mxnet/ndarray.h:62-66` +
+`python/mxnet/ndarray/sparse.py`. XLA has no native sparse storage
+(SURVEY.md §7.3), so these are index+value pairs whose ops lower to
+gather/scatter/segment-sum — the TPU-idiomatic encoding. They exist for the
+embedding/optimizer workflows: sparse gradients (Embedding sparse_grad),
+lazy sparse optimizer updates, and row_sparse_pull in KVStore.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .ndarray import NDArray, array, invoke
+
+__all__ = [
+    "RowSparseNDArray",
+    "CSRNDArray",
+    "row_sparse_array",
+    "csr_matrix",
+    "cast_storage",
+    "retain",
+]
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """values (nnz_rows, *row_shape) + sorted unique row indices (nnz_rows,).
+
+    reference: row_sparse chunks in ndarray.h; used for embedding grads and
+    PS-style on-demand row pulls."""
+
+    __slots__ = ("_values", "_indices", "_full_shape")
+
+    def __init__(self, values, indices, shape, ctx: Optional[Context] = None):
+        ctx = ctx or current_context()
+        self._values = values if not isinstance(values, NDArray) else values._data
+        self._indices = indices if not isinstance(indices, NDArray) else indices._data
+        self._full_shape = tuple(shape)
+        dense = jnp.zeros(shape, dtype=self._values.dtype).at[self._indices.astype(jnp.int32)].set(self._values)
+        super().__init__(dense, ctx)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._indices, self._ctx)
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._values, self._ctx)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        raise MXNetError("cannot cast row_sparse to %r" % stype)
+
+    def retain(self, row_ids) -> "RowSparseNDArray":
+        rid = row_ids._data.astype(jnp.int32) if isinstance(row_ids, NDArray) else jnp.asarray(row_ids, jnp.int32)
+        vals = jnp.take(self._data, rid, axis=0)
+        return RowSparseNDArray(vals, rid, self._full_shape, self._ctx)
+
+    def __repr__(self):
+        return "\n<RowSparseNDArray %s @%s>" % ("x".join(map(str, self.shape)), self._ctx)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (data, indices, indptr)."""
+
+    __slots__ = ("_csr_data", "_csr_indices", "_csr_indptr", "_full_shape")
+
+    def __init__(self, data, indices, indptr, shape, ctx: Optional[Context] = None):
+        ctx = ctx or current_context()
+        self._csr_data = data if not isinstance(data, NDArray) else data._data
+        self._csr_indices = indices if not isinstance(indices, NDArray) else indices._data
+        self._csr_indptr = indptr if not isinstance(indptr, NDArray) else indptr._data
+        self._full_shape = tuple(shape)
+        dense = _csr_to_dense(self._csr_data, self._csr_indices, self._csr_indptr, shape)
+        super().__init__(dense, ctx)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._csr_data, self._ctx)
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._csr_indices, self._ctx)
+
+    @property
+    def indptr(self) -> NDArray:
+        return NDArray(self._csr_indptr, self._ctx)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        raise MXNetError("cannot cast csr to %r" % stype)
+
+    def __repr__(self):
+        return "\n<CSRNDArray %s @%s>" % ("x".join(map(str, self.shape)), self._ctx)
+
+
+def _csr_to_dense(data, indices, indptr, shape):
+    np_data = np.asarray(data)
+    np_ind = np.asarray(indices).astype(np.int64)
+    np_ptr = np.asarray(indptr).astype(np.int64)
+    out = np.zeros(shape, dtype=np_data.dtype)
+    for r in range(shape[0]):
+        s, e = np_ptr[r], np_ptr[r + 1]
+        out[r, np_ind[s:e]] = np_data[s:e]
+    return jnp.asarray(out)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 2:
+        values, indices = arg1
+        v = array(values, ctx=ctx, dtype=dtype)._data
+        i = array(indices, ctx=ctx, dtype="int64")._data
+        return RowSparseNDArray(v, i, shape, ctx)
+    dense = array(arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        d = array(data, ctx=ctx, dtype=dtype)._data
+        i = array(indices, ctx=ctx, dtype="int64")._data
+        p = array(indptr, ctx=ctx, dtype="int64")._data
+        return CSRNDArray(d, i, p, shape, ctx)
+    dense = array(arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def cast_storage(arr: NDArray, stype: str):
+    """reference op cast_storage (src/operator/tensor/cast_storage.cc)."""
+    if stype == "default":
+        return NDArray(arr._data, arr._ctx)
+    a = arr.asnumpy()
+    if stype == "row_sparse":
+        nz_rows = np.where(np.any(a.reshape(a.shape[0], -1) != 0, axis=1))[0]
+        return RowSparseNDArray(jnp.asarray(a[nz_rows]), jnp.asarray(nz_rows.astype(np.int64)), a.shape, arr._ctx)
+    if stype == "csr":
+        if a.ndim != 2:
+            raise MXNetError("csr requires 2D")
+        data, indices, indptr = [], [], [0]
+        for r in range(a.shape[0]):
+            nz = np.nonzero(a[r])[0]
+            data.extend(a[r, nz].tolist())
+            indices.extend(nz.tolist())
+            indptr.append(len(indices))
+        return CSRNDArray(
+            jnp.asarray(np.asarray(data, dtype=a.dtype)),
+            jnp.asarray(np.asarray(indices, dtype=np.int64)),
+            jnp.asarray(np.asarray(indptr, dtype=np.int64)),
+            a.shape,
+            arr._ctx,
+        )
+    raise MXNetError("unknown stype %r" % stype)
+
+
+def retain(arr: RowSparseNDArray, row_ids):
+    return arr.retain(row_ids)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    from .ndarray import zeros as dense_zeros
+
+    d = dense_zeros(shape, ctx=ctx, dtype=dtype)
+    return cast_storage(d, stype) if stype != "default" else d

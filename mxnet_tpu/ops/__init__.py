@@ -1,0 +1,11 @@
+"""Operator registry package. Importing this populates OP_REGISTRY with the
+full op library (counterpart of the reference's static NNVM_REGISTER_OP
+initializers across `src/operator/`)."""
+from .registry import OP_REGISTRY, OpDef, AttrDict, get_op, list_ops, register, REQUIRED
+
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import linalg  # noqa: F401
+
+__all__ = ["OP_REGISTRY", "OpDef", "AttrDict", "get_op", "list_ops", "register", "REQUIRED"]

@@ -1,0 +1,148 @@
+"""Linear-algebra ops (reference `src/operator/tensor/la_op.cc`,
+`c_lapack_api.h`): _linalg_{gemm,gemm2,potrf,potri,trmm,trsm,sumlogdiag,
+syrk,syevd,gelqf,...}. LAPACK calls become jax.numpy.linalg / lax.linalg,
+which XLA lowers to MXU-friendly blocked kernels."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import REQUIRED, register
+
+
+def _t(x, transpose):
+    return jnp.swapaxes(x, -1, -2) if transpose else x
+
+
+@register(
+    "_linalg_gemm",
+    params={
+        "transpose_a": (bool, False),
+        "transpose_b": (bool, False),
+        "alpha": (float, 1.0),
+        "beta": (float, 1.0),
+        "axis": (int, -2),
+    },
+    inputs=("A", "B", "C"),
+    aliases=("linalg_gemm",),
+)
+def linalg_gemm(attrs, a, b, c):
+    return attrs.alpha * jnp.matmul(_t(a, attrs.transpose_a), _t(b, attrs.transpose_b)) + attrs.beta * c
+
+
+@register(
+    "_linalg_gemm2",
+    params={
+        "transpose_a": (bool, False),
+        "transpose_b": (bool, False),
+        "alpha": (float, 1.0),
+        "axis": (int, -2),
+    },
+    inputs=("A", "B"),
+    aliases=("linalg_gemm2",),
+)
+def linalg_gemm2(attrs, a, b):
+    return attrs.alpha * jnp.matmul(_t(a, attrs.transpose_a), _t(b, attrs.transpose_b))
+
+
+@register("_linalg_potrf", inputs=("A",), aliases=("linalg_potrf",))
+def linalg_potrf(attrs, a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("_linalg_potri", inputs=("A",), aliases=("linalg_potri",))
+def linalg_potri(attrs, a):
+    """Inverse of the SPD matrix whose Cholesky factor is A (reference potri)."""
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    linv = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register(
+    "_linalg_trmm",
+    params={"transpose": (bool, False), "rightside": (bool, False), "lower": (bool, True), "alpha": (float, 1.0)},
+    inputs=("A", "B"),
+    aliases=("linalg_trmm",),
+)
+def linalg_trmm(attrs, a, b):
+    at = _t(a, attrs.transpose)
+    out = jnp.matmul(b, at) if attrs.rightside else jnp.matmul(at, b)
+    return attrs.alpha * out
+
+
+@register(
+    "_linalg_trsm",
+    params={"transpose": (bool, False), "rightside": (bool, False), "lower": (bool, True), "alpha": (float, 1.0)},
+    inputs=("A", "B"),
+    aliases=("linalg_trsm",),
+)
+def linalg_trsm(attrs, a, b):
+    lower = attrs.lower != attrs.transpose
+    if attrs.rightside:
+        # solve X A^T' = alpha B  ->  A' X^T = alpha B^T
+        xt = jax.scipy.linalg.solve_triangular(
+            _t(a, not attrs.transpose), _t(attrs.alpha * b, True), lower=not lower
+        )
+        return _t(xt, True)
+    return jax.scipy.linalg.solve_triangular(_t(a, attrs.transpose), attrs.alpha * b, lower=lower)
+
+
+@register("_linalg_sumlogdiag", inputs=("A",), aliases=("linalg_sumlogdiag",))
+def linalg_sumlogdiag(attrs, a):
+    return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register(
+    "_linalg_syrk",
+    params={"transpose": (bool, False), "alpha": (float, 1.0)},
+    inputs=("A",),
+    aliases=("linalg_syrk",),
+)
+def linalg_syrk(attrs, a):
+    at = _t(a, True)
+    return attrs.alpha * (jnp.matmul(at, a) if attrs.transpose else jnp.matmul(a, at))
+
+
+@register("_linalg_syevd", inputs=("A",), num_outputs=2, aliases=("linalg_syevd",))
+def linalg_syevd(attrs, a):
+    w, v = jnp.linalg.eigh(a)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("_linalg_gelqf", inputs=("A",), num_outputs=2, aliases=("linalg_gelqf",))
+def linalg_gelqf(attrs, a):
+    """LQ factorization A = L Q with Q orthonormal rows (reference gelqf)."""
+    q, r = jnp.linalg.qr(_t(a, True))
+    return _t(r, True), _t(q, True)
+
+
+@register("_linalg_makediag", params={"offset": (int, 0)}, inputs=("A",), aliases=("linalg_makediag",))
+def linalg_makediag(attrs, a):
+    k = attrs.offset
+    n = a.shape[-1] + abs(k)
+    base = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    rows = idx - min(k, 0)
+    cols = idx + max(k, 0)
+    return base.at[..., rows, cols].set(a)
+
+
+@register("_linalg_extractdiag", params={"offset": (int, 0)}, inputs=("A",), aliases=("linalg_extractdiag",))
+def linalg_extractdiag(attrs, a):
+    return jnp.diagonal(a, offset=attrs.offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_inverse", inputs=("A",), aliases=("linalg_inverse",))
+def linalg_inverse(attrs, a):
+    return jnp.linalg.inv(a)
+
+
+@register("_linalg_det", inputs=("A",), aliases=("linalg_det",))
+def linalg_det(attrs, a):
+    return jnp.linalg.det(a)
+
+
+@register("_linalg_slogdet", inputs=("A",), num_outputs=2, aliases=("linalg_slogdet",))
+def linalg_slogdet(attrs, a):
+    sign, logdet = jnp.linalg.slogdet(a)
+    return sign, logdet

@@ -1,0 +1,931 @@
+"""Neural-network ops: FullyConnected, Convolution, Pooling, normalization,
+activations, softmax family, Dropout, RNN, sequence ops, loss outputs.
+
+Capability parity with reference `src/operator/nn/` + the legacy loss/output
+ops (`src/operator/softmax_output*.cc`, `regression_output*.cc`,
+`src/operator/rnn-inl.h`, `sequence_*.cc` — SURVEY.md §2.1). All compute is
+jax/lax so the MXU gets large fused matmuls/convs; layout defaults to the
+reference's NCHW but NHWC is supported (Convolution/Pooling `layout` attr)
+because channels-last tiles better onto TPU.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import _global
+from ..base import MXNetError
+from .registry import REQUIRED, register
+
+# ---------------------------------------------------------------------------
+# FullyConnected (reference src/operator/nn/fully_connected.cc)
+# ---------------------------------------------------------------------------
+
+
+@register(
+    "FullyConnected",
+    params={"num_hidden": (int, REQUIRED), "no_bias": (bool, False), "flatten": (bool, True)},
+    inputs=lambda attrs: ["data", "weight"] if attrs.get("no_bias") else ["data", "weight", "bias"],
+)
+def fully_connected(attrs, data, weight, *rest):
+    """out = data @ weight.T + bias; weight is (num_hidden, in_units) like the
+    reference so saved .params files transfer."""
+    if attrs.flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = jnp.matmul(data, weight.T)
+    if rest:
+        out = out + rest[0]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution (reference src/operator/nn/convolution.cc)
+# ---------------------------------------------------------------------------
+
+
+def _conv_dims(kernel_ndim, layout):
+    if layout in (None, "", "NCHW", "NCW", "NCDHW"):
+        spatial = "DHW"[-kernel_ndim:]
+        lhs = "NC" + spatial
+        out = lhs
+    else:  # NHWC family
+        spatial = "DHW"[-kernel_ndim:]
+        lhs = "N" + spatial + "C"
+        out = lhs
+    rhs = "OI" + "DHW"[-kernel_ndim:]
+    return (lhs, rhs, out)
+
+
+@register(
+    "Convolution",
+    params={
+        "kernel": (tuple, REQUIRED),
+        "stride": (tuple, None),
+        "dilate": (tuple, None),
+        "pad": (tuple, None),
+        "num_filter": (int, REQUIRED),
+        "num_group": (int, 1),
+        "workspace": (int, 1024),
+        "no_bias": (bool, False),
+        "cudnn_tune": (str, None),
+        "cudnn_off": (bool, False),
+        "layout": (str, None),
+    },
+    inputs=lambda attrs: ["data", "weight"] if attrs.get("no_bias") else ["data", "weight", "bias"],
+)
+def convolution(attrs, data, weight, *rest):
+    k = attrs.kernel
+    nd = len(k)
+    stride = attrs.stride or (1,) * nd
+    dilate = attrs.dilate or (1,) * nd
+    pad = attrs.pad or (0,) * nd
+    layout = attrs.layout or ("NCW" if nd == 1 else ("NCHW" if nd == 2 else "NCDHW"))
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(nd, layout))
+    out = lax.conv_general_dilated(
+        data,
+        weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=attrs.num_group,
+        preferred_element_type=None,
+    )
+    if rest:
+        bias = rest[0]
+        if layout.startswith("NC"):
+            out = out + bias.reshape((1, -1) + (1,) * nd)
+        else:
+            out = out + bias
+    return out
+
+
+@register(
+    "Deconvolution",
+    params={
+        "kernel": (tuple, REQUIRED),
+        "stride": (tuple, None),
+        "dilate": (tuple, None),
+        "pad": (tuple, None),
+        "adj": (tuple, None),
+        "target_shape": (tuple, None),
+        "num_filter": (int, REQUIRED),
+        "num_group": (int, 1),
+        "workspace": (int, 512),
+        "no_bias": (bool, True),
+        "cudnn_tune": (str, None),
+        "cudnn_off": (bool, False),
+        "layout": (str, None),
+    },
+    inputs=lambda attrs: ["data", "weight"] if attrs.get("no_bias", True) else ["data", "weight", "bias"],
+)
+def deconvolution(attrs, data, weight, *rest):
+    """Transposed convolution (gradient of Convolution w.r.t. its input).
+
+    Weight layout matches the reference: (in_channels, out_channels/group, *k).
+    Implemented as an input-dilated forward convolution with a spatially
+    flipped, transposed kernel — the standard XLA lowering.
+    """
+    k = attrs.kernel
+    nd = len(k)
+    stride = attrs.stride or (1,) * nd
+    pad = attrs.pad or (0,) * nd
+    dilate = attrs.dilate or (1,) * nd
+    adj = attrs.adj or (0,) * nd
+    g = attrs.num_group
+
+    # (I, O/g, *k) -> (O, I/g, *k) with spatial flip, respecting groups
+    w = weight.reshape((g, weight.shape[0] // g) + tuple(weight.shape[1:]))
+    w = jnp.swapaxes(w, 1, 2)  # (g, O/g, I/g, *k)
+    w = w.reshape((weight.shape[1] * g, weight.shape[0] // g) + tuple(weight.shape[2:]))
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+
+    dn = lax.conv_dimension_numbers(
+        data.shape,
+        w.shape,
+        _conv_dims(nd, attrs.layout or ("NCW" if nd == 1 else ("NCHW" if nd == 2 else "NCDHW"))),
+    )
+    out = lax.conv_general_dilated(
+        data,
+        w,
+        window_strides=(1,) * nd,
+        padding=[
+            (d * (kk - 1) - p, d * (kk - 1) - p + a)
+            for kk, p, d, a in zip(k, pad, dilate, adj)
+        ],
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=g,
+    )
+    if rest:
+        out = out + rest[0].reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling (reference src/operator/nn/pooling.cc)
+# ---------------------------------------------------------------------------
+
+
+@register(
+    "Pooling",
+    params={
+        "kernel": (tuple, None),
+        "pool_type": (str, "max"),
+        "global_pool": (bool, False),
+        "cudnn_off": (bool, False),
+        "pooling_convention": (str, "valid"),
+        "stride": (tuple, None),
+        "pad": (tuple, None),
+        "p_value": (int, 2),
+        "count_include_pad": (bool, True),
+        "layout": (str, None),
+    },
+)
+def pooling(attrs, data):
+    nd = data.ndim - 2
+    layout = attrs.layout or ("NCW" if nd == 1 else ("NCHW" if nd == 2 else "NCDHW"))
+    channels_first = layout.startswith("NC")
+    if channels_first:
+        spatial_axes = tuple(range(2, 2 + nd))
+    else:
+        spatial_axes = tuple(range(1, 1 + nd))
+
+    if attrs.global_pool:
+        if attrs.pool_type == "max":
+            return jnp.max(data, axis=spatial_axes, keepdims=True)
+        if attrs.pool_type in ("avg", "sum"):
+            red = jnp.mean if attrs.pool_type == "avg" else jnp.sum
+            return red(data, axis=spatial_axes, keepdims=True)
+        raise MXNetError("unsupported global pool_type %r" % attrs.pool_type)
+
+    kernel = attrs.kernel
+    stride = attrs.stride or (1,) * nd
+    pad = attrs.pad or (0,) * nd
+
+    window = [1] * data.ndim
+    strides = [1] * data.ndim
+    padding = [(0, 0)] * data.ndim
+    for i, ax in enumerate(spatial_axes):
+        window[ax] = kernel[i]
+        strides[ax] = stride[i]
+        lo = pad[i]
+        hi = pad[i]
+        if attrs.pooling_convention == "full":
+            # ceil division output: add extra high padding when needed
+            size = data.shape[ax] + 2 * pad[i]
+            rem = (size - kernel[i]) % stride[i]
+            if rem != 0:
+                hi += stride[i] - rem
+        padding[ax] = (lo, hi)
+
+    if attrs.pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, padding)
+    if attrs.pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
+        if attrs.pool_type == "sum":
+            return summed
+        if attrs.count_include_pad:
+            denom = 1
+            for i in range(nd):
+                denom *= kernel[i]
+            return summed / denom
+        ones = jnp.ones(data.shape, dtype=data.dtype)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return summed / counts
+    if attrs.pool_type == "lp":
+        p = float(attrs.p_value)
+        summed = lax.reduce_window(jnp.abs(data) ** p, 0.0, lax.add, window, strides, padding)
+        return summed ** (1.0 / p)
+    raise MXNetError("unsupported pool_type %r" % attrs.pool_type)
+
+
+# ---------------------------------------------------------------------------
+# Normalization (reference src/operator/nn/batch_norm.cc, layer_norm.cc,
+# instance_norm.cc, lrn.cc, l2_normalization.cc)
+# ---------------------------------------------------------------------------
+
+
+@register(
+    "BatchNorm",
+    params={
+        "eps": (float, 1e-3),
+        "momentum": (float, 0.9),
+        "fix_gamma": (bool, True),
+        "use_global_stats": (bool, False),
+        "output_mean_var": (bool, False),
+        "axis": (int, 1),
+        "cudnn_off": (bool, False),
+    },
+    inputs=("data", "gamma", "beta", "moving_mean", "moving_var"),
+    num_outputs=3,
+)
+def batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
+    """Returns (out, batch_mean, batch_var). Moving-stat updates are handled
+    by the caller (Gluon layer / executor aux-state machinery), keeping this a
+    pure function for XLA. Reference semantics: train uses batch stats unless
+    use_global_stats; fix_gamma pins gamma to 1."""
+    ax = attrs.axis % data.ndim
+    red_axes = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    g = jnp.ones_like(gamma) if attrs.fix_gamma else gamma
+    use_batch = _global.is_train() and not attrs.use_global_stats
+    if use_batch:
+        mean = jnp.mean(data, axis=red_axes)
+        var = jnp.var(data, axis=red_axes)
+    else:
+        mean, var = moving_mean, moving_var
+    inv = lax.rsqrt(var + attrs.eps)
+    out = (data - mean.reshape(bshape)) * (inv * g).reshape(bshape) + beta.reshape(bshape)
+    return out, mean, var
+
+
+@register(
+    "LayerNorm",
+    params={"axis": (int, -1), "eps": (float, 1e-5), "output_mean_var": (bool, False)},
+    inputs=("data", "gamma", "beta"),
+    num_outputs=3,
+)
+def layer_norm(attrs, data, gamma, beta):
+    ax = attrs.axis % data.ndim
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    inv = lax.rsqrt(var + attrs.eps)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    out = (data - mean) * inv * gamma.reshape(bshape) + beta.reshape(bshape)
+    return out, jnp.squeeze(mean, ax), jnp.squeeze(var, ax)
+
+
+@register(
+    "InstanceNorm",
+    params={"eps": (float, 1e-3)},
+    inputs=("data", "gamma", "beta"),
+)
+def instance_norm(attrs, data, gamma, beta):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * lax.rsqrt(var + attrs.eps) * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register(
+    "L2Normalization",
+    params={"eps": (float, 1e-10), "mode": (str, "instance")},
+)
+def l2_normalization(attrs, data):
+    if attrs.mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif attrs.mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, data.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + attrs.eps)
+    return data / norm
+
+
+@register(
+    "LRN",
+    params={"alpha": (float, 1e-4), "beta": (float, 0.75), "knorm": (float, 2.0), "nsize": (int, REQUIRED)},
+)
+def lrn(attrs, data):
+    sq = jnp.square(data)
+    half = attrs.nsize // 2
+    c = data.shape[1]
+    padded = jnp.pad(sq, ((0, 0), (half, half)) + ((0, 0),) * (data.ndim - 2))
+    window = jnp.stack([padded[:, i : i + c] for i in range(attrs.nsize)], axis=0).sum(axis=0)
+    return data / jnp.power(attrs.knorm + attrs.alpha * window / attrs.nsize, attrs.beta)
+
+
+# ---------------------------------------------------------------------------
+# Activations (reference src/operator/nn/activation.cc, leaky_relu.cc)
+# ---------------------------------------------------------------------------
+
+_ACTS = {
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softrelu": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+}
+
+
+@register("Activation", params={"act_type": (str, REQUIRED)})
+def activation(attrs, data):
+    try:
+        return _ACTS[attrs.act_type](data)
+    except KeyError:
+        raise MXNetError("unknown act_type %r" % attrs.act_type)
+
+
+@register(
+    "LeakyReLU",
+    params={
+        "act_type": (str, "leaky"),
+        "slope": (float, 0.25),
+        "lower_bound": (float, 0.125),
+        "upper_bound": (float, 0.334),
+    },
+    inputs=lambda attrs: ["data", "gamma"] if attrs.get("act_type") == "prelu" else ["data"],
+)
+def leaky_relu(attrs, data, *rest):
+    t = attrs.act_type
+    if t == "leaky":
+        return jnp.where(data >= 0, data, attrs.slope * data)
+    if t == "elu":
+        return jnp.where(data >= 0, data, attrs.slope * jnp.expm1(data))
+    if t == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, alpha * jnp.expm1(data))
+    if t == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if t == "prelu":
+        gamma = rest[0]
+        bshape = (1, -1) + (1,) * (data.ndim - 2) if data.ndim > 1 else (-1,)
+        return jnp.where(data >= 0, data, gamma.reshape(bshape) * data)
+    if t == "rrelu":
+        if _global.is_train():
+            key = _global.next_key()
+            slope = jax.random.uniform(
+                key, data.shape, minval=attrs.lower_bound, maxval=attrs.upper_bound, dtype=data.dtype
+            )
+        else:
+            slope = (attrs.lower_bound + attrs.upper_bound) / 2.0
+        return jnp.where(data >= 0, data, slope * data)
+    raise MXNetError("unknown LeakyReLU act_type %r" % t)
+
+
+# ---------------------------------------------------------------------------
+# Softmax family (reference src/operator/nn/softmax.cc:70-152)
+# ---------------------------------------------------------------------------
+
+
+def _softmax_impl(attrs, data, log=False, neg=False):
+    ax = attrs.axis
+    x = -data if neg else data
+    if attrs.temperature is not None and attrs.temperature != 1.0:
+        x = x / attrs.temperature
+    fn = jax.nn.log_softmax if log else jax.nn.softmax
+    out = fn(x, axis=ax)
+    if attrs.dtype is not None:
+        out = out.astype(attrs.dtype)
+    return out
+
+
+_SOFTMAX_PARAMS = {"axis": (int, -1), "temperature": (float, None), "dtype": ("dtype", None)}
+
+
+@register("softmax", params=dict(_SOFTMAX_PARAMS))
+def softmax(attrs, data):
+    return _softmax_impl(attrs, data)
+
+
+@register("softmin", params=dict(_SOFTMAX_PARAMS))
+def softmin(attrs, data):
+    return _softmax_impl(attrs, data, neg=True)
+
+
+@register("log_softmax", params=dict(_SOFTMAX_PARAMS))
+def log_softmax(attrs, data):
+    return _softmax_impl(attrs, data, log=True)
+
+
+@register("SoftmaxActivation", params={"mode": (str, "instance")})
+def softmax_activation(attrs, data):
+    if attrs.mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+def _softmax_output_fwd(data, label, attrs_tuple):
+    (grad_scale, ignore_label, use_ignore, multi_output, normalization,
+     smooth_alpha, out_grad_flag, preserve_shape) = attrs_tuple
+    ax = 1 if (multi_output or preserve_shape) else -1
+    if multi_output:
+        prob = jax.nn.softmax(data, axis=1)
+    elif preserve_shape:
+        prob = jax.nn.softmax(data, axis=-1)
+    else:
+        prob = jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+    return prob
+
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _softmax_output(data, label, attrs_tuple):
+    return _softmax_output_fwd(data, label, attrs_tuple)
+
+
+def _so_fwd(data, label, attrs_tuple):
+    prob = _softmax_output_fwd(data, label, attrs_tuple)
+    return prob, (prob, label)
+
+
+def _so_bwd(attrs_tuple, res, g):
+    """Reference semantics (`src/operator/softmax_output-inl.h`): the backward
+    of SoftmaxOutput ignores incoming gradient and emits
+    (prob - smoothed_onehot(label)) * grad_scale, where label smoothing
+    replaces onehot with (1-alpha)*onehot + alpha/(k-1)*(1-onehot), plus
+    ignore_label masking and normalization."""
+    prob, label = res
+    (grad_scale, ignore_label, use_ignore, multi_output, normalization,
+     smooth_alpha, out_grad_flag, preserve_shape) = attrs_tuple
+
+    def smoothed(onehot, k):
+        if smooth_alpha > 0:
+            return onehot * (1.0 - smooth_alpha) + (1.0 - onehot) * (smooth_alpha / (k - 1))
+        return onehot
+
+    if multi_output:
+        nclass = prob.shape[1]
+        lab = label.astype(jnp.int32)
+        onehot = smoothed(jax.nn.one_hot(lab, nclass, dtype=prob.dtype, axis=1), nclass)
+        grad = prob - onehot
+        if use_ignore:
+            mask = (label != ignore_label).astype(prob.dtype)
+            grad = grad * jnp.expand_dims(mask, 1)
+    else:
+        flat = prob.reshape(prob.shape[0], -1) if not preserve_shape else prob
+        lab = label.astype(jnp.int32).reshape(-1) if not preserve_shape else label.astype(jnp.int32)
+        if preserve_shape:
+            onehot = smoothed(jax.nn.one_hot(lab, prob.shape[-1], dtype=prob.dtype), prob.shape[-1])
+            grad = prob - onehot
+            if use_ignore:
+                mask = (label != ignore_label).astype(prob.dtype)[..., None]
+                grad = grad * mask
+        else:
+            onehot = smoothed(jax.nn.one_hot(lab, flat.shape[-1], dtype=prob.dtype), flat.shape[-1])
+            grad = (flat - onehot).reshape(prob.shape)
+            if use_ignore:
+                mask = (label.reshape(-1) != ignore_label).astype(prob.dtype)
+                grad = grad * mask.reshape((-1,) + (1,) * (prob.ndim - 1))
+    scale = grad_scale
+    if normalization == "batch":
+        scale = scale / prob.shape[0]
+    elif normalization == "valid" and use_ignore:
+        valid = jnp.maximum(jnp.sum((label != ignore_label).astype(prob.dtype)), 1.0)
+        scale = scale / valid
+    return (grad * scale).astype(prob.dtype), jnp.zeros_like(label)
+
+
+_softmax_output.defvjp(_so_fwd, _so_bwd)
+
+
+@register(
+    "SoftmaxOutput",
+    params={
+        "grad_scale": (float, 1.0),
+        "ignore_label": (float, -1.0),
+        "multi_output": (bool, False),
+        "use_ignore": (bool, False),
+        "preserve_shape": (bool, False),
+        "normalization": (str, "null"),
+        "out_grad": (bool, False),
+        "smooth_alpha": (float, 0.0),
+    },
+    inputs=("data", "label"),
+    aliases=("Softmax",),
+)
+def softmax_output(attrs, data, label):
+    at = (
+        attrs.grad_scale,
+        attrs.ignore_label,
+        attrs.use_ignore,
+        attrs.multi_output,
+        attrs.normalization,
+        attrs.smooth_alpha,
+        attrs.out_grad,
+        attrs.preserve_shape,
+    )
+    return _softmax_output(data, label, at)
+
+
+@register(
+    "softmax_cross_entropy",
+    inputs=("data", "label"),
+)
+def softmax_cross_entropy(attrs, data, label):
+    logprob = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(logprob, lab[:, None], axis=-1)
+    return -jnp.sum(picked)
+
+
+# regression outputs: forward=identity-ish, backward=(pred-label)*scale
+def _make_regression(name, link, grad_fn):
+    from functools import partial as _partial
+
+    @_partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def _core(data, label, scale):
+        return link(data)
+
+    def _fwd(data, label, scale):
+        out = link(data)
+        return out, (out, label)
+
+    def _bwd(scale, res, g):
+        # reference regression_output-inl.h normalizes by outputs-per-sample
+        out, label = res
+        n = 1
+        for d in out.shape[1:]:
+            n *= d
+        grad = grad_fn(out, label.reshape(out.shape)) * (scale / n)
+        return grad, jnp.zeros_like(label)
+
+    _core.defvjp(_fwd, _bwd)
+
+    @register(name, params={"grad_scale": (float, 1.0)}, inputs=("data", "label"))
+    def _op(attrs, data, label, _core=_core):
+        return _core(data, label, attrs.grad_scale)
+
+    return _op
+
+
+_make_regression("LinearRegressionOutput", lambda x: x, lambda o, l: (o - l))
+_make_regression("LogisticRegressionOutput", jax.nn.sigmoid, lambda o, l: (o - l))
+_make_regression("MAERegressionOutput", lambda x: x, lambda o, l: jnp.sign(o - l))
+
+
+def _svm_bwd_core(out, label, margin, reg, use_linear):
+    """reference svm_output.cc L1_SVM/L2_SVM: for the label class k,
+    grad = -[margin > s_k]*reg (L1) or -2*reg*max(0, margin - s_k) (L2);
+    for other classes x, grad = [margin > -s_x]*reg (L1) or
+    2*reg*max(0, margin + s_x) (L2)."""
+    flat = out.reshape(out.shape[0], -1)
+    k = label.astype(jnp.int32).reshape(-1)
+    onehot = jax.nn.one_hot(k, flat.shape[-1], dtype=flat.dtype)
+    if use_linear:
+        g_target = -(margin > flat).astype(flat.dtype) * reg
+        g_other = (margin > -flat).astype(flat.dtype) * reg
+    else:
+        g_target = -2.0 * reg * jnp.maximum(0.0, margin - flat)
+        g_other = 2.0 * reg * jnp.maximum(0.0, margin + flat)
+    grad = onehot * g_target + (1.0 - onehot) * g_other
+    return grad.reshape(out.shape)
+
+
+from functools import partial as _svm_partial
+
+
+@_svm_partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _svm_output_core(data, label, attrs_tuple):
+    return data
+
+
+def _svm_fwd(data, label, attrs_tuple):
+    return data, (data, label)
+
+
+def _svm_bwd(attrs_tuple, res, g):
+    out, label = res
+    margin, reg, use_linear = attrs_tuple
+    return _svm_bwd_core(out, label, margin, reg, use_linear), jnp.zeros_like(label)
+
+
+_svm_output_core.defvjp(_svm_fwd, _svm_bwd)
+
+
+@register(
+    "SVMOutput",
+    params={"margin": (float, 1.0), "regularization_coefficient": (float, 1.0), "use_linear": (bool, False)},
+    inputs=("data", "label"),
+)
+def svm_output(attrs, data, label):
+    return _svm_output_core(data, label, (attrs.margin, attrs.regularization_coefficient, attrs.use_linear))
+
+
+# ---------------------------------------------------------------------------
+# Dropout (reference src/operator/nn/dropout.cc)
+# ---------------------------------------------------------------------------
+
+
+@register(
+    "Dropout",
+    params={"p": (float, 0.5), "mode": (str, "training"), "axes": (tuple, None), "cudnn_off": (bool, False)},
+)
+def dropout(attrs, data):
+    if attrs.p <= 0 or (not _global.is_train() and attrs.mode != "always"):
+        return data
+    key = _global.next_key()
+    shape = data.shape
+    if attrs.axes:
+        shape = tuple(1 if i in attrs.axes else s for i, s in enumerate(data.shape))
+    keep = 1.0 - attrs.p
+    mask = jax.random.bernoulli(key, keep, shape)
+    return jnp.where(mask, data / keep, jnp.zeros((), dtype=data.dtype))
+
+
+# ---------------------------------------------------------------------------
+# UpSampling / grid ops
+# ---------------------------------------------------------------------------
+
+
+@register(
+    "UpSampling",
+    params={
+        "scale": (int, REQUIRED),
+        "num_filter": (int, 0),
+        "sample_type": (str, "nearest"),
+        "multi_input_mode": (str, "concat"),
+        "num_args": (int, 1),
+        "workspace": (int, 512),
+    },
+    inputs=lambda attrs: ["arg%d" % i for i in range(attrs.get("num_args", 1))],
+)
+def upsampling(attrs, *xs):
+    s = attrs.scale
+    outs = []
+    for x in xs:
+        n, c, h, w = x.shape
+        out = jax.image.resize(x, (n, c, h * s, w * s), method="nearest" if attrs.sample_type == "nearest" else "bilinear")
+        outs.append(out)
+    if len(outs) == 1:
+        return outs[0]
+    return jnp.concatenate(outs, axis=1) if attrs.multi_input_mode == "concat" else sum(outs[1:], outs[0])
+
+
+# ---------------------------------------------------------------------------
+# Sequence ops (reference src/operator/sequence_*.cc)
+# ---------------------------------------------------------------------------
+
+
+def _seq_len_mask(seq_len, maxlen, batch, dtype):
+    steps = jnp.arange(maxlen, dtype=jnp.float32)[:, None]
+    return (steps < seq_len.astype(jnp.float32)[None, :]).astype(dtype)
+
+
+@register(
+    "SequenceMask",
+    params={"use_sequence_length": (bool, False), "value": (float, 0.0), "axis": (int, 0)},
+    inputs=lambda attrs: ["data", "sequence_length"] if attrs.get("use_sequence_length") else ["data"],
+)
+def sequence_mask(attrs, data, *rest):
+    if not attrs.use_sequence_length:
+        return data
+    seq_len = rest[0]
+    if attrs.axis == 0:
+        maxlen, batch = data.shape[0], data.shape[1]
+        mask = _seq_len_mask(seq_len, maxlen, batch, data.dtype)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    else:
+        batch, maxlen = data.shape[0], data.shape[1]
+        mask = _seq_len_mask(seq_len, maxlen, batch, data.dtype).T
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return data * mask + attrs.value * (1 - mask)
+
+
+@register(
+    "SequenceLast",
+    params={"use_sequence_length": (bool, False), "axis": (int, 0)},
+    inputs=lambda attrs: ["data", "sequence_length"] if attrs.get("use_sequence_length") else ["data"],
+)
+def sequence_last(attrs, data, *rest):
+    ax = attrs.axis
+    if not attrs.use_sequence_length:
+        return jnp.take(data, data.shape[ax] - 1, axis=ax)
+    seq_len = rest[0].astype(jnp.int32) - 1
+    if ax == 0:
+        batch = data.shape[1]
+        return data[seq_len, jnp.arange(batch)]
+    batch = data.shape[0]
+    return data[jnp.arange(batch), seq_len]
+
+
+@register(
+    "SequenceReverse",
+    params={"use_sequence_length": (bool, False), "axis": (int, 0)},
+    inputs=lambda attrs: ["data", "sequence_length"] if attrs.get("use_sequence_length") else ["data"],
+)
+def sequence_reverse(attrs, data, *rest):
+    if not attrs.use_sequence_length:
+        return jnp.flip(data, axis=0)
+    seq_len = rest[0].astype(jnp.int32)
+    maxlen = data.shape[0]
+    idx = jnp.arange(maxlen)[:, None]
+    rev_idx = jnp.where(idx < seq_len[None, :], seq_len[None, :] - 1 - idx, idx)
+    return jnp.take_along_axis(data, rev_idx.reshape(rev_idx.shape + (1,) * (data.ndim - 2)).astype(jnp.int32), axis=0) if data.ndim > 2 else jnp.take_along_axis(data, rev_idx, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Fused RNN (reference src/operator/rnn-inl.h, cudnn_rnn-inl.h) — implemented
+# as lax.scan over fused per-step matmuls so XLA pipelines the MXU.
+# ---------------------------------------------------------------------------
+
+
+def _gru_scan(x_seq, h0, wx, wh, bx, bh):
+    x_proj = jnp.einsum("tbi,gi->tbg", x_seq, wx) + bx
+
+    def step(h, xp):
+        rx, zx, nx = jnp.split(xp, 3, axis=-1)
+        hproj = jnp.matmul(h, wh.T) + bh
+        rh, zh, nh = jnp.split(hproj, 3, axis=-1)
+        r = jax.nn.sigmoid(rx + rh)
+        z = jax.nn.sigmoid(zx + zh)
+        n = jnp.tanh(nx + r * nh)
+        h_new = (1 - z) * n + z * h
+        return h_new, h_new
+
+    hT, ys = lax.scan(step, h0, x_proj)
+    return ys, hT
+
+
+def _rnn_layer_scan(mode, x_seq, h0, c0, wx, wh, bx, bh):
+    """One direction of one layer. x_seq (T,B,I); returns (ys, hT, cT)."""
+    if mode == "gru":
+        ys, hT = _gru_scan(x_seq, h0, wx, wh, bx, bh)
+        return ys, hT, c0
+    x_proj = jnp.einsum("tbi,gi->tbg", x_seq, wx) + bx
+
+    if mode == "lstm":
+        def step(carry, xp):
+            h, c = carry
+            gates = xp + jnp.matmul(h, wh.T) + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+        (hT, cT), ys = lax.scan(step, (h0, c0), x_proj)
+        return ys, hT, cT
+
+    act = jnp.tanh if mode == "rnn_tanh" else (lambda v: jnp.maximum(v, 0))
+
+    def step(h, xp):
+        h_new = act(xp + jnp.matmul(h, wh.T) + bh)
+        return h_new, h_new
+
+    hT, ys = lax.scan(step, h0, x_proj)
+    return ys, hT, c0
+
+
+def rnn_forward(mode, data, params_flat, state, state_cell, num_layers, state_size,
+                bidirectional=False, p_dropout=0.0, train=False):
+    """Fused multi-layer RNN matching reference parameter packing
+    (`src/operator/rnn-inl.h` — per layer/direction: W_x then W_h then b_x, b_h).
+
+    data: (T, B, I). state: (L*D, B, H). Returns (out, hT, cT).
+    """
+    ngates = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}[mode]
+    D = 2 if bidirectional else 1
+    T, B, I = data.shape
+    H = state_size
+    offset = 0
+    x = data
+    h_outs = []
+    c_outs = []
+
+    def take(n):
+        nonlocal offset
+        out = lax.dynamic_slice(params_flat, (offset,), (n,))
+        offset += n
+        return out
+
+    # weights for all layers/directions first, then biases (cuDNN packing)
+    weights = []
+    for layer in range(num_layers):
+        in_size = I if layer == 0 else H * D
+        per_dir = []
+        for d in range(D):
+            wx = take(ngates * H * in_size).reshape(ngates * H, in_size)
+            wh = take(ngates * H * H).reshape(ngates * H, H)
+            per_dir.append((wx, wh))
+        weights.append(per_dir)
+    biases = []
+    for layer in range(num_layers):
+        per_dir = []
+        for d in range(D):
+            bx = take(ngates * H)
+            bh = take(ngates * H)
+            per_dir.append((bx, bh))
+        biases.append(per_dir)
+
+    for layer in range(num_layers):
+        dir_outs = []
+        for d in range(D):
+            wx, wh = weights[layer][d]
+            bx, bh = biases[layer][d]
+            h0 = state[layer * D + d]
+            c0 = state_cell[layer * D + d] if state_cell is not None else jnp.zeros_like(h0)
+            xs = jnp.flip(x, axis=0) if d == 1 else x
+            ys, hT, cT = _rnn_layer_scan(mode, xs, h0, c0, wx, wh, bx, bh)
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            dir_outs.append(ys)
+            h_outs.append(hT)
+            c_outs.append(cT)
+        x = dir_outs[0] if D == 1 else jnp.concatenate(dir_outs, axis=-1)
+        if p_dropout > 0 and train and layer < num_layers - 1:
+            key = _global.next_key()
+            keep = 1.0 - p_dropout
+            mask = jax.random.bernoulli(key, keep, x.shape)
+            x = jnp.where(mask, x / keep, jnp.zeros((), dtype=x.dtype))
+    hT = jnp.stack(h_outs, axis=0)
+    cT = jnp.stack(c_outs, axis=0) if mode == "lstm" else None
+    return x, hT, cT
+
+
+@register(
+    "RNN",
+    params={
+        "state_size": (int, REQUIRED),
+        "num_layers": (int, REQUIRED),
+        "bidirectional": (bool, False),
+        "mode": (str, REQUIRED),
+        "p": (float, 0.0),
+        "state_outputs": (bool, False),
+        "projection_size": (int, None),
+        "lstm_state_clip_min": (float, None),
+        "lstm_state_clip_max": (float, None),
+        "lstm_state_clip_nan": (bool, False),
+    },
+    inputs=lambda attrs: ["data", "parameters", "state", "state_cell"]
+    if attrs.get("mode") == "lstm"
+    else ["data", "parameters", "state"],
+    num_outputs=lambda attrs: (3 if attrs.get("mode") == "lstm" else 2) if attrs.get("state_outputs") else 1,
+)
+def rnn(attrs, data, parameters, state, *rest):
+    state_cell = rest[0] if rest else None
+    out, hT, cT = rnn_forward(
+        attrs.mode,
+        data,
+        parameters,
+        state,
+        state_cell,
+        attrs.num_layers,
+        attrs.state_size,
+        bidirectional=attrs.bidirectional,
+        p_dropout=attrs.p,
+        train=_global.is_train(),
+    )
+    if attrs.mode == "lstm":
+        return (out, hT, cT) if attrs.state_outputs else out
+    return (out, hT) if attrs.state_outputs else out
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional=False):
+    """Total packed parameter count (mirrors reference rnn-inl.h GetParamSize)."""
+    ngates = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}[mode]
+    D = 2 if bidirectional else 1
+    H = state_size
+    size = 0
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else H * D
+        size += D * (ngates * H * in_size + ngates * H * H)
+    size += num_layers * D * 2 * ngates * H
+    return size
+
+
+@register(
+    "_rnn_param_concat",
+    params={"num_args": (int, 1), "dim": (int, 0)},
+    inputs=lambda attrs: ["arg%d" % i for i in range(attrs.get("num_args", 1))],
+)
+def rnn_param_concat(attrs, *xs):
+    return jnp.concatenate([x.reshape(-1) for x in xs], axis=0)

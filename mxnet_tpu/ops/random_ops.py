@@ -1,0 +1,187 @@
+"""Random sampling ops (reference `src/operator/random/sample_op.cc`,
+`multisample_op.cc`, `unique_sample_op.h`).
+
+Keys come from the global/traced RNG stream (see `mxnet_tpu/_global.py`):
+eager calls advance a process-global key; inside a jitted executor the key is
+an input to the compiled program, mirroring how the reference hands each op a
+per-op `kRandom`/`kParallelRandom` Resource (`src/resource.cc`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import _global
+from .registry import REQUIRED, register
+
+_SHAPE_PARAMS = {
+    "shape": (tuple, None),
+    "dtype": ("dtype", None),
+    "ctx": (str, ""),
+}
+
+
+def _shape_dtype(attrs):
+    return tuple(attrs.shape or ()), attrs.dtype or jnp.float32
+
+
+@register("_random_uniform", params={"low": (float, 0.0), "high": (float, 1.0), **_SHAPE_PARAMS}, inputs=())
+def random_uniform(attrs):
+    shape, dtype = _shape_dtype(attrs)
+    return jax.random.uniform(_global.next_key(), shape, dtype=dtype, minval=attrs.low, maxval=attrs.high)
+
+
+@register("_random_normal", params={"loc": (float, 0.0), "scale": (float, 1.0), **_SHAPE_PARAMS}, inputs=())
+def random_normal(attrs):
+    shape, dtype = _shape_dtype(attrs)
+    return attrs.loc + attrs.scale * jax.random.normal(_global.next_key(), shape, dtype=dtype)
+
+
+@register("_random_exponential", params={"lam": (float, 1.0), **_SHAPE_PARAMS}, inputs=())
+def random_exponential(attrs):
+    shape, dtype = _shape_dtype(attrs)
+    return jax.random.exponential(_global.next_key(), shape, dtype=dtype) / attrs.lam
+
+
+@register("_random_gamma", params={"alpha": (float, 1.0), "beta": (float, 1.0), **_SHAPE_PARAMS}, inputs=())
+def random_gamma(attrs):
+    shape, dtype = _shape_dtype(attrs)
+    return jax.random.gamma(_global.next_key(), attrs.alpha, shape, dtype=dtype) * attrs.beta
+
+
+@register("_random_poisson", params={"lam": (float, 1.0), **_SHAPE_PARAMS}, inputs=())
+def random_poisson(attrs):
+    shape, dtype = _shape_dtype(attrs)
+    return jax.random.poisson(_global.next_key(), attrs.lam, shape).astype(dtype)
+
+
+@register(
+    "_random_negative_binomial",
+    params={"k": (int, 1), "p": (float, 1.0), **_SHAPE_PARAMS},
+    inputs=(),
+)
+def random_negative_binomial(attrs):
+    shape, dtype = _shape_dtype(attrs)
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    lam = jax.random.gamma(_global.next_key(), attrs.k, shape) * ((1 - attrs.p) / attrs.p)
+    return jax.random.poisson(_global.next_key(), lam, shape).astype(dtype)
+
+
+@register(
+    "_random_generalized_negative_binomial",
+    params={"mu": (float, 1.0), "alpha": (float, 1.0), **_SHAPE_PARAMS},
+    inputs=(),
+)
+def random_gen_negative_binomial(attrs):
+    shape, dtype = _shape_dtype(attrs)
+    if attrs.alpha <= 0:
+        return jax.random.poisson(_global.next_key(), attrs.mu, shape).astype(dtype)
+    k = 1.0 / attrs.alpha
+    p = k / (k + attrs.mu)
+    lam = jax.random.gamma(_global.next_key(), k, shape) * ((1 - p) / p)
+    return jax.random.poisson(_global.next_key(), lam, shape).astype(dtype)
+
+
+@register("_random_randint", params={"low": (int, 0), "high": (int, REQUIRED), **_SHAPE_PARAMS}, inputs=())
+def random_randint(attrs):
+    shape, dtype = _shape_dtype(attrs)
+    if dtype == jnp.float32:
+        dtype = jnp.int32
+    return jax.random.randint(_global.next_key(), shape, attrs.low, attrs.high, dtype=dtype)
+
+
+# tensor-parameter multisample variants (reference multisample_op.cc):
+# sample one draw per row of the parameter tensors.
+
+
+def _multisample(sampler_inputs):
+    def deco(name, inputs, fn):
+        @register(name, params={"shape": (tuple, None), "dtype": ("dtype", None)}, inputs=inputs)
+        def _op(attrs, *params, _fn=fn):
+            shape = tuple(attrs.shape or ())
+            out_shape = params[0].shape + shape
+            return _fn(_global.next_key(), out_shape, attrs.dtype or jnp.float32, *[
+                p.reshape(p.shape + (1,) * len(shape)) for p in params
+            ])
+
+    return deco
+
+
+_ms = _multisample(None)
+_ms("_sample_uniform", ("low", "high"), lambda k, s, d, lo, hi: lo + (hi - lo) * jax.random.uniform(k, s, dtype=d))
+_ms("_sample_normal", ("mu", "sigma"), lambda k, s, d, mu, sg: mu + sg * jax.random.normal(k, s, dtype=d))
+_ms("_sample_exponential", ("lam",), lambda k, s, d, lam: jax.random.exponential(k, s, dtype=d) / lam)
+_ms("_sample_gamma", ("alpha", "beta"), lambda k, s, d, a, b: jax.random.gamma(k, a, s, dtype=d) * b)
+_ms("_sample_poisson", ("lam",), lambda k, s, d, lam: jax.random.poisson(k, lam, s).astype(d))
+_ms(
+    "_sample_negative_binomial",
+    ("k", "p"),
+    lambda key, s, d, k, p: jax.random.poisson(
+        key, jax.random.gamma(jax.random.fold_in(key, 1), k, s) * ((1 - p) / p), s
+    ).astype(d),
+)
+_ms(
+    "_sample_generalized_negative_binomial",
+    ("mu", "alpha"),
+    lambda key, s, d, mu, alpha: jax.random.poisson(
+        key,
+        jax.random.gamma(jax.random.fold_in(key, 1), 1.0 / jnp.maximum(alpha, 1e-12), s)
+        * (mu * alpha),
+        s,
+    ).astype(d),
+)
+
+
+@register(
+    "_sample_multinomial",
+    params={"shape": (tuple, None), "get_prob": (bool, False), "dtype": ("dtype", None)},
+    inputs=("data",),
+    num_outputs=lambda attrs: 2 if attrs.get("get_prob") else 1,
+)
+def sample_multinomial(attrs, data):
+    """data: (..., k) probabilities; draws `shape` samples per distribution."""
+    n = 1
+    for s in attrs.shape or (1,):
+        n *= s
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    flat = logits.reshape(-1, logits.shape[-1])
+    samples = jax.random.categorical(_global.next_key(), flat[:, None, :], axis=-1, shape=(flat.shape[0], n))
+    out_shape = data.shape[:-1] + tuple(attrs.shape or ())
+    samples = samples.reshape(out_shape if out_shape else (1,)).astype(attrs.dtype or jnp.int32)
+    if attrs.get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(flat, axis=-1),
+            samples.reshape(flat.shape[0], n).astype(jnp.int32),
+            axis=-1,
+        ).reshape(samples.shape)
+        return samples, lp
+    return samples
+
+
+@register("_shuffle", inputs=("data",))
+def shuffle(attrs, data):
+    """Shuffle along the first axis (reference _shuffle semantics)."""
+    idx = jax.random.permutation(_global.next_key(), data.shape[0])
+    return jnp.take(data, idx, axis=0)
+
+
+@register(
+    "_sample_unique_zipfian",
+    params={"range_max": (int, REQUIRED), "shape": (tuple, None)},
+    inputs=(),
+    num_outputs=2,
+)
+def sample_unique_zipfian(attrs):
+    """Approximate log-uniform (zipfian) candidate sampler used by sampled
+    softmax (reference unique_sample_op.h). Dedup is approximated by
+    rejection-free sampling; counts returned for expected-count correction."""
+    shape = tuple(attrs.shape or (1,))
+    n = 1
+    for s in shape:
+        n *= s
+    u = jax.random.uniform(_global.next_key(), (n,))
+    rng = attrs.range_max
+    samples = (jnp.exp(u * jnp.log(rng + 1.0)) - 1.0).astype(jnp.int64)
+    samples = jnp.clip(samples, 0, rng - 1)
+    counts = jnp.ones((n,), dtype=jnp.int64)
+    return samples.reshape(shape), counts.reshape(shape)

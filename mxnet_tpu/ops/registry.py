@@ -1,0 +1,175 @@
+"""Operator registry — the TPU-native counterpart of the reference's NNVM op
+registry (`NNVM_REGISTER_OP` + `FCompute`/`FInferShape` attributes, see
+reference `include/mxnet/op_attr_types.h:198-281`).
+
+Design: every op registers
+  * a ``fcompute(attrs, *inputs) -> output | tuple`` implemented with
+    jax.numpy / lax — traced eagerly for NDArray calls, and traced into one
+    XLA HloModule when invoked inside a jitted Symbol executor or CachedOp;
+  * a typed parameter spec (counterpart of dmlc::Parameter reflection) so
+    string attrs from MXNet-format symbol JSON round-trip losslessly;
+  * input argument names for Symbol composition (list_arguments parity).
+
+Gradients are NOT hand-registered per op: autograd uses jax.vjp over
+fcompute, which is exactly the whole-graph XLA gradient the reference
+builds via its nnvm Gradient pass (`src/executor/graph_executor.cc:231-295`).
+Ops needing custom backward semantics (e.g. SoftmaxOutput) wrap their
+fcompute in jax.custom_vjp themselves.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError, parser_for
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "AttrDict", "OP_REGISTRY"]
+
+OP_REGISTRY: Dict[str, "OpDef"] = {}
+
+
+class AttrDict(dict):
+    """Parsed op attributes with attribute access (`attrs.kernel`)."""
+
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError:
+            raise AttributeError(k)
+
+
+class OpDef:
+    """One registered operator.
+
+    Parameters
+    ----------
+    name : canonical MXNet-compatible op name (e.g. "FullyConnected").
+    fcompute : callable(attrs: AttrDict, *inputs) -> jnp array or tuple.
+    params : dict attr_name -> (type, default). type is one of
+        bool/int/float/tuple/str/'dtype' or a callable parser. default
+        ``REQUIRED`` marks mandatory attrs.
+    inputs : list of input names, or a callable(attrs)->list for ops whose
+        arity depends on attrs (e.g. Concat's num_args, no_bias).
+    num_outputs : int or callable(attrs)->int.
+    """
+
+    REQUIRED = object()
+
+    def __init__(
+        self,
+        name: str,
+        fcompute: Callable,
+        params: Optional[Dict[str, Tuple[Any, Any]]] = None,
+        inputs: Any = ("data",),
+        num_outputs: Any = 1,
+        aliases: Sequence[str] = (),
+        doc: str = "",
+    ):
+        self.name = name
+        self.fcompute = fcompute
+        self.params = params or {}
+        self._inputs = inputs
+        self._num_outputs = num_outputs
+        self.aliases = tuple(aliases)
+        self.doc = doc
+
+    # ------------------------------------------------------------------
+    def input_names(self, attrs: Optional[AttrDict] = None) -> List[str]:
+        if callable(self._inputs):
+            return list(self._inputs(attrs or self.parse_attrs({})))
+        return list(self._inputs)
+
+    def num_outputs(self, attrs: Optional[AttrDict] = None) -> int:
+        if callable(self._num_outputs):
+            return int(self._num_outputs(attrs or self.parse_attrs({})))
+        return int(self._num_outputs)
+
+    def parse_attrs(self, raw: Dict[str, Any]) -> AttrDict:
+        """Parse raw (possibly string-valued) attrs into typed values,
+        applying defaults and validating required fields."""
+        out = AttrDict()
+        for pname, (ptype, pdefault) in self.params.items():
+            if pname in raw:
+                v = raw[pname]
+                if isinstance(v, str) or ptype in (bool, int, float, tuple) or isinstance(ptype, str):
+                    out[pname] = parser_for(ptype)(v)
+                else:
+                    out[pname] = v
+            elif pdefault is OpDef.REQUIRED:
+                raise MXNetError(
+                    "op %s: required attribute %r missing" % (self.name, pname)
+                )
+            else:
+                out[pname] = pdefault
+        # keep unknown attrs verbatim (forward/JSON compat)
+        for k, v in raw.items():
+            if k not in out and not k.startswith("__"):
+                out[k] = v
+        return out
+
+    def serialize_attrs(self, attrs: Dict[str, Any]) -> Dict[str, str]:
+        """Stringify attrs for MXNet-format symbol JSON."""
+        out = {}
+        for k, v in attrs.items():
+            if k not in self.params:
+                continue
+            ptype, pdefault = self.params[k]
+            if v is None and pdefault is None:
+                continue
+            if ptype == "dtype" and v is not None:
+                from ..base import dtype_name
+
+                out[k] = dtype_name(v)
+            elif isinstance(v, (tuple, list)):
+                out[k] = "(" + ", ".join(str(int(x)) for x in v) + ")"
+            else:
+                out[k] = str(v)
+        return out
+
+    def __call__(self, attrs: AttrDict, *inputs):
+        return self.fcompute(attrs, *inputs)
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+def register(
+    name: str,
+    params: Optional[Dict[str, Tuple[Any, Any]]] = None,
+    inputs: Any = ("data",),
+    num_outputs: Any = 1,
+    aliases: Sequence[str] = (),
+):
+    """Decorator registering ``fcompute`` under ``name`` (+aliases)."""
+
+    def deco(fn: Callable) -> Callable:
+        opdef = OpDef(
+            name,
+            fn,
+            params=params,
+            inputs=inputs,
+            num_outputs=num_outputs,
+            aliases=aliases,
+            doc=fn.__doc__ or "",
+        )
+        if name in OP_REGISTRY:
+            raise MXNetError("op %r registered twice" % name)
+        OP_REGISTRY[name] = opdef
+        for a in aliases:
+            OP_REGISTRY.setdefault(a, opdef)
+        return fn
+
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    try:
+        return OP_REGISTRY[name]
+    except KeyError:
+        raise MXNetError("operator %r is not registered" % (name,))
+
+
+def list_ops() -> List[str]:
+    return sorted(OP_REGISTRY.keys())
+
+
+REQUIRED = OpDef.REQUIRED

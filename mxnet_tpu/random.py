@@ -1,0 +1,26 @@
+"""`mx.random` — global seeding (reference python/mxnet/random.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import _global
+from .ndarray import random as _ndrandom
+
+uniform = _ndrandom.uniform
+normal = _ndrandom.normal
+randn = _ndrandom.randn
+randint = _ndrandom.randint
+exponential = _ndrandom.exponential
+gamma = _ndrandom.gamma
+poisson = _ndrandom.poisson
+negative_binomial = _ndrandom.negative_binomial
+generalized_negative_binomial = _ndrandom.generalized_negative_binomial
+multinomial = _ndrandom.multinomial
+shuffle = _ndrandom.shuffle
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global RNG stream (reference mx.random.seed; per-ctx seeding
+    collapses to one stream because jax PRNG keys are device-agnostic)."""
+    _global.seed(seed_state)
+    np.random.seed(seed_state % (2**32))

@@ -1,0 +1,121 @@
+"""Test helpers (reference python/mxnet/test_utils.py): assert_almost_equal,
+check_numeric_gradient (finite differences vs autograd — the backbone of the
+reference's test_operator.py), rand_ndarray, check_consistency across
+contexts (the cpu-vs-tpu analogue of the reference's cpu-vs-gpu check)."""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from . import autograd
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, array
+
+__all__ = [
+    "assert_almost_equal",
+    "almost_equal",
+    "rand_ndarray",
+    "rand_shape_nd",
+    "check_numeric_gradient",
+    "check_consistency",
+    "same",
+    "default_context",
+]
+
+
+def default_context() -> Context:
+    return current_context()
+
+
+def same(a, b) -> bool:
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-8) -> bool:
+    return np.allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b")):
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol, err_msg="%s vs %s" % names)
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=np.float32, ctx=None):
+    data = np.random.uniform(-1, 1, size=shape).astype(dtype)
+    if stype == "default":
+        return array(data, ctx=ctx)
+    from .ndarray.sparse import cast_storage
+
+    if density is not None:
+        mask = np.random.uniform(0, 1, size=(shape[0],) + (1,) * (len(shape) - 1)) < density
+        data = data * mask
+    return cast_storage(array(data, ctx=ctx), stype)
+
+
+def numeric_grad(f: Callable[[List[np.ndarray]], np.ndarray], inputs: List[np.ndarray],
+                 eps=1e-4) -> List[np.ndarray]:
+    """Central finite differences of sum(f(inputs)) w.r.t. each input."""
+    grads = []
+    for i, x in enumerate(inputs):
+        g = np.zeros_like(x, dtype=np.float64)
+        flat = x.reshape(-1)
+        gflat = g.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fplus = float(np.sum(np.asarray(f(inputs), dtype=np.float64)))
+            flat[j] = orig - eps
+            fminus = float(np.sum(np.asarray(f(inputs), dtype=np.float64)))
+            flat[j] = orig
+            gflat[j] = (fplus - fminus) / (2 * eps)
+        grads.append(g.astype(x.dtype))
+    return grads
+
+
+def check_numeric_gradient(fn: Callable, inputs: Sequence[np.ndarray],
+                           eps=1e-3, rtol=1e-2, atol=1e-4, ctx=None):
+    """Compare autograd gradients of `fn` (NDArray -> NDArray) against finite
+    differences (reference test_utils.check_numeric_gradient)."""
+    nd_inputs = [array(x.astype(np.float64) if False else x, ctx=ctx) for x in inputs]
+    for nd in nd_inputs:
+        nd.attach_grad()
+    with autograd.record():
+        out = fn(*nd_inputs)
+        loss = out.sum() if isinstance(out, NDArray) else sum(o.sum() for o in out)
+    loss.backward()
+    analytic = [nd.grad.asnumpy() for nd in nd_inputs]
+
+    def np_f(xs):
+        nds = [array(x, ctx=ctx) for x in xs]
+        o = fn(*nds)
+        return o.asnumpy() if isinstance(o, NDArray) else np.concatenate([v.asnumpy().reshape(-1) for v in o])
+
+    numeric = numeric_grad(np_f, [x.copy() for x in inputs], eps=eps)
+    for i, (a, n) in enumerate(zip(analytic, numeric)):
+        np.testing.assert_allclose(a, n, rtol=rtol, atol=atol,
+                                   err_msg="gradient mismatch for input %d" % i)
+
+
+def check_consistency(fn: Callable, inputs: Sequence[np.ndarray], ctx_list: Sequence[Context],
+                      rtol=1e-4, atol=1e-5):
+    """Run `fn` under each context and compare outputs (reference
+    check_consistency, cpu-vs-gpu -> cpu-vs-tpu)."""
+    outs = []
+    for ctx in ctx_list:
+        with ctx:
+            nds = [array(x, ctx=ctx) for x in inputs]
+            o = fn(*nds)
+            outs.append(o.asnumpy() if isinstance(o, NDArray) else [v.asnumpy() for v in o])
+    ref = outs[0]
+    for o in outs[1:]:
+        if isinstance(ref, list):
+            for r, v in zip(ref, o):
+                np.testing.assert_allclose(r, v, rtol=rtol, atol=atol)
+        else:
+            np.testing.assert_allclose(ref, o, rtol=rtol, atol=atol)

@@ -1,0 +1,25 @@
+"""Test config: run the suite on a virtual 8-device CPU mesh so multi-chip
+sharding paths are exercised without TPU hardware (the analogue of the
+reference's `--launcher local` single-host distributed tests, SURVEY.md §4.2).
+Must set env before jax initializes."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    """Seeded determinism per test (reference tests/python/unittest/common.py
+    @with_seed): failures are reproducible."""
+    import mxnet_tpu as mx
+
+    seed = np.random.randint(0, 2**31 - 1)
+    mx.random.seed(seed)
+    yield
+    # seed printed by pytest on failure via -l; keep quiet otherwise

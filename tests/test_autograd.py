@@ -1,0 +1,178 @@
+"""Autograd tests (modeled on reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_simple_grad():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, [2, 4, 6])
+
+
+def test_chain_rule():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y * x  # x^3
+    z.backward()
+    assert_almost_equal(x.grad, [12.0])  # 3x^2
+
+
+def test_multi_input():
+    a = mx.nd.array([1.0, 2.0])
+    b = mx.nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b).sum()
+    c.backward()
+    assert_almost_equal(a.grad, [3, 4])
+    assert_almost_equal(b.grad, [1, 2])
+
+
+def test_grad_req_add():
+    x = mx.nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    assert_almost_equal(x.grad, [6.0])
+
+
+def test_grad_req_write_overwrites():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    assert_almost_equal(x.grad, [2.0])
+
+
+def test_diamond_accumulation():
+    # two paths to the same leaf must sum inside one backward
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2 + x * 5
+    y.backward()
+    assert_almost_equal(x.grad, [7.0])
+
+
+def test_detach():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, [4.0])  # only d(z)/dx via second factor
+
+
+def test_pause():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        with autograd.pause():
+            y = x * x
+        z = x * 3
+    z.backward()
+    assert_almost_equal(x.grad, [3.0])
+    assert y._entry is None
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_head_grad():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(mx.nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, [20.0, 200.0])
+
+
+def test_grad_function():
+    x = mx.nd.array([1.0, 2.0])
+    with autograd.record():
+        x.attach_grad()
+        y = (x * x * x).sum()
+    g = autograd.grad(y, [x])
+    assert_almost_equal(g[0], [3.0, 12.0])
+
+
+def test_mark_variables():
+    x = mx.nd.array([2.0])
+    g = mx.nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * 4
+    y.backward()
+    assert_almost_equal(x.grad, [4.0])
+
+
+def test_backward_through_ops():
+    check_numeric_gradient(lambda x: mx.nd.tanh(x), [np.random.uniform(-1, 1, (3, 4)).astype(np.float32)])
+    check_numeric_gradient(lambda x: mx.nd.sigmoid(x), [np.random.uniform(-1, 1, (3, 4)).astype(np.float32)])
+    check_numeric_gradient(
+        lambda a, b: mx.nd.dot(a, b),
+        [np.random.uniform(-1, 1, (3, 4)).astype(np.float32), np.random.uniform(-1, 1, (4, 2)).astype(np.float32)],
+    )
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = mx.nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = mx.nd.array(np.random.uniform(-1, 1, (3,)).astype(np.float32))
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    xn = x.asnumpy()
+    s = 1 / (1 + np.exp(-xn))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-4, atol=1e-5)
+
+
+def test_no_record_no_graph():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    y = x * 2  # not recorded
+    assert y._entry is None
+
+
+def test_inplace_on_leaf_inside_record():
+    # regression: += on a grad-attached leaf must not orphan the gradient
+    x = mx.nd.array([1.0, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        x += 1
+        y = (x * 2).sum()
+    y.backward()
+    assert_almost_equal(x.grad, [2.0, 2.0])

@@ -1,0 +1,186 @@
+"""NDArray API tests (modeled on reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = mx.nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    b = mx.nd.ones((4,), dtype="int32")
+    assert b.asnumpy().tolist() == [1, 1, 1, 1]
+    c = mx.nd.full((2, 2), 7.0)
+    assert c.asnumpy().sum() == 28
+    d = mx.nd.arange(0, 10, 2)
+    assert d.asnumpy().tolist() == [0, 2, 4, 6, 8]
+    e = mx.nd.array([[1, 2], [3, 4]])
+    assert e.shape == (2, 2)
+    assert mx.nd.eye(3).asnumpy().trace() == 3
+
+
+def test_arithmetic():
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = mx.nd.array([[10.0, 20.0], [30.0, 40.0]])
+    assert_almost_equal(a + b, [[11, 22], [33, 44]])
+    assert_almost_equal(b - a, [[9, 18], [27, 36]])
+    assert_almost_equal(a * 2, [[2, 4], [6, 8]])
+    assert_almost_equal(2 * a, [[2, 4], [6, 8]])
+    assert_almost_equal(1 / a, [[1, 0.5], [1 / 3, 0.25]], rtol=1e-6)
+    assert_almost_equal(a ** 2, [[1, 4], [9, 16]])
+    assert_almost_equal(-a, [[-1, -2], [-3, -4]])
+    assert_almost_equal(10 - a, [[9, 8], [7, 6]])
+    assert_almost_equal((a > 2), [[0, 0], [1, 1]])
+    assert_almost_equal((a == 2), [[0, 1], [0, 0]])
+
+
+def test_inplace():
+    a = mx.nd.ones((2, 2))
+    a += 1
+    assert_almost_equal(a, [[2, 2], [2, 2]])
+    a *= 3
+    assert_almost_equal(a, [[6, 6], [6, 6]])
+
+
+def test_broadcast():
+    a = mx.nd.ones((2, 1, 3))
+    b = mx.nd.ones((1, 4, 3))
+    assert (a + b).shape == (2, 4, 3)
+    c = mx.nd.ones((2, 3)).broadcast_to((4, 2, 3))
+    assert c.shape == (4, 2, 3)
+
+
+def test_shape_ops():
+    a = mx.nd.arange(0, 24).reshape((2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1, 4)).shape == (6, 4)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.transpose().shape == (4, 3, 2)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert a.flatten().shape == (2, 12)
+    assert a.expand_dims(0).shape == (1, 2, 3, 4)
+    assert a.swapaxes(0, 2).shape == (4, 3, 2)
+    assert mx.nd.tile(a, reps=(2, 1, 1)).shape == (4, 3, 4)
+    parts = a.split(3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+    st = mx.nd.stack(mx.nd.ones((2,)), mx.nd.zeros((2,)), axis=0)
+    assert st.shape == (2, 2)
+    cc = mx.nd.concat(mx.nd.ones((2, 3)), mx.nd.zeros((2, 2)), dim=1)
+    assert cc.shape == (2, 5)
+
+
+def test_slicing():
+    a = mx.nd.arange(0, 24).reshape((4, 6))
+    assert_almost_equal(a[1], np.arange(6, 12))
+    assert_almost_equal(a[1:3], np.arange(6, 18).reshape(2, 6))
+    assert a.slice(begin=(1, 2), end=(3, 5)).shape == (2, 3)
+    assert a.slice_axis(axis=1, begin=0, end=3).shape == (4, 3)
+    a[0] = 100.0
+    assert a.asnumpy()[0].tolist() == [100.0] * 6
+    a[1, 2] = -1.0
+    assert a.asnumpy()[1, 2] == -1.0
+
+
+def test_reductions():
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    assert a.sum().asscalar() == 10
+    assert_almost_equal(a.sum(axis=0), [4, 6])
+    assert_almost_equal(a.sum(axis=1, keepdims=True), [[3], [7]])
+    assert a.mean().asscalar() == 2.5
+    assert a.max().asscalar() == 4
+    assert a.min().asscalar() == 1
+    assert a.prod().asscalar() == 24
+    assert float(a.norm().asscalar()) == pytest.approx(np.sqrt(30), rel=1e-5)
+    assert a.argmax(axis=1).asnumpy().tolist() == [1, 1]
+
+
+def test_dot():
+    a = mx.nd.array(np.random.randn(3, 4).astype(np.float32))
+    b = mx.nd.array(np.random.randn(4, 5).astype(np.float32))
+    assert_almost_equal(mx.nd.dot(a, b), a.asnumpy() @ b.asnumpy(), rtol=1e-4, atol=1e-5)
+    # transpose flags
+    assert_almost_equal(
+        mx.nd.dot(a, b.T, transpose_b=True), a.asnumpy() @ b.asnumpy(), rtol=1e-4, atol=1e-5
+    )
+    # batch_dot
+    x = mx.nd.array(np.random.randn(2, 3, 4).astype(np.float32))
+    y = mx.nd.array(np.random.randn(2, 4, 5).astype(np.float32))
+    assert_almost_equal(mx.nd.batch_dot(x, y), x.asnumpy() @ y.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_indexing_ops():
+    w = mx.nd.arange(0, 12).reshape((4, 3))
+    idx = mx.nd.array([0, 2])
+    assert_almost_equal(mx.nd.take(w, idx), w.asnumpy()[[0, 2]])
+    emb = mx.nd.Embedding(idx, w, input_dim=4, output_dim=3)
+    assert_almost_equal(emb, w.asnumpy()[[0, 2]])
+    oh = mx.nd.one_hot(mx.nd.array([0, 2]), depth=3)
+    assert_almost_equal(oh, [[1, 0, 0], [0, 0, 1]])
+    x = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    picked = mx.nd.pick(x, mx.nd.array([0, 1]), axis=1)
+    assert_almost_equal(picked, [1, 4])
+
+
+def test_ordering():
+    a = mx.nd.array([[3.0, 1.0, 2.0]])
+    assert mx.nd.topk(a, k=2).asnumpy().tolist() == [[0, 2]]
+    assert mx.nd.sort(a).asnumpy().tolist() == [[1, 2, 3]]
+    assert mx.nd.argsort(a).asnumpy().tolist() == [[1, 2, 0]]
+    both = mx.nd.topk(a, k=2, ret_typ="both")
+    assert both[0].asnumpy().tolist() == [[3, 2]]
+
+
+def test_astype_cast():
+    a = mx.nd.array([1.5, 2.5])
+    assert a.astype("int32").asnumpy().tolist() == [1, 2]
+    assert a.astype(np.float16).dtype == np.float16
+
+
+def test_context_placement():
+    a = mx.nd.ones((2, 2), ctx=mx.cpu())
+    assert a.context.device_type == "cpu"
+    b = a.as_in_context(mx.cpu(0))
+    assert b is a
+    c = a.copyto(mx.cpu(0))
+    assert c is not a
+
+
+def test_scalar_conversions():
+    assert float(mx.nd.array([3.5])) == 3.5
+    assert int(mx.nd.array([3])) == 3
+    assert mx.nd.array([2.0]).asscalar() == 2.0
+    with pytest.raises(Exception):
+        mx.nd.ones((2,)).asscalar()
+
+
+def test_where_clip_misc():
+    cond = mx.nd.array([1.0, 0.0])
+    x = mx.nd.array([1.0, 2.0])
+    y = mx.nd.array([10.0, 20.0])
+    assert_almost_equal(mx.nd.where(cond, x, y), [1, 20])
+    assert_almost_equal(mx.nd.clip(y, 0, 15), [10, 15])
+    assert_almost_equal(mx.nd.abs(mx.nd.array([-1.0, 2.0])), [1, 2])
+
+
+def test_sparse_roundtrip():
+    dense = np.array([[0, 0], [1, 2], [0, 0], [3, 0]], dtype=np.float32)
+    rsp = mx.nd.array(dense).tostype("row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert rsp.indices.asnumpy().tolist() == [1, 3]
+    assert_almost_equal(rsp.tostype("default"), dense)
+    csr = mx.nd.array(dense).tostype("csr")
+    assert csr.stype == "csr"
+    assert_almost_equal(csr.tostype("default"), dense)
+
+
+def test_random_basic():
+    mx.random.seed(42)
+    u1 = mx.nd.random.uniform(0, 1, shape=(100,)).asnumpy()
+    mx.random.seed(42)
+    u2 = mx.nd.random.uniform(0, 1, shape=(100,)).asnumpy()
+    assert np.allclose(u1, u2)
+    assert (u1 >= 0).all() and (u1 < 1).all()
+    n = mx.nd.random.normal(0, 1, shape=(1000,)).asnumpy()
+    assert abs(n.mean()) < 0.2

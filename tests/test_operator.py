@@ -1,0 +1,340 @@
+"""Operator correctness tests vs numpy + finite-difference gradients
+(modeled on reference tests/python/unittest/test_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def _rnd(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+# ---------------------------------------------------------------- elemwise
+def test_unary_math_vs_numpy():
+    x = np.random.uniform(0.1, 2.0, (3, 4)).astype(np.float32)
+    nd = mx.nd.array(x)
+    for name, npf in [
+        ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt), ("square", np.square),
+        ("abs", np.abs), ("floor", np.floor), ("ceil", np.ceil),
+        ("sin", np.sin), ("cos", np.cos), ("tanh", np.tanh),
+        ("log1p", np.log1p), ("expm1", np.expm1), ("rsqrt", lambda v: 1 / np.sqrt(v)),
+        ("reciprocal", lambda v: 1 / v), ("cbrt", np.cbrt),
+    ]:
+        assert_almost_equal(getattr(mx.nd, name)(nd), npf(x), rtol=1e-4, atol=1e-5, names=(name, "np"))
+
+
+def test_activation_ops():
+    x = _rnd(4, 5)
+    nd = mx.nd.array(x)
+    assert_almost_equal(mx.nd.relu(nd), np.maximum(x, 0))
+    assert_almost_equal(mx.nd.Activation(nd, act_type="relu"), np.maximum(x, 0))
+    assert_almost_equal(mx.nd.Activation(nd, act_type="sigmoid"), 1 / (1 + np.exp(-x)), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(mx.nd.LeakyReLU(nd, act_type="leaky", slope=0.1), np.where(x > 0, x, 0.1 * x))
+    elu = mx.nd.LeakyReLU(nd, act_type="elu", slope=1.0)
+    assert_almost_equal(elu, np.where(x > 0, x, np.expm1(x)), rtol=1e-5, atol=1e-6)
+
+
+def test_fully_connected():
+    x, w, b = _rnd(5, 3), _rnd(4, 3), _rnd(4)
+    out = mx.nd.FullyConnected(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b), num_hidden=4)
+    assert_almost_equal(out, x @ w.T + b, rtol=1e-4, atol=1e-5)
+    out2 = mx.nd.FullyConnected(mx.nd.array(x), mx.nd.array(w), num_hidden=4, no_bias=True)
+    assert_almost_equal(out2, x @ w.T, rtol=1e-4, atol=1e-5)
+    # 4D input flattens
+    x4 = _rnd(2, 3, 2, 2)
+    w4 = _rnd(4, 12)
+    out3 = mx.nd.FullyConnected(mx.nd.array(x4), mx.nd.array(w4), num_hidden=4, no_bias=True)
+    assert_almost_equal(out3, x4.reshape(2, -1) @ w4.T, rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(
+        lambda a, ww: mx.nd.FullyConnected(a, ww, num_hidden=4, no_bias=True),
+        [_rnd(3, 3), _rnd(4, 3)],
+    )
+
+
+def test_convolution_vs_naive():
+    # compare against explicit correlation
+    x = _rnd(1, 2, 5, 5)
+    w = _rnd(3, 2, 3, 3)
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3), num_filter=3, no_bias=True)
+    ref = np.zeros((1, 3, 3, 3), dtype=np.float32)
+    for o in range(3):
+        for i in range(3):
+            for j in range(3):
+                ref[0, o, i, j] = np.sum(x[0, :, i:i + 3, j:j + 3] * w[o])
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_convolution_grad():
+    check_numeric_gradient(
+        lambda d, w: mx.nd.Convolution(d, w, kernel=(3, 3), num_filter=2, pad=(1, 1), no_bias=True),
+        [_rnd(1, 2, 4, 4), _rnd(2, 2, 3, 3)],
+        rtol=2e-2, atol=1e-3,
+    )
+
+
+def test_conv_stride_pad_group():
+    x = _rnd(2, 4, 8, 8)
+    w = _rnd(6, 2, 3, 3)
+    out = mx.nd.Convolution(
+        mx.nd.array(x), mx.nd.array(w), kernel=(3, 3), num_filter=6, stride=(2, 2), pad=(1, 1), num_group=2, no_bias=True
+    )
+    assert out.shape == (2, 6, 4, 4)
+
+
+def test_deconvolution():
+    x = _rnd(1, 2, 4, 4)
+    w = _rnd(2, 3, 3, 3)  # (in, out, kh, kw)
+    out = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3), num_filter=3, stride=(2, 2))
+    assert out.shape == (1, 3, 9, 9)
+    # deconv is adjoint of conv: <conv(y), x> == <deconv(x), y>.
+    # The deconv weight (in=2, out=3, kh, kw) is exactly the weight of the
+    # adjoint conv (1,3,9,9)->(1,2,4,4) whose layout is (out=2, in=3, kh, kw).
+    y = _rnd(1, 3, 9, 9)
+    conv = mx.nd.Convolution(mx.nd.array(y), mx.nd.array(w),
+                             kernel=(3, 3), num_filter=2, stride=(2, 2), no_bias=True)
+    lhs = float((conv.asnumpy() * x).sum())
+    rhs = float((out.asnumpy() * y).sum())
+    assert lhs == pytest.approx(rhs, rel=1e-3)
+
+
+def test_pooling():
+    x = _rnd(2, 3, 6, 6)
+    mxp = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert mxp.shape == (2, 3, 3, 3)
+    ref = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+    assert_almost_equal(mxp, ref)
+    avg = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    assert_almost_equal(avg, x.reshape(2, 3, 3, 2, 3, 2).mean(axis=(3, 5)), rtol=1e-5, atol=1e-6)
+    gp = mx.nd.Pooling(mx.nd.array(x), global_pool=True, pool_type="max")
+    assert gp.shape == (2, 3, 1, 1)
+    assert_almost_equal(gp.asnumpy().reshape(2, 3), x.max(axis=(2, 3)))
+
+
+def test_batchnorm_train_eval():
+    x = _rnd(4, 3, 5, 5)
+    gamma, beta = np.ones(3, np.float32), np.zeros(3, np.float32)
+    mm, mv = np.zeros(3, np.float32), np.ones(3, np.float32)
+    args = [mx.nd.array(v) for v in (x, gamma, beta, mm, mv)]
+    with mx.autograd.record():  # train mode: use batch stats
+        out = mx.nd.BatchNorm(*args, fix_gamma=False, eps=1e-5)[0]
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    ref = (x - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + 1e-5)
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-4)
+    # eval mode: use moving stats
+    out_eval = mx.nd.BatchNorm(*args, fix_gamma=False, eps=1e-5)[0]
+    assert_almost_equal(out_eval, x / np.sqrt(1 + 1e-5), rtol=1e-4, atol=1e-5)
+
+
+def test_layernorm():
+    x = _rnd(4, 10)
+    g, b = np.random.rand(10).astype(np.float32), _rnd(10)
+    out = mx.nd.LayerNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b), eps=1e-5)[0]
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mean) / np.sqrt(var + 1e-5) * g + b
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_family():
+    x = _rnd(3, 5)
+    nd = mx.nd.array(x)
+    sm = mx.nd.softmax(nd).asnumpy()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    assert_almost_equal(sm, e / e.sum(-1, keepdims=True), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(mx.nd.log_softmax(nd), np.log(e / e.sum(-1, keepdims=True)), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(mx.nd.softmin(nd).asnumpy().sum(-1), np.ones(3), rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_output_grad():
+    x = _rnd(4, 5)
+    label = np.array([0, 2, 1, 4], dtype=np.float32)
+    nd = mx.nd.array(x)
+    nd.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.SoftmaxOutput(nd, mx.nd.array(label))
+    out.backward()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    prob = e / e.sum(-1, keepdims=True)
+    onehot = np.eye(5, dtype=np.float32)[label.astype(int)]
+    assert_almost_equal(nd.grad, prob - onehot, rtol=1e-4, atol=1e-5)
+
+
+def test_dropout():
+    x = mx.nd.ones((100, 100))
+    with mx.autograd.record():
+        y = mx.nd.Dropout(x, p=0.5)
+    kept = (y.asnumpy() != 0).mean()
+    assert 0.4 < kept < 0.6
+    assert_almost_equal(y.asnumpy()[y.asnumpy() != 0], 2.0 * np.ones((y.asnumpy() != 0).sum()))
+    # eval mode: identity
+    y_eval = mx.nd.Dropout(x, p=0.5)
+    assert_almost_equal(y_eval, x.asnumpy())
+
+
+def test_reshape_special_codes():
+    a = mx.nd.zeros((2, 3, 4))
+    assert mx.nd.Reshape(a, shape=(0, -1)).shape == (2, 12)
+    assert mx.nd.Reshape(a, shape=(-2,)).shape == (2, 3, 4)
+    assert mx.nd.Reshape(a, shape=(-3, 0)).shape == (6, 4)
+    assert mx.nd.Reshape(a, shape=(0, 0, -4, 2, 2)).shape == (2, 3, 2, 2)
+
+
+def test_embedding_grad_dense():
+    w = _rnd(10, 4)
+    idx = np.array([1, 3, 1], dtype=np.float32)
+    wnd = mx.nd.array(w)
+    wnd.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.Embedding(mx.nd.array(idx), wnd, input_dim=10, output_dim=4)
+        loss = out.sum()
+    loss.backward()
+    ref = np.zeros_like(w)
+    for i in idx.astype(int):
+        ref[i] += 1
+    assert_almost_equal(wnd.grad, ref)
+
+
+def test_rnn_lstm_shapes():
+    T, B, I, H, L = 5, 3, 4, 6, 2
+    from mxnet_tpu.ops.nn import rnn_param_size
+
+    psize = rnn_param_size("lstm", I, H, L)
+    params = mx.nd.array(np.random.uniform(-0.1, 0.1, (psize,)).astype(np.float32))
+    data = mx.nd.array(_rnd(T, B, I))
+    h0 = mx.nd.zeros((L, B, H))
+    c0 = mx.nd.zeros((L, B, H))
+    out = mx.nd.RNN(data, params, h0, c0, state_size=H, num_layers=L, mode="lstm", state_outputs=True)
+    assert out[0].shape == (T, B, H)
+    assert out[1].shape == (L, B, H)
+    assert out[2].shape == (L, B, H)
+
+
+def test_rnn_gru_bidirectional():
+    T, B, I, H = 4, 2, 3, 5
+    from mxnet_tpu.ops.nn import rnn_param_size
+
+    psize = rnn_param_size("gru", I, H, 1, bidirectional=True)
+    params = mx.nd.array(np.random.uniform(-0.1, 0.1, (psize,)).astype(np.float32))
+    out = mx.nd.RNN(
+        mx.nd.array(_rnd(T, B, I)), params, mx.nd.zeros((2, B, H)),
+        state_size=H, num_layers=1, mode="gru", bidirectional=True,
+    )
+    assert out.shape == (T, B, 2 * H)
+
+
+def test_sequence_ops():
+    data = mx.nd.array(np.arange(24, dtype=np.float32).reshape(4, 2, 3))
+    seq_len = mx.nd.array([2, 4])
+    masked = mx.nd.SequenceMask(data, seq_len, use_sequence_length=True, value=-1.0)
+    mn = masked.asnumpy()
+    assert (mn[2:, 0] == -1).all() and (mn[:, 1] != -1).all()
+    last = mx.nd.SequenceLast(data, seq_len, use_sequence_length=True)
+    assert_almost_equal(last, data.asnumpy()[[1, 3], [0, 1]])
+    rev = mx.nd.SequenceReverse(data, seq_len, use_sequence_length=True)
+    assert_almost_equal(rev.asnumpy()[0, 0], data.asnumpy()[1, 0])
+
+
+def test_linalg_ops():
+    a = _rnd(3, 4)
+    b = _rnd(4, 5)
+    c = _rnd(3, 5)
+    out = mx.nd.linalg_gemm(mx.nd.array(a), mx.nd.array(b), mx.nd.array(c), alpha=2.0, beta=0.5)
+    assert_almost_equal(out, 2 * (a @ b) + 0.5 * c, rtol=1e-4, atol=1e-5)
+    spd = np.eye(4, dtype=np.float32) * 3 + 0.1
+    L = mx.nd.linalg_potrf(mx.nd.array(spd))
+    assert_almost_equal(L.asnumpy() @ L.asnumpy().T, spd, rtol=1e-4, atol=1e-4)
+    sld = mx.nd.linalg_sumlogdiag(mx.nd.array(np.eye(3, dtype=np.float32) * 2))
+    assert float(sld.asscalar()) == pytest.approx(3 * np.log(2), rel=1e-4)
+
+
+def test_regression_outputs():
+    x = _rnd(4, 3)
+    label = _rnd(4, 3)
+    nd = mx.nd.array(x)
+    nd.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.LinearRegressionOutput(nd, mx.nd.array(label))
+    out.backward()
+    assert_almost_equal(out, x)
+    assert_almost_equal(nd.grad, (x - label) / 3, rtol=1e-4, atol=1e-5)
+
+
+def test_grad_of_grad_ops():
+    # numeric gradient checks across a sample of op families
+    check_numeric_gradient(lambda x: mx.nd.softmax(x), [_rnd(3, 4)])
+    check_numeric_gradient(lambda x: mx.nd.LayerNorm(x, mx.nd.ones((4,)), mx.nd.zeros((4,)))[0], [_rnd(3, 4)], rtol=2e-2, atol=1e-3)
+    check_numeric_gradient(lambda x: mx.nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg"), [_rnd(1, 2, 4, 4)])
+    check_numeric_gradient(lambda x: mx.nd.sum(x, axis=1), [_rnd(3, 4)])
+    mult = mx.nd.array(_rnd(1, 4))
+    check_numeric_gradient(lambda x: mx.nd.broadcast_mul(x, mult), [_rnd(3, 4)])
+
+
+def test_random_samplers():
+    g = mx.nd.random.gamma(2.0, 2.0, shape=(2000,)).asnumpy()
+    assert g.mean() == pytest.approx(4.0, rel=0.2)
+    p = mx.nd.random.poisson(3.0, shape=(2000,)).asnumpy()
+    assert p.mean() == pytest.approx(3.0, rel=0.2)
+    m = mx.nd.random.multinomial(mx.nd.array([[0.0, 0.0, 1.0]]), shape=(50,)).asnumpy()
+    assert (m == 2).all()
+    s = mx.nd.random.shuffle(mx.nd.arange(0, 10))
+    assert sorted(s.asnumpy().tolist()) == list(range(10))
+
+
+def test_dot_ndim_and_transpose():
+    a = mx.nd.ones((3, 4, 5))
+    b = mx.nd.ones((5, 6))
+    assert mx.nd.dot(a, b).shape == (3, 4, 6)
+    x = _rnd(4, 3)
+    y = _rnd(4, 5)
+    assert_almost_equal(mx.nd.dot(mx.nd.array(x), mx.nd.array(y), transpose_a=True),
+                        x.T @ y, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_output_label_smoothing():
+    x = _rnd(2, 4)
+    label = np.array([1, 3], dtype=np.float32)
+    nd = mx.nd.array(x)
+    nd.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.SoftmaxOutput(nd, mx.nd.array(label), smooth_alpha=0.1)
+    out.backward()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    prob = e / e.sum(-1, keepdims=True)
+    onehot = np.eye(4, dtype=np.float32)[label.astype(int)]
+    target = onehot * 0.9 + (1 - onehot) * (0.1 / 3)
+    assert_almost_equal(nd.grad, prob - target, rtol=1e-4, atol=1e-5)
+
+
+def test_svm_output_grad():
+    x = np.array([[0.5, -2.0, 3.0]], dtype=np.float32)
+    label = np.array([0], dtype=np.float32)
+    nd = mx.nd.array(x)
+    nd.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.SVMOutput(nd, mx.nd.array(label), margin=1.0, use_linear=True)
+    out.backward()
+    # L1 SVM: target col 0: -(1 > 0.5) = -1; col1: (1 > 2.0)=0; col2: (1 > -3)=1
+    assert_almost_equal(nd.grad, [[-1.0, 0.0, 1.0]])
+    nd.grad[:] = 0
+    with mx.autograd.record():
+        out = mx.nd.SVMOutput(nd, mx.nd.array(label), margin=1.0)
+    out.backward()
+    # L2: col0: -2*max(0,1-0.5)=-1; col1: 2*max(0,1-2)=0; col2: 2*max(0,1+3)=8
+    assert_almost_equal(nd.grad, [[-1.0, 0.0, 8.0]])
+
+
+def test_makediag_offset():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    d = mx.nd.linalg_makediag(x, offset=1)
+    assert d.shape == (4, 4)
+    assert_almost_equal(mx.nd.linalg_extractdiag(d, offset=1), [1, 2, 3])
+    d0 = mx.nd.linalg_makediag(x)
+    assert_almost_equal(d0, np.diag([1.0, 2.0, 3.0]))
+
+
+def test_random_ctx_honored():
+    u = mx.nd.random.uniform(0, 1, shape=(2,), ctx=mx.cpu())
+    assert u.context.device_type == "cpu"
