@@ -24,6 +24,7 @@ from . import autograd
 from . import random
 
 from .ndarray import NDArray
+from . import name
 
 # Subsystems below land in build order (SURVEY.md §7.2); each import is
 # guarded so the core stays usable while the surface grows.

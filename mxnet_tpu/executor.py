@@ -1,0 +1,222 @@
+"""Executor: whole-graph compiled execution of a Symbol.
+
+Re-design of the reference GraphExecutor (`src/executor/graph_executor.cc`)
+and its Python wrapper (`python/mxnet/executor.py`). Where the reference
+interprets the nnvm graph node-by-node through the dependency engine, this
+executor lowers the ENTIRE forward graph — and, for training, the fused
+forward+backward via jax.vjp — into single jitted XLA HloModules
+(SURVEY.md §7.1 north star). Memory planning (PlanMemory pass,
+graph_executor.cc:636) is delegated to XLA's buffer assignment.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from . import _global
+from .base import MXNetError
+from .context import Context, current_context
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Executor"]
+
+
+class Executor(object):
+    """Bound computation graph (reference executor.py:45).
+
+    Parameters mirror ``Symbol.bind``: ``args``/``args_grad``/``aux_states``
+    are dicts or lists of NDArrays in ``list_arguments()`` order.
+    """
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else current_context()
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+
+        self.arg_dict = self._as_dict(args, self.arg_names, "args")
+        self.arg_arrays = [self.arg_dict[n] for n in self.arg_names]
+        if args_grad is None:
+            self.grad_dict = {}
+        else:
+            self.grad_dict = self._as_dict(args_grad, self.arg_names, "args_grad",
+                                           allow_missing=True)
+        self.grad_arrays = [self.grad_dict.get(n) for n in self.arg_names]
+        self.aux_dict = self._as_dict(aux_states or {}, self.aux_names, "aux_states")
+        self.aux_arrays = [self.aux_dict[n] for n in self.aux_names]
+
+        if isinstance(grad_req, str):
+            self.grad_req = {n: grad_req for n in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(self.arg_names, grad_req))
+        else:
+            self.grad_req = dict(grad_req)
+
+        self.outputs: List[NDArray] = []
+        self._monitor_callback = None
+        self._fwd_cache: Dict[bool, Any] = {}
+        self._vjp_fn = None
+        self._output_shapes = None
+
+    @staticmethod
+    def _as_dict(vals, names, what, allow_missing=False):
+        if isinstance(vals, dict):
+            missing = [n for n in names if n not in vals]
+            if missing and not allow_missing:
+                raise MXNetError("%s: missing bindings for %s" % (what, missing))
+            return {n: vals[n] for n in names if n in vals}
+        vals = list(vals)
+        if len(vals) != len(names):
+            raise MXNetError(
+                "%s: expected %d arrays, got %d" % (what, len(names), len(vals)))
+        return dict(zip(names, vals))
+
+    # ------------------------------------------------------------------
+    def _graph_fn(self, is_train):
+        """Jitted (arg_vals, aux_vals, rng) -> (outputs, aux_updates)."""
+        if is_train in self._fwd_cache:
+            return self._fwd_cache[is_train]
+        sym = self._symbol
+
+        def fn(arg_vals, aux_vals, rng):
+            prev = _global.set_train(is_train)
+            _global.push_rng_key(rng)
+            try:
+                vm = dict(arg_vals)
+                vm.update(aux_vals)
+                aux_updates = {}
+                outs = sym.eval_jax(vm, aux_updates=aux_updates)
+            finally:
+                _global.pop_rng_key()
+                _global.set_train(prev)
+            return tuple(outs), aux_updates
+
+        jit_fn = jax.jit(fn)
+        self._fwd_cache[is_train] = jit_fn
+        return jit_fn
+
+    def forward(self, is_train=False, **kwargs):
+        """Run forward (reference executor.py:114). kwargs update arg data."""
+        for name, val in kwargs.items():
+            if name not in self.arg_dict:
+                raise MXNetError("unknown argument %r" % name)
+            src = val._data if isinstance(val, NDArray) else val
+            self.arg_dict[name]._data = src
+
+        arg_vals = {n: a._data for n, a in self.arg_dict.items()}
+        aux_vals = {n: a._data for n, a in self.aux_dict.items()}
+        rng = _global.next_key()
+
+        if is_train:
+            # capture the vjp of the whole graph w.r.t. grad-requiring args
+            diff_names = [n for n in self.arg_names
+                          if self.grad_req.get(n, "null") != "null"
+                          and n in self.grad_dict]
+            const_args = {n: v for n, v in arg_vals.items() if n not in diff_names}
+            jit_fn = self._graph_fn(True)
+
+            def closed(diff_vals):
+                av = dict(const_args)
+                av.update(dict(zip(diff_names, diff_vals)))
+                return jit_fn(av, aux_vals, rng)
+
+            outputs, self._vjp_fn, aux_updates = jax.vjp(
+                closed, [arg_vals[n] for n in diff_names], has_aux=True)
+            self._diff_names = diff_names
+        else:
+            outputs, aux_updates = self._graph_fn(False)(arg_vals, aux_vals, rng)
+            self._vjp_fn = None
+        for name, val in aux_updates.items():
+            if name in self.aux_dict:
+                self.aux_dict[name]._data = val
+
+        self.outputs = [NDArray(o, self._ctx) for o in outputs]
+        self._output_shapes = [o.shape for o in outputs]
+        if self._monitor_callback is not None:
+            for name, out in zip(self.output_names, self.outputs):
+                self._monitor_callback(name, out)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """Run backward (reference executor.py:155); accumulates into
+        grad_arrays honoring per-arg grad_req write/add."""
+        import jax.numpy as jnp
+
+        if self._vjp_fn is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        if out_grads is None:
+            cts = tuple(jnp.ones(s, dtype=o._data.dtype)
+                        for s, o in zip(self._output_shapes, self.outputs))
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                        for g in out_grads)
+        (grads,) = self._vjp_fn(cts)
+        for name, g in zip(self._diff_names, grads):
+            tgt = self.grad_dict.get(name)
+            if tgt is None:
+                continue
+            if self.grad_req.get(name) == "add":
+                tgt._data = tgt._data + g
+            else:
+                tgt._data = g
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor for new input shapes (reference
+        executor.py:372). XLA recompiles per shape automatically; arrays are
+        reallocated here."""
+        from .ndarray import ndarray as nd_mod
+
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for name, shape in zip(self.arg_names, arg_shapes):
+            old = self.arg_dict[name]
+            if shape == old.shape:
+                new_args[name] = old
+            else:
+                new_args[name] = nd_mod.zeros(shape, ctx=self._ctx,
+                                              dtype=old.dtype)
+        new_grads = None
+        if self.grad_dict:
+            new_grads = {}
+            for name in self.grad_dict:
+                shape = new_args[name].shape
+                new_grads[name] = nd_mod.zeros(shape, ctx=self._ctx)
+        new_aux = {}
+        for name, shape in zip(self.aux_names, aux_shapes):
+            old = self.aux_dict[name]
+            new_aux[name] = old if shape == old.shape else nd_mod.zeros(
+                shape, ctx=self._ctx)
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self.grad_req, new_aux)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """Copy parameters (reference executor.py:copy_params_from)."""
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._data = array._data
+            elif not allow_extra_params:
+                raise MXNetError("Found name \"%s\" that is not in the arguments" % name)
+        if aux_params:
+            for name, array in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._data = array._data
+                elif not allow_extra_params:
+                    raise MXNetError("Found name \"%s\" that is not in the auxiliary states" % name)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    @property
+    def output_dict(self):
+        return dict(zip(self.output_names, self.outputs))
+
+    def debug_str(self):
+        return "Symbolic executor over %d args, %d outputs (whole-graph XLA)" % (
+            len(self.arg_names), len(self.output_names))

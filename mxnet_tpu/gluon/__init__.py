@@ -1,0 +1,20 @@
+"""Gluon: the imperative/hybrid NN API (reference ``python/mxnet/gluon/``).
+
+TPU-native redesign: ``HybridBlock.hybridize()`` compiles the traced forward
+into a single jitted XLA computation (the CachedOp equivalent, reference
+``gluon/block.py:749-786`` → ``src/imperative/cached_op.cc``); everything
+else keeps the reference API shape.
+"""
+from . import parameter
+from .parameter import Parameter, Constant, ParameterDict, DeferredInitializationError
+from . import block
+from .block import Block, HybridBlock, SymbolBlock
+from . import nn
+from . import loss
+from . import trainer
+from .trainer import Trainer
+from . import utils
+from . import rnn
+from . import data
+from . import model_zoo
+from . import contrib

@@ -1,0 +1,3 @@
+"""Gluon contrib nn (reference ``python/mxnet/gluon/contrib/nn/``)."""
+from .basic_layers import *
+from . import basic_layers

@@ -1,0 +1,5 @@
+"""Gluon contrib rnn (reference ``python/mxnet/gluon/contrib/rnn/``)."""
+from .conv_rnn_cell import *
+from .rnn_cell import *
+from . import conv_rnn_cell
+from . import rnn_cell

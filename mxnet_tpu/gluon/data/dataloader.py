@@ -1,0 +1,102 @@
+"""DataLoader (reference ``python/mxnet/gluon/data/dataloader.py:263``).
+
+The reference uses multiprocessing workers with shared-memory NDArray
+pickling (dataloader.py:97,184) to hide JPEG-decode latency. On this stack
+host-side decode feeds the TPU via asynchronous device_put; worker
+parallelism uses a thread pool (numpy decode releases the GIL) which avoids
+the fork-vs-XLA-runtime hazard the reference handles with fork handlers
+(reference src/initialize.cc). num_workers>0 therefore maps to threads.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ...ndarray import ndarray as nd_mod
+from ...ndarray.ndarray import NDArray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference dataloader.py:default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return nd_mod.stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return nd_mod.array(data, dtype=data.dtype)
+
+
+class DataLoader(object):
+    """Iterate a Dataset in mini-batches (reference dataloader.py:DataLoader)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(
+                sampler, batch_size, last_batch if last_batch else "keep")
+        elif (batch_size is not None or shuffle or sampler is not None or
+              last_batch is not None):
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be specified "
+                "if batch_sampler is specified.")
+
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers if num_workers >= 0 else 0
+        self._prefetch = max(0, int(prefetch) if prefetch is not None else
+                             2 * self._num_workers)
+        if batchify_fn is None:
+            self._batchify_fn = default_batchify_fn
+        else:
+            self._batchify_fn = batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[idx] for idx in batch])
+            return
+
+        # threaded prefetch pipeline (counterpart of the reference's
+        # worker-pool + data_queue, dataloader.py:_MultiWorkerIter)
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            batches = list(self._batch_sampler)
+            futures = []
+            depth = max(1, self._prefetch)
+
+            def fetch(idx_batch):
+                return self._batchify_fn([self._dataset[i] for i in idx_batch])
+
+            it = iter(batches)
+            for _ in range(depth):
+                nxt = next(it, None)
+                if nxt is None:
+                    break
+                futures.append(pool.submit(fetch, nxt))
+            while futures:
+                fut = futures.pop(0)
+                nxt = next(it, None)
+                if nxt is not None:
+                    futures.append(pool.submit(fetch, nxt))
+                yield fut.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,228 @@
+"""Vision datasets (reference ``python/mxnet/gluon/data/vision/datasets.py``):
+MNIST, FashionMNIST, CIFAR10, CIFAR100, ImageRecordDataset, ImageFolderDataset.
+
+Downloads are unavailable (zero egress); datasets read standard on-disk
+formats from ``root`` and synthesize deterministic data when
+``MXNET_TPU_FAKE_DATA=1`` so tests/benchmarks run hermetically.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ....base import MXNetError, get_env
+from ....ndarray import ndarray as nd_mod
+from ..dataset import Dataset, RecordFileDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+def _fake_ok():
+    return bool(int(os.environ.get("MXNET_TPU_FAKE_DATA", "0")))
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        super().__init__()
+        self._transform = transform
+        self._data = None
+        self._label = None
+        root = os.path.expanduser(root)
+        self._root = root
+        if not os.path.isdir(root):
+            os.makedirs(root, exist_ok=True)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from idx-ubyte files (reference datasets.py:MNIST)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        self._train_data = ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz")
+        self._test_data = ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz")
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        data_file, label_file = self._train_data if self._train else self._test_data
+        data_path = os.path.join(self._root, data_file)
+        label_path = os.path.join(self._root, label_file)
+        if not os.path.exists(data_path):
+            if os.path.exists(data_path[:-3]):
+                data_path, label_path = data_path[:-3], label_path[:-3]
+            elif _fake_ok():
+                n = 1024
+                rng = np.random.RandomState(42)
+                self._data = nd_mod.array(
+                    rng.randint(0, 255, (n, 28, 28, 1)).astype(np.uint8), dtype="uint8")
+                self._label = rng.randint(0, 10, n).astype(np.int32)
+                return
+            else:
+                raise MXNetError(
+                    "MNIST files not found under %s and downloads are disabled. "
+                    "Set MXNET_TPU_FAKE_DATA=1 for synthetic data." % self._root)
+        opener = gzip.open if data_path.endswith(".gz") else open
+        with opener(label_path, "rb") as fin:
+            struct.unpack(">II", fin.read(8))
+            label = np.frombuffer(fin.read(), dtype=np.uint8).astype(np.int32)
+        with opener(data_path, "rb") as fin:
+            struct.unpack(">IIII", fin.read(16))
+            data = np.frombuffer(fin.read(), dtype=np.uint8)
+            data = data.reshape(len(label), 28, 28, 1)
+        self._data = nd_mod.array(data, dtype="uint8")
+        self._label = label
+
+
+class FashionMNIST(MNIST):
+    """FashionMNIST (reference datasets.py:FashionMNIST)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root=root, train=train, transform=transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 from the python pickle batches (reference datasets.py:CIFAR10)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _read_batch(self, filename):
+        with open(filename, "rb") as fin:
+            d = pickle.load(fin, encoding="bytes")
+        data = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        label = np.asarray(d.get(b"labels", d.get(b"fine_labels")), dtype=np.int32)
+        return data, label
+
+    def _get_data(self):
+        batch_dir = os.path.join(self._root, "cifar-10-batches-py")
+        if not os.path.isdir(batch_dir):
+            if _fake_ok():
+                n = 1024
+                rng = np.random.RandomState(42)
+                self._data = nd_mod.array(
+                    rng.randint(0, 255, (n, 32, 32, 3)).astype(np.uint8), dtype="uint8")
+                self._label = rng.randint(0, 10, n).astype(np.int32)
+                return
+            raise MXNetError(
+                "CIFAR10 batches not found under %s and downloads are disabled. "
+                "Set MXNET_TPU_FAKE_DATA=1 for synthetic data." % self._root)
+        if self._train:
+            files = ["data_batch_%d" % i for i in range(1, 6)]
+        else:
+            files = ["test_batch"]
+        data, label = zip(*[self._read_batch(os.path.join(batch_dir, f)) for f in files])
+        self._data = nd_mod.array(np.concatenate(data), dtype="uint8")
+        self._label = np.concatenate(label)
+
+
+class CIFAR100(CIFAR10):
+    """CIFAR100 (reference datasets.py:CIFAR100)."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar100"),
+                 fine_label=False, train=True, transform=None):
+        self._fine_label = fine_label
+        super().__init__(root=root, train=train, transform=transform)
+
+    def _get_data(self):
+        batch_dir = os.path.join(self._root, "cifar-100-python")
+        if not os.path.isdir(batch_dir):
+            if _fake_ok():
+                n = 1024
+                rng = np.random.RandomState(42)
+                self._data = nd_mod.array(
+                    rng.randint(0, 255, (n, 32, 32, 3)).astype(np.uint8), dtype="uint8")
+                self._label = rng.randint(0, 100, n).astype(np.int32)
+                return
+            raise MXNetError(
+                "CIFAR100 batches not found under %s and downloads are disabled. "
+                "Set MXNET_TPU_FAKE_DATA=1 for synthetic data." % self._root)
+        fname = "train" if self._train else "test"
+        with open(os.path.join(batch_dir, fname), "rb") as fin:
+            d = pickle.load(fin, encoding="bytes")
+        data = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        key = b"fine_labels" if self._fine_label else b"coarse_labels"
+        self._data = nd_mod.array(data, dtype="uint8")
+        self._label = np.asarray(d[key], dtype=np.int32)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images from a .rec file (reference datasets.py:ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from .... import image, recordio
+
+        record = super().__getitem__(idx)
+        header, img = recordio.unpack(record)
+        decoded = image.imdecode(img, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(decoded, label)
+        return decoded, label
+
+
+class ImageFolderDataset(Dataset):
+    """Images arranged in class folders (reference datasets.py:ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png", ".npy"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                filename = os.path.join(path, filename)
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    continue
+                self.items.append((filename, label))
+
+    def __getitem__(self, idx):
+        from .... import image
+
+        filename, label = self.items[idx]
+        if filename.endswith(".npy"):
+            img = nd_mod.array(np.load(filename))
+        else:
+            with open(filename, "rb") as f:
+                img = image.imdecode(f.read(), self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,272 @@
+"""Vision transforms (reference ``python/mxnet/gluon/data/vision/transforms.py``):
+Compose, Cast, ToTensor, Normalize, Resize, CenterCrop, RandomResizedCrop,
+RandomFlipLeftRight/TopBottom, RandomBrightness/Contrast/Saturation/Hue,
+RandomColorJitter, RandomLighting."""
+from __future__ import annotations
+
+import numpy as np
+
+from ....base import MXNetError
+from ....ndarray import ndarray as nd_mod
+from ....ndarray.ndarray import NDArray
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = [
+    "Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+    "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+    "RandomBrightness", "RandomContrast", "RandomSaturation", "RandomHue",
+    "RandomColorJitter", "RandomLighting",
+]
+
+
+def _np_rng():
+    from .... import random as _random
+
+    return _random.np_rng()
+
+
+class Compose(Sequential):
+    """Sequentially compose transforms (reference transforms.py:Compose)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for i in transforms:
+            if isinstance(i, Block):
+                self.add(i)
+            else:
+                self.add(Lambda_(i))
+
+
+class Lambda_(Block):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, x):
+        return self._fn(x)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (reference transforms.py:ToTensor)."""
+
+    def hybrid_forward(self, F, x):
+        x = x.astype("float32") / 255.0
+        if x.ndim == 3:
+            return x.transpose((2, 0, 1))
+        return x.transpose((0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    """(x - mean) / std per channel, CHW input (reference transforms.py:Normalize)."""
+
+    def __init__(self, mean, std):
+        super().__init__()
+        self._mean = np.asarray(mean, dtype=np.float32)
+        self._std = np.asarray(std, dtype=np.float32)
+
+    def hybrid_forward(self, F, x):
+        mean = self._mean.reshape((-1, 1, 1))
+        std = self._std.reshape((-1, 1, 1))
+        return (x - nd_mod.array(mean)) / nd_mod.array(std)
+
+
+def _resize_hwc(x, size, interp="bilinear"):
+    import jax
+
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = size
+    if x.ndim == 3:
+        out_shape = (h, w, x.shape[2])
+    else:
+        out_shape = (x.shape[0], h, w, x.shape[3])
+    data = x._data.astype("float32")
+    out = jax.image.resize(data, out_shape, method=interp)
+    return NDArray(out.astype(x._data.dtype if np.issubdtype(np.asarray(x._data).dtype, np.floating) else "float32"), x.context)
+
+
+class Resize(Block):
+    """Resize HWC image (reference transforms.py:Resize)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+
+    def forward(self, x):
+        if isinstance(self._size, int) and self._keep:
+            h, w = x.shape[0], x.shape[1]
+            if h < w:
+                size = (int(self._size * w / h), self._size)
+            else:
+                size = (self._size, int(self._size * h / w))
+        else:
+            size = self._size
+        return _resize_hwc(x, size)
+
+
+class CenterCrop(Block):
+    """Center crop HWC (reference transforms.py:CenterCrop)."""
+
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[0], x.shape[1]
+        if H < h or W < w:
+            x = _resize_hwc(x, (max(w, W), max(h, H)))
+            H, W = x.shape[0], x.shape[1]
+        y0 = (H - h) // 2
+        x0 = (W - w) // 2
+        return x[y0:y0 + h, x0:x0 + w, :]
+
+
+class RandomResizedCrop(Block):
+    """Random area/aspect crop then resize (reference transforms.py:RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        rng = _np_rng()
+        H, W = x.shape[0], x.shape[1]
+        area = H * W
+        for _ in range(10):
+            target_area = rng.uniform(*self._scale) * area
+            log_ratio = (np.log(self._ratio[0]), np.log(self._ratio[1]))
+            aspect = np.exp(rng.uniform(*log_ratio))
+            w = int(round(np.sqrt(target_area * aspect)))
+            h = int(round(np.sqrt(target_area / aspect)))
+            if w <= W and h <= H:
+                x0 = rng.randint(0, W - w + 1)
+                y0 = rng.randint(0, H - h + 1)
+                crop = x[y0:y0 + h, x0:x0 + w, :]
+                return _resize_hwc(crop, self._size)
+        return CenterCrop(self._size).forward(x)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if _np_rng().rand() < 0.5:
+            return x.flip(axis=1)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if _np_rng().rand() < 0.5:
+            return x.flip(axis=0)
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._args = (max(0, 1 - brightness), 1 + brightness)
+
+    def forward(self, x):
+        alpha = _np_rng().uniform(*self._args)
+        return x.astype("float32") * alpha
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._args = (max(0, 1 - contrast), 1 + contrast)
+
+    def forward(self, x):
+        alpha = _np_rng().uniform(*self._args)
+        xf = x.astype("float32")
+        gray = xf.mean()
+        return xf * alpha + gray * (1 - alpha)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._args = (max(0, 1 - saturation), 1 + saturation)
+
+    def forward(self, x):
+        alpha = _np_rng().uniform(*self._args)
+        xf = x.astype("float32")
+        coef = nd_mod.array(np.array([0.299, 0.587, 0.114], dtype=np.float32))
+        gray = (xf * coef.reshape((1, 1, 3))).sum(axis=2, keepdims=True)
+        return xf * alpha + gray * (1 - alpha)
+
+
+class RandomHue(Block):
+    def __init__(self, hue):
+        super().__init__()
+        self._hue = hue
+
+    def forward(self, x):
+        alpha = _np_rng().uniform(-self._hue, self._hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]], dtype=np.float32)
+        tyiq = np.array([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]], dtype=np.float32)
+        ityiq = np.array([[1.0, 0.956, 0.621],
+                          [1.0, -0.272, -0.647],
+                          [1.0, -1.107, 1.705]], dtype=np.float32)
+        t = ityiq @ bt @ tyiq
+        xf = x.astype("float32")
+        return xf.dot(nd_mod.array(t.T))
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._transforms = []
+        if brightness > 0:
+            self._transforms.append(RandomBrightness(brightness))
+        if contrast > 0:
+            self._transforms.append(RandomContrast(contrast))
+        if saturation > 0:
+            self._transforms.append(RandomSaturation(saturation))
+        if hue > 0:
+            self._transforms.append(RandomHue(hue))
+
+    def forward(self, x):
+        order = _np_rng().permutation(len(self._transforms))
+        for i in order:
+            x = self._transforms[i].forward(x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (reference transforms.py:RandomLighting)."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], dtype=np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], dtype=np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        rng = _np_rng()
+        alpha = rng.normal(0, self._alpha, size=(3,)).astype(np.float32)
+        rgb = self._eigvec @ (self._eigval * alpha)
+        return x.astype("float32") + nd_mod.array(rgb.reshape((1, 1, 3)))

@@ -1,0 +1,7 @@
+"""Gluon neural-network layers (reference ``python/mxnet/gluon/nn/``)."""
+from .basic_layers import *
+from .conv_layers import *
+from .activations import *
+from . import basic_layers
+from . import conv_layers
+from . import activations

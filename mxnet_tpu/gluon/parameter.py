@@ -1,0 +1,499 @@
+"""Gluon Parameter / ParameterDict.
+
+API parity with reference ``python/mxnet/gluon/parameter.py`` (Parameter
+:43,102 — deferred init, per-ctx copies, grad_req/stype; ParameterDict with
+prefix scoping and sharing). On this stack a parameter owns one NDArray per
+context; with a single TPU chip that's one HBM buffer, and multi-device
+replication is handled by the Trainer/KVStore layer (SURVEY.md §2.5).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from .. import autograd, initializer as init_mod
+from ..base import MXNetError, np_dtype
+from ..context import Context, cpu, current_context
+from ..ndarray import ndarray as nd_mod
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization."""
+
+
+class Parameter(object):
+    """A Block parameter (reference gluon/parameter.py:43).
+
+    Holds data+grad per context, supports deferred initialization when the
+    shape contains unknown (0) dimensions resolved at first forward.
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None  # OrderedDict ctx -> NDArray
+        self._grad = None
+        self._ctx_list = None
+        self._deferred_init = ()
+        self.name = name
+        self._differentiable = differentiable
+        if not differentiable:
+            grad_req = "null"
+        self._allow_deferred_init = allow_deferred_init
+        self._grad_req = None
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.grad_req = grad_req
+        self.init = init
+        if stype not in ("default", "row_sparse", "csr"):
+            raise MXNetError("invalid stype %r" % (stype,))
+        self._stype = stype
+        self._grad_stype = grad_stype
+
+    def __repr__(self):
+        s = "Parameter {name} (shape={shape}, dtype={dtype})"
+        return s.format(name=self.name, shape=self.shape, dtype=self.dtype)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise MXNetError("grad_req must be write, add, or null, got %r" % (req,))
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null" and self._grad is not None:
+            self._grad = None
+            for d in (self._data or {}).values():
+                d._marked = False
+                d._grad = None
+        elif self._data is not None:
+            self._init_grad()
+
+    @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def grad_stype(self):
+        return self._grad_stype
+
+    # ------------------------------------------------------------------
+    # init machinery (reference parameter.py:_finish_deferred_init)
+    # ------------------------------------------------------------------
+    def _check_and_get(self, arr_dict, ctx):
+        if arr_dict is not None:
+            if ctx is list:
+                return list(arr_dict.values())
+            if ctx is None:
+                if len(arr_dict) == 1:
+                    return list(arr_dict.values())[0]
+                ctx = current_context()
+            if ctx in arr_dict:
+                return arr_dict[ctx]
+            # single copy serves any ctx on this stack (one chip)
+            if len(arr_dict) == 1:
+                return list(arr_dict.values())[0]
+            raise MXNetError(
+                "Parameter '%s' was not initialized on context %s." % (self.name, ctx))
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter '%s' has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass." % self.name)
+        raise MXNetError(
+            "Parameter '%s' has not been initialized. You should initialize "
+            "parameters with Block.collect_params().initialize()." % self.name)
+
+    def _load_init(self, data, ctx):
+        """Initialize from loaded data (reference parameter.py:_load_init)."""
+        if self.shape:
+            for self_dim, data_dim in zip(self.shape, data.shape):
+                if self_dim != 0 and self_dim != data_dim:
+                    raise MXNetError(
+                        "Failed loading Parameter '%s' from saved params: "
+                        "shape incompatible expected %s vs saved %s"
+                        % (self.name, str(self.shape), str(data.shape)))
+            self.shape = tuple(
+                self_dim if self_dim != 0 else data_dim
+                for self_dim, data_dim in zip(self.shape, data.shape))
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is None:
+            if self._deferred_init:
+                if ctx is not None and set(ctx) != set(self._deferred_init[1]):
+                    pass  # ctx change on load is fine on this stack
+            self._init_impl(data, ctx or [current_context()])
+        else:
+            for arr in self._data.values():
+                arr._data = data._data if isinstance(data, NDArray) else nd_mod.array(data)._data
+        self._deferred_init = ()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        if self.shape is None or np.prod(self.shape) <= 0:
+            raise MXNetError(
+                "Cannot initialize Parameter '%s' because it has invalid shape: %s."
+                % (self.name, str(self.shape)))
+        with autograd.pause():
+            if data is None:
+                data = nd_mod.zeros(self.shape, dtype=self.dtype, ctx=cpu())
+                # a param-specific init overrides suffix dispatch via the
+                # InitDesc __init__ attr (reference parameter.py:_finish_deferred_init)
+                attrs = {}
+                if init is not None:
+                    init_obj = init_mod.create(init)
+                    if hasattr(init_obj, "dumps"):
+                        attrs["__init__"] = init_obj.dumps()
+                    else:  # Load/Mixed-style plain callables
+                        init_obj(init_mod.InitDesc(self.name), data)
+                        self._init_impl(data, ctx)
+                        return
+                init_mod.create(default_init)(
+                    init_mod.InitDesc(self.name, attrs), data)
+            self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        self._ctx_list = list(ctx_list)
+        self._data = OrderedDict()
+        if not isinstance(data, NDArray):
+            data = nd_mod.array(data, dtype=self.dtype)
+        for ctx in self._ctx_list:
+            self._data[ctx] = data.as_in_context(ctx) if ctx != data.context else data
+        self._init_grad()
+
+    def _init_grad(self):
+        if self.grad_req == "null":
+            self._grad = None
+            return
+        self._grad = OrderedDict()
+        for ctx, d in self._data.items():
+            d.attach_grad(grad_req=self.grad_req)
+            self._grad[ctx] = d._grad
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None, force_reinit=False):
+        """Initialize data+grad buffers (reference parameter.py:initialize)."""
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if init is None:
+            init = default_init if self.init is None else self.init
+        if self.shape is None or any(s == 0 for s in self.shape):
+            if self._allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise MXNetError(
+                "Cannot initialize Parameter '%s' because it has invalid shape: %s."
+                % (self.name, str(self.shape)))
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data:
+            data = list(self._data.values())[0]
+            self._init_impl(data, ctx)
+        elif self._deferred_init:
+            init, _, default_init, data = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+        else:
+            raise MXNetError(
+                "Cannot reset context for Parameter '%s' because it has not been "
+                "initialized." % self.name)
+
+    def set_data(self, data):
+        """Set data on all contexts (reference parameter.py:set_data)."""
+        self.shape = tuple(data.shape)
+        if self._data is None:
+            assert self._deferred_init, \
+                "Parameter '%s' has not been initialized" % self.name
+            self._deferred_init = self._deferred_init[:3] + (data,)
+            return
+        for arr in self._data.values():
+            arr._data = data._data if isinstance(data, NDArray) else nd_mod.array(data)._data
+
+    def row_sparse_data(self, row_id):
+        """Row-sparse pull collapses to a dense read on XLA (SURVEY §7.3)."""
+        return self.data()
+
+    def list_row_sparse_data(self, row_id):
+        return self.list_data()
+
+    def data(self, ctx=None) -> NDArray:
+        return self._check_and_get(self._data, ctx)
+
+    def list_data(self):
+        return self._check_and_get(self._data, list)
+
+    def grad(self, ctx=None) -> NDArray:
+        if self._data is not None and self._grad is None:
+            raise MXNetError(
+                "Cannot get gradient array for Parameter '%s' because grad_req='null'"
+                % self.name)
+        return self._check_and_get(self._grad, ctx)
+
+    def list_grad(self):
+        if self._data is not None and self._grad is None:
+            raise MXNetError(
+                "Cannot get gradient array for Parameter '%s' because grad_req='null'"
+                % self.name)
+        return self._check_and_get(self._grad, list)
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise MXNetError("Parameter '%s' has not been initialized" % self.name)
+        return self._ctx_list
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        import jax.numpy as jnp
+
+        for g in self._grad.values():
+            g._data = jnp.zeros_like(g._data)
+
+    def var(self):
+        """Symbol variable for this parameter (symbolic bridge)."""
+        if self._var is None:
+            from .. import symbol
+
+            self._var = symbol.var(self.name, shape=self.shape, dtype=self.dtype,
+                                   lr_mult=self.lr_mult, wd_mult=self.wd_mult,
+                                   init=self.init)
+        return self._var
+
+    def cast(self, dtype):
+        self.dtype = np_dtype(dtype) if isinstance(dtype, str) else dtype
+        if self._data is None:
+            return
+        with autograd.pause():
+            for arr in self._data.values():
+                arr._data = arr.astype(dtype)._data
+            if self._grad is not None:
+                for g in self._grad.values():
+                    g._data = g.astype(dtype)._data
+
+
+class Constant(Parameter):
+    """A constant parameter: grad_req='null', initialized from ``value``
+    (reference gluon/parameter.py:Constant)."""
+
+    def __init__(self, name, value):
+        import json
+
+        if not isinstance(value, NDArray):
+            value = nd_mod.array(value)
+        self.value = value
+
+        init_name = "constant_{}_{}".format(name, id(self)).lower()
+
+        class InitName(init_mod.Initializer):
+            def _init_weight(self2, _, arr):
+                init_mod.Initializer._set(arr, value.asnumpy())
+
+            _init_default = _init_weight
+            _init_bias = _init_weight
+            _init_gamma = _init_weight
+            _init_beta = _init_weight
+
+            def dumps(self2):
+                return json.dumps([init_name, {}])
+
+        init_mod._INIT_REGISTRY[init_name] = InitName
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=init_name)
+
+
+class ParameterDict(object):
+    """Prefix-scoped dict of Parameters with sharing (reference
+    gluon/parameter.py:ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __repr__(self):
+        name = self._prefix + " " if self._prefix else ""
+        return "{name}(\n{content}\n)".format(
+            name=name, content="\n".join(str(v) for v in self.values()))
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs) -> Parameter:
+        """Get or create a Parameter named ``self.prefix + name``."""
+        name = self.prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and len(v) == len(existing):
+                        inferred_shape = []
+                        matched = True
+                        for dim1, dim2 in zip(v, existing):
+                            if dim1 != dim2 and dim1 * dim2 != 0:
+                                matched = False
+                                break
+                            elif dim1 == dim2:
+                                inferred_shape.append(dim1)
+                            elif dim1 == 0:
+                                inferred_shape.append(dim2)
+                            else:
+                                inferred_shape.append(dim1)
+                        if matched:
+                            param.shape = tuple(inferred_shape)
+                            continue
+                    elif k == "dtype" and np.dtype(v) == np.dtype(existing):
+                        continue
+                    if v is not None and existing is not None and v != existing:
+                        raise MXNetError(
+                            "Cannot retrieve Parameter '%s' because desired attribute "
+                            "does not match with stored for attribute '%s': desired "
+                            "'%s' vs stored '%s'." % (name, k, str(v), str(existing)))
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None) -> Constant:
+        name = self.prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise MXNetError(
+                    "No constant named '{name}'. Please specify value "
+                    "if you want to create a new constant.".format(name=name))
+            param = Constant(name, value)
+            self._params[name] = param
+        elif value is not None:
+            if not isinstance(value, NDArray):
+                value = nd_mod.array(value)
+            if not np.array_equal(param.value.asnumpy(), value.asnumpy()):
+                raise MXNetError(
+                    "Constant '{name}' already exists but it's not equal to "
+                    "the requested value".format(name=name))
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params:
+                if self._params[k] is not v:
+                    raise MXNetError(
+                        "Cannot update self with other because they have different "
+                        "Parameters with the same name '%s'" % k)
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        if init is None:
+            init = init_mod.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        """Save to a .params file (reference format via ndarray save)."""
+        from ..ndarray import io_utils
+
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data() if param._data is not None else None
+            if weight is None:
+                raise MXNetError("Parameter %s not initialized" % param.name)
+            if not param.name.startswith(strip_prefix):
+                raise MXNetError(
+                    "Prefix '%s' is to be stripped before saving, but Parameter's "
+                    "name '%s' does not start with it." % (strip_prefix, param.name))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        io_utils.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False, ignore_extra=False,
+             restore_prefix=""):
+        from ..ndarray import io_utils
+
+        arg_dict = io_utils.load(filename)
+        arg_dict = {restore_prefix + k.split(":", 1)[-1]: v for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise MXNetError(
+                        "Parameter '%s' is missing in file '%s'" % (name, filename))
+        for name in arg_dict:
+            if name not in self._params:
+                if not ignore_extra:
+                    raise MXNetError(
+                        "Parameter '%s' loaded from file '%s' is not present in "
+                        "ParameterDict" % (name, filename))
+                continue
+            self[name]._load_init(arg_dict[name], ctx)

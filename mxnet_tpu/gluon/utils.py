@@ -1,0 +1,100 @@
+"""Gluon utilities (reference ``python/mxnet/gluon/utils.py``):
+split_data, split_and_load, clip_global_norm, check_sha1, download."""
+from __future__ import annotations
+
+import hashlib
+import math
+import os
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import ndarray as nd_mod
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split an NDArray along batch_axis into num_slice slices
+    (reference utils.py:split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along axis %d. "
+            "Use a batch size that's multiple of %d or set even_split=False to allow "
+            "uneven partitioning of data." % (str(data.shape), num_slice, batch_axis, num_slice))
+    n_each = size // num_slice
+    if not even_split:
+        step = int(math.ceil(size / num_slice))
+        slices = [
+            data.slice_axis(batch_axis, i * step, min((i + 1) * step, size))
+            for i in range(num_slice) if i * step < size]
+        return slices
+    return [data.slice_axis(batch_axis, i * n_each, (i + 1) * n_each)
+            for i in range(num_slice)]
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split data and load each slice to a context
+    (reference utils.py:split_and_load)."""
+    if not isinstance(data, NDArray):
+        data = nd_mod.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so the sum of their 2-norms is <= max_norm
+    (reference utils.py:clip_global_norm)."""
+    import jax.numpy as jnp
+
+    assert len(arrays) > 0
+    total_norm = jnp.sqrt(sum(jnp.sum(jnp.square(a._data.astype(jnp.float32)))
+                              for a in arrays))
+    total_norm_np = float(total_norm)
+    if check_isfinite and not np.isfinite(total_norm_np):
+        import warnings
+
+        warnings.warn(
+            UserWarning("nan or inf is detected. Clipping results will be "
+                        "undefined."), stacklevel=2)
+    scale = max_norm / (total_norm_np + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr._data = arr._data * scale
+    return total_norm_np if check_isfinite else total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """Check file sha1 (reference utils.py:check_sha1)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Download a file (reference utils.py:download). This environment has no
+    network egress; the function errors clearly when a real fetch is needed."""
+    if path is None:
+        fname = url.split("/")[-1]
+        path = fname
+    else:
+        path = os.path.expanduser(path)
+        if os.path.isdir(path):
+            path = os.path.join(path, url.split("/")[-1])
+        fname = path
+    if os.path.exists(fname) and not overwrite and (
+            not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    raise MXNetError(
+        "download(%r) needs network access, which is unavailable in this "
+        "environment. Place the file at %r manually." % (url, fname))

@@ -22,6 +22,8 @@ from .ndarray import (
 from . import random  # noqa: F401
 from . import sparse  # noqa: F401
 from .sparse import RowSparseNDArray, CSRNDArray
+from . import io_utils  # noqa: F401
+from .io_utils import save, load
 
 
 def _make_op_func(_name):

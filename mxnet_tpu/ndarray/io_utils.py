@@ -1,0 +1,177 @@
+"""MXNet ``.params`` file (de)serialization.
+
+Byte-compatible with the reference container format so model-zoo artifacts
+transfer (SURVEY.md Appendix B; reference ``src/ndarray/ndarray.cc:1537``
+NDArray::Save and ``:1733`` list save):
+
+    file      := uint64 kMXAPINDArrayListMagic(0x112) | uint64 reserved
+               | vec<ndarray> | vec<string names>
+    vec<T>    := uint64 count | T*count                       (dmlc::Stream)
+    ndarray   := uint32 NDARRAY_V2_MAGIC(0xF993fac9) | int32 stype(=1 dense)
+               | tshape | int32 dev_type | int32 dev_id | int32 type_flag
+               | raw data bytes
+    tshape    := uint32 ndim | int64*ndim                     (int64 TShape)
+
+Legacy V1 (int64 shape, no stype) and pre-V1 (uint32 ndim leading) load
+paths are kept, mirroring ``NDArray::LegacyLoad``.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple, Union
+
+import numpy as np
+
+from ..base import MXNetError
+
+kMXAPINDArrayListMagic = 0x112
+NDARRAY_V1_MAGIC = 0xF993FAC8
+NDARRAY_V2_MAGIC = 0xF993FAC9
+
+# mshadow type codes (reference include/mxnet/base.h / mshadow dtype flags)
+_TYPE_FLAG_TO_NP = {
+    0: np.float32,
+    1: np.float64,
+    2: np.float16,
+    3: np.uint8,
+    4: np.int32,
+    5: np.int8,
+    6: np.int64,
+}
+_NP_TO_TYPE_FLAG = {np.dtype(v): k for k, v in _TYPE_FLAG_TO_NP.items()}
+
+
+def _np_of(arr) -> np.ndarray:
+    if hasattr(arr, "asnumpy"):
+        return arr.asnumpy()
+    return np.ascontiguousarray(arr)
+
+
+def _save_one(parts: List[bytes], a: np.ndarray):
+    dt = np.dtype(a.dtype)
+    if dt.name == "bfloat16":  # no mshadow code for bf16 in 1.x files
+        a = a.astype(np.float32)
+        dt = np.dtype(np.float32)
+    if a.ndim == 0:
+        # the reference format has no 0-d arrays (ndim 0 marks a "none"
+        # NDArray with no payload, ndarray.cc:1556); persist as (1,)
+        a = a.reshape(1)
+    if dt not in _NP_TO_TYPE_FLAG:
+        raise MXNetError("dtype %s not serializable to .params" % dt)
+    parts.append(struct.pack("<I", NDARRAY_V2_MAGIC))
+    parts.append(struct.pack("<i", 1))  # kDefaultStorage
+    parts.append(struct.pack("<I", a.ndim))
+    parts.append(struct.pack("<%dq" % a.ndim, *a.shape))
+    parts.append(struct.pack("<ii", 1, 0))  # Context: cpu(0)
+    parts.append(struct.pack("<i", _NP_TO_TYPE_FLAG[dt]))
+    parts.append(np.ascontiguousarray(a).tobytes())
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, fmt: str):
+        size = struct.calcsize(fmt)
+        vals = struct.unpack_from("<" + fmt, self.buf, self.pos)
+        self.pos += size
+        return vals if len(vals) > 1 else vals[0]
+
+    def read_bytes(self, n: int) -> bytes:
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+
+def _load_shape_v2(r: _Reader) -> Tuple[int, ...]:
+    ndim = r.read("I")
+    if ndim == 0:
+        return ()
+    return tuple(r.read("%dq" % ndim) if ndim > 1 else (r.read("q"),))
+
+
+def _load_one(r: _Reader) -> np.ndarray:
+    magic = r.read("I")
+    if magic == NDARRAY_V2_MAGIC:
+        stype = r.read("i")
+        if stype != 1:
+            raise MXNetError("sparse .params entries not supported yet (stype=%d)" % stype)
+        shape = _load_shape_v2(r)
+    elif magic == NDARRAY_V1_MAGIC:
+        shape = _load_shape_v2(r)
+    else:
+        # pre-V1: magic itself is ndim, uint32 dims
+        ndim = magic
+        shape = tuple(r.read("%dI" % ndim)) if ndim > 1 else ((r.read("I"),) if ndim else ())
+    if len(shape) == 0:
+        return np.zeros((), dtype=np.float32)
+    r.read("ii")  # context
+    type_flag = r.read("i")
+    dt = np.dtype(_TYPE_FLAG_TO_NP[type_flag])
+    count = int(np.prod(shape))
+    data = np.frombuffer(r.read_bytes(count * dt.itemsize), dtype=dt).reshape(shape)
+    return data.copy()
+
+
+def save(fname: str, data) -> None:
+    """Save dict-of-NDArray / list-of-NDArray / single NDArray
+    (reference ``mx.nd.save``)."""
+    from .ndarray import NDArray
+
+    if isinstance(data, NDArray):
+        data = [data]
+    names: List[str] = []
+    arrays: List[np.ndarray] = []
+    if isinstance(data, dict):
+        for k, v in data.items():
+            names.append(k)
+            arrays.append(_np_of(v))
+    elif isinstance(data, (list, tuple)):
+        arrays = [_np_of(v) for v in data]
+    else:
+        raise MXNetError("save expects dict, list, or NDArray")
+
+    parts: List[bytes] = [struct.pack("<QQ", kMXAPINDArrayListMagic, 0)]
+    parts.append(struct.pack("<Q", len(arrays)))
+    for a in arrays:
+        _save_one(parts, a)
+    parts.append(struct.pack("<Q", len(names)))
+    for n in names:
+        nb = n.encode("utf-8")
+        parts.append(struct.pack("<Q", len(nb)))
+        parts.append(nb)
+    with open(fname, "wb") as f:
+        f.write(b"".join(parts))
+
+
+def load_np(fname: str) -> Union[Dict[str, np.ndarray], List[np.ndarray]]:
+    """Load a .params file into numpy arrays (names preserved)."""
+    with open(fname, "rb") as f:
+        buf = f.read()
+    r = _Reader(buf)
+    header, _reserved = r.read("QQ")
+    if header != kMXAPINDArrayListMagic:
+        raise MXNetError("Invalid NDArray file format (bad magic 0x%x)" % header)
+    n_arrays = r.read("Q")
+    arrays = [_load_one(r) for _ in range(n_arrays)]
+    n_names = r.read("Q")
+    names = []
+    for _ in range(n_names):
+        ln = r.read("Q")
+        names.append(r.read_bytes(ln).decode("utf-8"))
+    if names:
+        if len(names) != len(arrays):
+            raise MXNetError("Invalid NDArray file format (names/arrays mismatch)")
+        return dict(zip(names, arrays))
+    return arrays
+
+
+def load(fname: str):
+    """Load a .params file into NDArrays (reference ``mx.nd.load``)."""
+    from . import ndarray as nd
+
+    out = load_np(fname)
+    if isinstance(out, dict):
+        return {k: nd.array(v, dtype=v.dtype) for k, v in out.items()}
+    return [nd.array(v, dtype=v.dtype) for v in out]

@@ -929,3 +929,94 @@ def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional=False
 )
 def rnn_param_concat(attrs, *xs):
     return jnp.concatenate([x.reshape(-1) for x in xs], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference src/operator/contrib/ctc_loss.cc / warpctc) — log-space
+# alpha recursion over lax.scan; one XLA while loop, batched lattice.
+# ---------------------------------------------------------------------------
+
+_CTC_NEG = -1e30
+
+
+def _ctc_logaddexp(a, b):
+    m = jnp.maximum(a, b)
+    return m + jnp.log1p(jnp.exp(-jnp.abs(a - b)))
+
+
+def _ctc_forward(logp, lab, pl, ll):
+    """logp (B,T,C) log-probs; lab (B,L) labels (blank=0); pl,(B,) input
+    lengths; ll (B,) label lengths. Returns per-sample -log p(l|x)."""
+    B, T, C = logp.shape
+    L = lab.shape[1]
+    S = 2 * L + 1
+
+    ext = jnp.zeros((B, S), dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    ext_len = 2 * ll + 1
+
+    alpha0 = jnp.full((B, S), _CTC_NEG)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, 0])
+    first_lab = ext[:, 1]
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(ll > 0,
+                  jnp.take_along_axis(logp[:, 0, :], first_lab[:, None], axis=1)[:, 0],
+                  _CTC_NEG))
+
+    same_as_two_back = jnp.concatenate(
+        [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, t):
+        stay = alpha
+        one = jnp.concatenate([jnp.full((B, 1), _CTC_NEG), alpha[:, :-1]], axis=1)
+        two = jnp.concatenate([jnp.full((B, 2), _CTC_NEG), alpha[:, :-2]], axis=1)
+        two = jnp.where(same_as_two_back, _CTC_NEG, two)
+        merged = _ctc_logaddexp(_ctc_logaddexp(stay, one), two)
+        emit = jnp.take_along_axis(logp[:, t, :], ext, axis=1)
+        new_alpha = merged + emit
+        active = (t < pl)[:, None]
+        return jnp.where(active, new_alpha, alpha), None
+
+    alphaT, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    idx_last = jnp.clip(ext_len - 1, 0, S - 1)
+    idx_prev = jnp.clip(ext_len - 2, 0, S - 1)
+    a_last = jnp.take_along_axis(alphaT, idx_last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alphaT, idx_prev[:, None], axis=1)[:, 0]
+    return -_ctc_logaddexp(a_last, a_prev)
+
+
+@register(
+    "CTCLoss",
+    params={
+        "use_data_lengths": (bool, False),
+        "use_label_lengths": (bool, False),
+        "blank_label": (str, "first"),
+    },
+    inputs=lambda attrs: ["data", "label"]
+    + (["data_lengths"] if attrs.get("use_data_lengths") else [])
+    + (["label_lengths"] if attrs.get("use_label_lengths") else []),
+    aliases=("_contrib_CTCLoss", "ctc_loss", "_contrib_ctc_loss"),
+)
+def ctc_loss(attrs, data, label, *rest):
+    """data (B,T,C) unnormalized activations; label (B,L). blank_label
+    'first' means blank=0 (reference contrib.CTCLoss semantics)."""
+    B, T, C = data.shape
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)
+    i = 0
+    if attrs.use_data_lengths:
+        pl = rest[i].astype(jnp.int32)
+        i += 1
+    else:
+        pl = jnp.full((B,), T, dtype=jnp.int32)
+    if attrs.use_label_lengths:
+        ll = rest[i].astype(jnp.int32)
+    else:
+        # padding convention: 0 for blank_label='first', -1 for 'last'
+        pad_val = -1 if attrs.blank_label == "last" else 0
+        ll = jnp.sum((lab != pad_val).astype(jnp.int32), axis=1)
+    if attrs.blank_label == "last":
+        # rotate so blank becomes index 0; -1 padding maps onto blank
+        logp = jnp.concatenate([logp[..., -1:], logp[..., :-1]], axis=-1)
+        lab = jnp.where(lab < 0, -1, lab) + 1
+    return _ctc_forward(logp, lab, pl, ll)

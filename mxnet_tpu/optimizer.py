@@ -1,0 +1,832 @@
+"""Optimizers.
+
+API parity with reference ``python/mxnet/optimizer.py`` (Optimizer registry,
+SGD/NAG/Signum/FTML/Adam/AdaGrad/RMSProp/AdaDelta/Ftrl/Adamax/Nadam/SGLD/
+DCASGD/LBSGD, lr/wd multipliers, ``num_update`` bookkeeping, ``Updater`` with
+state (de)serialization).
+
+TPU-native design: the reference accelerates updates with hand-fused CUDA ops
+(reference ``src/operator/optimizer_op.cc`` — sgd_mom_update, adam_update, …).
+Here every optimizer expresses its update as a *pure jax function*
+``step(weight, grad, *state, lr, wd) -> (new_weight, *new_state)`` which is
+``jax.jit``-compiled once per parameter shape — XLA fuses the whole update
+chain (rescale → clip → wd → momentum → assign) into one kernel, the direct
+equivalent of the reference's fused ops. lr/wd enter as traced scalars so LR
+schedules never trigger recompilation.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = [
+    "Optimizer", "register", "create", "get_updater", "Updater",
+    "SGD", "NAG", "Signum", "SignSGD", "FTML", "DCASGD", "SGLD", "LBSGD",
+    "Adam", "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam",
+    "Test",
+]
+
+
+def _as_jax(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+def _f32(x):
+    return jnp.asarray(x, dtype=jnp.float32)
+
+
+class Optimizer(object):
+    """Base optimizer (reference optimizer.py:35).
+
+    Subclasses implement :meth:`create_state` and a pure :meth:`_step`
+    returning ``(new_weight, new_states)``; the base class handles registry,
+    per-index lr/wd multipliers, update counting, gradient rescale/clip, and
+    jit caching.
+    """
+
+    opt_registry: Dict[str, type] = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise MXNetError("Cannot find optimizer %s" % name)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        if not isinstance(param_idx2name, dict):
+            raise MXNetError("param_idx2name should be a dict of param indexes to names.")
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None else ()
+        self.param_dict = param_dict if param_dict else {}
+        self._step_cache: Dict[Any, Any] = {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    # ------------------------------------------------------------------
+    # state
+    # ------------------------------------------------------------------
+    def create_state(self, index, weight):
+        """Return optimizer state for one parameter (None / array / tuple)."""
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp16 weights get an fp32 master copy (reference
+        create_state_multi_precision; mp_sgd_update parity)."""
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == np.float16:
+            weight_master_copy = jnp.asarray(_as_jax(weight), dtype=jnp.float32)
+            return (weight_master_copy, self.create_state(index, weight))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        """fp16 weights: run the update on the fp32 master copy, then cast
+        back (reference mp_sgd_update semantics). Returns the new state."""
+        if self.multi_precision and weight.dtype == np.float16:
+            master, base_state = state
+            g32 = NDArray(jnp.asarray(_as_jax(grad), jnp.float32), weight._ctx)
+            w32 = NDArray(master, weight._ctx)
+            new_base = self.update(index, w32, g32, base_state)
+            weight._data = jnp.asarray(w32._data, dtype=jnp.float16)
+            return (w32._data, new_base if new_base is not None else base_state)
+        new_state = self.update(index, weight, grad, state)
+        return new_state if new_state is not None else state
+
+    # ------------------------------------------------------------------
+    # lr / wd plumbing (reference optimizer.py:200-320)
+    # ------------------------------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("LRScheduler of the optimizer has already been defined.")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    # ------------------------------------------------------------------
+    # jit-fused step dispatch
+    # ------------------------------------------------------------------
+    def _preprocess(self, grad, weight, wd):
+        """Shared rescale → clip → weight-decay prologue, traced into the
+        fused kernel (the reference bakes the same sequence into each
+        optimizer_op.cc kernel)."""
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = jnp.clip(grad, -self.clip_gradient, self.clip_gradient)
+        return grad + wd * weight
+
+    def _fused(self, key, fn):
+        """jit-compile ``fn`` once per (variant, rescale_grad, clip) key.
+
+        rescale_grad/clip_gradient are read by the step closures at trace
+        time, so they are part of the cache key: Trainer.step() mutates
+        rescale_grad per batch size, and a changed value must retrace rather
+        than silently reuse the first-traced constant."""
+        key = (key, self.rescale_grad, self.clip_gradient)
+        if key not in self._step_cache:
+            self._step_cache[key] = jax.jit(fn)
+        return self._step_cache[key]
+
+    def __getstate__(self):
+        st = self.__dict__.copy()
+        st["_step_cache"] = {}
+        return st
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+@register
+class Test(Optimizer):
+    """Trivial debug optimizer: w -= lr * grad, state keeps a weight copy
+    (reference optimizer.py:Test)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        return jnp.zeros_like(_as_jax(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        weight._data = _as_jax(weight) - self.learning_rate * _as_jax(grad) * self.rescale_grad
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and multi-precision (reference optimizer.py:445;
+    fused-op parity: sgd_update/sgd_mom_update/mp_sgd_update)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return jnp.zeros_like(_as_jax(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = _f32(self._get_lr(index))
+        wd = _f32(self._get_wd(index))
+        w, g = _as_jax(weight), _as_jax(grad)
+        if state is None:
+            def step(w, g, lr, wd):
+                g = self._preprocess(g, w, wd)
+                return w - lr * g
+            weight._data = self._fused("sgd", step)(w, g, lr, wd)
+        else:
+            def step(w, g, m, lr, wd):
+                g = self._preprocess(g, w, wd)
+                m = self.momentum * m - lr * g
+                return w + m, m
+            weight._data, new_m = self._fused("sgd_mom", step)(w, g, _as_jax(state), lr, wd)
+            return new_m
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference optimizer.py:NAG)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return jnp.zeros_like(_as_jax(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = _f32(self._get_lr(index))
+        wd = _f32(self._get_wd(index))
+        w, g = _as_jax(weight), _as_jax(grad)
+        if state is None:
+            def step(w, g, lr, wd):
+                g = self._preprocess(g, w, wd)
+                return w - lr * g
+            weight._data = self._fused("nag0", step)(w, g, lr, wd)
+        else:
+            def step(w, g, m, lr, wd):
+                g = self._preprocess(g, w, wd)
+                m = self.momentum * m + g
+                g2 = self.momentum * m + g
+                return w - lr * g2, m
+            weight._data, new_m = self._fused("nag", step)(w, g, _as_jax(state), lr, wd)
+            return new_m
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer.py:SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        from . import _global
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = _f32(self._get_wd(index))
+        w, g = _as_jax(weight), _as_jax(grad)
+
+        def step(w, g, key, lr, wd):
+            g = self._preprocess(g, w, wd)
+            noise = jax.random.normal(key, w.shape, dtype=w.dtype) * jnp.sqrt(lr)
+            return w - lr / 2 * g + noise
+
+        weight._data = self._fused("sgld", step)(w, g, _global.next_key(), _f32(lr), wd)
+
+
+@register
+class SignSGD(Optimizer):
+    """Take the sign of the gradient (reference optimizer.py:Signum family)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = _f32(self._get_lr(index))
+        wd = _f32(self._get_wd(index))
+
+        def step(w, g, lr, wd):
+            g = g * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            return w - lr * (jnp.sign(g) + wd * w)
+
+        weight._data = self._fused("signsgd", step)(_as_jax(weight), _as_jax(grad), lr, wd)
+
+
+@register
+class Signum(Optimizer):
+    """Sign of momentum SGD (reference optimizer.py:550)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return jnp.zeros_like(_as_jax(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = _f32(self._get_lr(index))
+        wd = _f32(self._get_wd(index))
+        w, g = _as_jax(weight), _as_jax(grad)
+        if state is None:
+            def step(w, g, lr, wd):
+                g = g * self.rescale_grad
+                if self.clip_gradient is not None:
+                    g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+                return w - lr * (jnp.sign(g) + wd * w)
+            weight._data = self._fused("signsgd", step)(w, g, lr, wd)
+        else:
+            def step(w, g, m, lr, wd):
+                g = self._preprocess(g, w, wd)
+                m = self.momentum * m - (1 - self.momentum) * g
+                return w + lr * jnp.sign(m) - lr * self.wd_lh * w, m
+            weight._data, new_m = self._fused("signum", step)(w, g, _as_jax(state), lr, wd)
+            return new_m
+
+
+@register
+class FTML(Optimizer):
+    """Follow the Moving Leader (reference optimizer.py:616)."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        w = _as_jax(weight)
+        return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = _f32(self._get_lr(index))
+        wd = _f32(self._get_wd(index))
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+
+        def step(w, g, d, v, z, lr, wd, t):
+            g = self._preprocess(g, w, wd)
+            v = b2 * v + (1 - b2) * g * g
+            bc1 = 1 - jnp.power(b1, t)
+            bc2 = 1 - jnp.power(b2, t)
+            d_t = bc1 / lr * (jnp.sqrt(v / bc2) + eps)
+            sigma = d_t - b1 * d
+            z = b1 * z + (1 - b1) * g - sigma * w
+            return -z / d_t, d_t, v, z
+
+        d, v, z = state
+        new_w, d, v, z = self._fused("ftml", step)(
+            _as_jax(weight), _as_jax(grad), d, v, z, lr, wd, _f32(t))
+        weight._data = new_w
+        return (d, v, z)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer.py:DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        w = _as_jax(weight)
+        if self.momentum == 0.0:
+            return (None, jnp.asarray(w))
+        return (jnp.zeros_like(w), jnp.asarray(w))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = _f32(self._get_lr(index))
+        wd = _f32(self._get_wd(index))
+        mom, prev = state
+        w, g = _as_jax(weight), _as_jax(grad)
+
+        if mom is None:
+            def step(w, g, prev, lr, wd):
+                g = self._preprocess(g, w, wd)
+                upd = -lr * (g + self.lamda * g * g * (w - prev))
+                return w + upd, w
+            new_w, new_prev = self._fused("dcasgd0", step)(w, g, prev, lr, wd)
+            weight._data = new_w
+            return (None, new_prev)
+
+        def step(w, g, m, prev, lr, wd):
+            g = self._preprocess(g, w, wd)
+            m = self.momentum * m - lr * (g + self.lamda * g * g * (w - prev))
+            return w + m, m, w
+
+        new_w, new_m, new_prev = self._fused("dcasgd", step)(w, g, mom, prev, lr, wd)
+        weight._data = new_w
+        return (new_m, new_prev)
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-batch SGD with LARS-style layer-wise adaptive rate
+    (reference optimizer.py:672, simplified to the lars core)."""
+
+    def __init__(self, momentum=0.0, multi_precision=False, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32, begin_epoch=0,
+                 num_epochs=60, **kwargs):
+        super().__init__(multi_precision=multi_precision, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return jnp.zeros_like(_as_jax(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = _f32(self._get_lr(index))
+        wd = _f32(self._get_wd(index))
+        w, g = _as_jax(weight), _as_jax(grad)
+
+        def step(w, g, m, lr, wd):
+            g = self._preprocess(g, w, wd)
+            wnorm = jnp.linalg.norm(w.ravel())
+            gnorm = jnp.linalg.norm(g.ravel())
+            lars = jnp.where(
+                (wnorm > 0) & (gnorm > 0), wnorm / (gnorm + 1e-9), 1.0)
+            eff_lr = lr * lars
+            if m is None:
+                return w - eff_lr * g, jnp.zeros(())
+            m = self.momentum * m - eff_lr * g
+            return w + m, m
+
+        if state is None:
+            new_w, _ = self._fused("lbsgd0", step)(w, g, None, lr, wd)
+            weight._data = new_w
+        else:
+            new_w, new_m = self._fused("lbsgd", step)(w, g, state, lr, wd)
+            weight._data = new_w
+            return new_m
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference optimizer.py:1014; fused-op parity adam_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        w = _as_jax(weight)
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        wd = _f32(self._get_wd(index))
+        lr = lr * math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+
+        def step(w, g, m, v, lr, wd):
+            g = self._preprocess(g, w, wd)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            return w - lr * m / (jnp.sqrt(v) + eps), m, v
+
+        m, v = state
+        new_w, m, v = self._fused("adam", step)(
+            _as_jax(weight), _as_jax(grad), m, v, _f32(lr), wd)
+        weight._data = new_w
+        return (m, v)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference optimizer.py:AdaGrad; sparse lazy path collapses to
+    dense — XLA has no sparse, SURVEY §7.3)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return jnp.zeros_like(_as_jax(weight))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = _f32(self._get_lr(index))
+        wd = _f32(self._get_wd(index))
+        eps = self.float_stable_eps
+
+        def step(w, g, h, lr, wd):
+            g = self._preprocess(g, w, wd)
+            h = h + g * g
+            return w - lr * g / jnp.sqrt(h + eps), h
+
+        new_w, new_h = self._fused("adagrad", step)(
+            _as_jax(weight), _as_jax(grad), _as_jax(state), lr, wd)
+        weight._data = new_w
+        return new_h
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, centered (Graves) and plain (reference optimizer.py:1155)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        w = _as_jax(weight)
+        if self.centered:
+            return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros_like(w))
+        return (jnp.zeros_like(w),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = _f32(self._get_lr(index))
+        wd = _f32(self._get_wd(index))
+        g1, g2, eps = self.gamma1, self.gamma2, self.epsilon
+        cw = self.clip_weights
+
+        if not self.centered:
+            def step(w, g, n, lr, wd):
+                g = self._preprocess(g, w, wd)
+                n = (1 - g1) * g * g + g1 * n
+                w = w - lr * g / jnp.sqrt(n + eps)
+                if cw:
+                    w = jnp.clip(w, -cw, cw)
+                return w, n
+            new_w, n = self._fused("rmsprop", step)(
+                _as_jax(weight), _as_jax(grad), state[0], lr, wd)
+            weight._data = new_w
+            return (n,)
+
+        def step(w, g, n, mg, delta, lr, wd):
+            g = self._preprocess(g, w, wd)
+            n = (1 - g1) * g * g + g1 * n
+            mg = (1 - g1) * g + g1 * mg
+            delta = g2 * delta - lr * g / jnp.sqrt(n - mg * mg + eps)
+            w = w + delta
+            if cw:
+                w = jnp.clip(w, -cw, cw)
+            return w, n, mg, delta
+
+        n, mg, delta = state
+        new_w, n, mg, delta = self._fused("rmsprop_c", step)(
+            _as_jax(weight), _as_jax(grad), n, mg, delta, lr, wd)
+        weight._data = new_w
+        return (n, mg, delta)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference optimizer.py:AdaDelta)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        w = _as_jax(weight)
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = _f32(self._get_wd(index))
+        rho, eps = self.rho, self.epsilon
+
+        def step(w, g, acc_g, acc_d, wd):
+            g = self._preprocess(g, w, wd)
+            acc_g = rho * acc_g + (1 - rho) * g * g
+            delta = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g + eps) * g
+            acc_d = rho * acc_d + (1 - rho) * delta * delta
+            return w - delta, acc_g, acc_d
+
+        acc_g, acc_d = state
+        new_w, acc_g, acc_d = self._fused("adadelta", step)(
+            _as_jax(weight), _as_jax(grad), acc_g, acc_d, wd)
+        weight._data = new_w
+        return (acc_g, acc_d)
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference optimizer.py:Ftrl; fused ftrl_update parity)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        w = _as_jax(weight)
+        return (jnp.zeros_like(w), jnp.zeros_like(w))  # (z, n)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = _f32(self._get_lr(index))
+        wd = _f32(self._get_wd(index))
+        l1, beta = self.lamda1, self.beta
+
+        def step(w, g, z, n, lr, wd):
+            g = g * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr
+            z = z + g - sigma * w
+            n = n + g * g
+            w = jnp.where(
+                jnp.abs(z) > l1,
+                -(z - jnp.sign(z) * l1) / ((beta + jnp.sqrt(n)) / lr + wd),
+                0.0,
+            ).astype(w.dtype)
+            return w, z, n
+
+        z, n = state
+        new_w, z, n = self._fused("ftrl", step)(
+            _as_jax(weight), _as_jax(grad), z, n, lr, wd)
+        weight._data = new_w
+        return (z, n)
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax (reference optimizer.py:Adamax)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        w = _as_jax(weight)
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
+        wd = _f32(self._get_wd(index))
+        b1, b2 = self.beta1, self.beta2
+
+        def step(w, g, m, u, lr, wd):
+            g = self._preprocess(g, w, wd)
+            m = b1 * m + (1 - b1) * g
+            u = jnp.maximum(b2 * u, jnp.abs(g))
+            return w - lr * m / (u + 1e-8), m, u
+
+        m, u = state
+        new_w, m, u = self._fused("adamax", step)(
+            _as_jax(weight), _as_jax(grad), m, u, _f32(lr), wd)
+        weight._data = new_w
+        return (m, u)
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (reference optimizer.py:Nadam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        w = _as_jax(weight)
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = _f32(self._get_lr(index))
+        wd = _f32(self._get_wd(index))
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+
+        momentum_t = b1 * (1.0 - 0.5 * (0.96 ** (t * self.schedule_decay)))
+        momentum_t_1 = b1 * (1.0 - 0.5 * (0.96 ** ((t + 1) * self.schedule_decay)))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+
+        # time-varying scalars enter as traced args so the kernel compiles once
+        def step(w, g, m, v, lr, wd, t, mt, mt1, ms, msn):
+            g = self._preprocess(g, w, wd)
+            g_prime = g / (1.0 - ms)
+            m = b1 * m + (1.0 - b1) * g
+            m_prime = m / (1.0 - msn)
+            v = b2 * v + (1.0 - b2) * g * g
+            v_prime = v / (1.0 - jnp.power(b2, t))
+            m_bar = (1.0 - mt) * g_prime + mt1 * m_prime
+            return w - lr * m_bar / (jnp.sqrt(v_prime) + eps), m, v
+
+        m, v = state
+        new_w, m, v = self._fused("nadam", step)(
+            _as_jax(weight), _as_jax(grad), m, v, lr, wd, _f32(t),
+            _f32(momentum_t), _f32(momentum_t_1), _f32(self.m_schedule),
+            _f32(m_schedule_next))
+        weight._data = new_w
+        return (m, v)
+
+
+# ---------------------------------------------------------------------------
+# Updater (reference optimizer.py:1506)
+# ---------------------------------------------------------------------------
+
+
+class Updater(object):
+    """Applies an optimizer to (index, grad, weight) triples, owning the
+    per-index state dict — reference optimizer.py:Updater (get_updater)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.states[index] = self.optimizer.update_multi_precision(
+            index, weight, grad, self.states[index])
+
+    def sync_state_context(self, state, context):
+        return state
+
+    def set_states(self, states):
+        """Restore states from :meth:`get_states` bytes."""
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        # stored as numpy; rehydrate to jax on first use
+        self.states = {
+            k: jax.tree_util.tree_map(
+                lambda a: jnp.asarray(a) if isinstance(a, np.ndarray) else a, v)
+            for k, v in self.states.items()
+        }
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        """Serialize states (optionally with the optimizer) to bytes."""
+        host_states = {
+            k: jax.tree_util.tree_map(
+                lambda a: np.asarray(a) if isinstance(a, jnp.ndarray) else a, v)
+            for k, v in self.states.items()
+        }
+        return pickle.dumps((host_states, self.optimizer) if dump_optimizer else host_states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

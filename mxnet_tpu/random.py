@@ -19,8 +19,18 @@ multinomial = _ndrandom.multinomial
 shuffle = _ndrandom.shuffle
 
 
+_NP_RNG = np.random.RandomState()
+
+
+def np_rng() -> np.random.RandomState:
+    """Host-side RNG stream used for one-time setup work (weight init,
+    dataset shuffling); seeded together with the device stream."""
+    return _NP_RNG
+
+
 def seed(seed_state, ctx="all"):
     """Seed the global RNG stream (reference mx.random.seed; per-ctx seeding
     collapses to one stream because jax PRNG keys are device-agnostic)."""
     _global.seed(seed_state)
     np.random.seed(seed_state % (2**32))
+    _NP_RNG.seed(seed_state % (2**32))

@@ -1,0 +1,735 @@
+"""Symbol: declarative graph construction.
+
+Re-design of the reference's nnvm-based Symbol (`python/mxnet/symbol/
+symbol.py`, `3rdparty/tvm/nnvm` Symbol/Graph). The graph is a lightweight
+Python DAG over the op registry; JSON (de)serialization keeps the reference's
+``*-symbol.json`` format (SURVEY.md Appendix B: nodes/arg_nodes/heads with
+``[node_id, out_idx, version]`` inputs) so model-zoo artifacts round-trip.
+Execution lowers the WHOLE graph into one jitted XLA computation via
+``executor.Executor`` — the north-star translation of GraphExecutor
+(SURVEY.md §7.1).
+"""
+from __future__ import annotations
+
+import json
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .name import NameManager
+from .ops.registry import OP_REGISTRY, get_op
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json", "zeros",
+           "ones", "arange"]
+
+
+class _Node:
+    """One graph node: an op application or a variable (op=None)."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "_extra_attrs")
+
+    def __init__(self, op: Optional[str], name: str, attrs: Dict[str, Any],
+                 inputs: List[Tuple["_Node", int]]):
+        self.op = op
+        self.name = name
+        self.attrs = attrs
+        self.inputs = inputs
+        self._extra_attrs = {}  # user attrs (__lr_mult__ etc.)
+
+    def is_var(self):
+        return self.op is None
+
+    def num_outputs(self) -> int:
+        if self.op is None:
+            return 1
+        opdef = get_op(self.op)
+        return opdef.num_outputs(opdef.parse_attrs(self.attrs))
+
+
+# which op inputs are auxiliary states (not gradient targets) — the
+# counterpart of the reference's FMutateInputs-marked aux (BatchNorm moving
+# stats, reference src/operator/nn/batch_norm.cc)
+_AUX_INPUT_NAMES = {"moving_mean", "moving_var", "running_mean", "running_var"}
+
+
+class Symbol(object):
+    """Multi-output symbolic handle (reference symbol.py:54)."""
+
+    def __init__(self, outputs: List[Tuple[_Node, int]]):
+        self._outputs = list(outputs)
+
+    # ------------------------------------------------------------------
+    # identity / composition
+    # ------------------------------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def attr(self, key):
+        node = self._outputs[0][0]
+        return node._extra_attrs.get(key)
+
+    def _set_attr(self, **kwargs):
+        self._outputs[0][0]._extra_attrs.update(
+            {k: str(v) for k, v in kwargs.items()})
+
+    def attr_dict(self):
+        """name → attr dict for all nodes (reference symbol.py:attr_dict)."""
+        ret = {}
+        for node in self._topo_nodes():
+            d = {}
+            if node.op is not None:
+                d.update({k: str(v) for k, v in _str_attrs(node).items()})
+            d.update(node._extra_attrs)
+            if d:
+                ret[node.name] = d
+        return ret
+
+    def __repr__(self):
+        if len(self._outputs) == 1:
+            return "<Symbol %s>" % self.name
+        return "<Symbol group [%s]>" % ", ".join(
+            n.name for n, _ in self._outputs)
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self)))
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if names.count(index) != 1:
+                raise MXNetError(
+                    "There are multiple outputs with name \"%s\"" % index
+                    if index in names else
+                    "Cannot find output that matches name \"%s\"" % index)
+            index = names.index(index)
+        if isinstance(index, slice):
+            return Group([self[i] for i in range(*index.indices(len(self)))])
+        if index >= len(self):
+            raise IndexError
+        return Symbol([self._outputs[index]])
+
+    def get_internals(self):
+        """Symbol grouping every internal output (reference
+        symbol.py:get_internals)."""
+        outs = []
+        for node in self._topo_nodes():
+            for i in range(node.num_outputs()):
+                outs.append((node, i))
+        return Group([Symbol([o]) for o in outs])
+
+    def get_children(self):
+        nodes = {id(n) for n, _ in self._outputs}
+        children = []
+        for n, _ in self._outputs:
+            children.extend(n.inputs)
+        if not children:
+            return None
+        return Symbol(children)
+
+    # -- operators ----------------------------------------------------------
+    def _binop(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _invoke(op, [a, b], {})
+        if isinstance(other, (int, float, np.generic)):
+            return _invoke(scalar_op, [self], {"scalar": float(other)})
+        raise TypeError("cannot combine Symbol with %r" % (other,))
+
+    def __add__(self, o):
+        return self._binop(o, "elemwise_add" if isinstance(o, Symbol) else "_plus",
+                           "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        if isinstance(o, (int, float, np.generic)):
+            return _invoke("_rminus_scalar", [self], {"scalar": float(o)})
+        return self._binop(o, "elemwise_sub", "_minus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "elemwise_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        if isinstance(o, (int, float, np.generic)):
+            return _invoke("_rdiv_scalar", [self], {"scalar": float(o)})
+        return self._binop(o, "elemwise_div", "_div_scalar", reverse=True)
+
+    def __pow__(self, o):
+        return self._binop(o, "_power", "_power_scalar")
+
+    def __neg__(self):
+        return _invoke("negative", [self], {})
+
+    def __copy__(self):
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, _):
+        return load_json(self.tojson())
+
+    # ------------------------------------------------------------------
+    # graph traversal
+    # ------------------------------------------------------------------
+    def _topo_nodes(self) -> List[_Node]:
+        seen = set()
+        order: List[_Node] = []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for inp, _ in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for n, _ in self._outputs:
+            visit(n)
+        return order
+
+    def list_arguments(self) -> List[str]:
+        """Variable names excluding aux (reference symbol.py:list_arguments)."""
+        args = []
+        for node in self._topo_nodes():
+            if node.is_var() and not _is_aux_node(node, self):
+                args.append(node.name)
+        return args
+
+    def list_outputs(self) -> List[str]:
+        outs = []
+        for node, idx in self._outputs:
+            if node.is_var():
+                outs.append(node.name)
+            elif node.num_outputs() == 1:
+                outs.append(node.name + "_output")
+            else:
+                outs.append("%s_output%d" % (node.name, idx))
+        return outs
+
+    def list_auxiliary_states(self) -> List[str]:
+        aux = []
+        for node in self._topo_nodes():
+            if node.is_var() and _is_aux_node(node, self):
+                aux.append(node.name)
+        return aux
+
+    def list_inputs(self):
+        return [n.name for n in self._topo_nodes() if n.is_var()]
+
+    # ------------------------------------------------------------------
+    # shape/type inference — runs jax.eval_shape over the traced graph,
+    # the counterpart of the reference's InferShape/InferType passes
+    # (exec_pass.h:175-201) with zero hand-written per-op shape functions.
+    # ------------------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+        except Exception as e:
+            raise MXNetError("infer_shape error: %s" % e)
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        import jax
+
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        known: Dict[str, Tuple] = {}
+        if args:
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        for k, v in kwargs.items():
+            if v is not None:
+                known[k] = tuple(v)
+
+        # iterative local propagation: trace each node with eval_shape once
+        # all its input shapes are known; parameter-input shapes (weights,
+        # norm scales) are back-filled from the op's attrs + data shape —
+        # the counterpart of the reference's bidirectional InferShape pass
+        shapes: Dict[Tuple[int, int], Optional[Tuple]] = {}
+        dtypes: Dict[Tuple[int, int], Any] = {}
+        for node in self._topo_nodes():
+            if node.is_var():
+                shp = known.get(node.name)
+                if shp is None and node._extra_attrs.get("__shape__"):
+                    shp = tuple(json.loads(node._extra_attrs["__shape__"]))
+                shapes[(id(node), 0)] = shp
+                dtypes[(id(node), 0)] = np.float32
+                continue
+            in_shapes = [shapes.get((id(n), i)) for n, i in node.inputs]
+            if any(s is None for s in in_shapes):
+                filled = _fill_param_shapes(node, in_shapes)
+                if filled is not None:
+                    for (src, si), s_old, s_new in zip(node.inputs, in_shapes, filled):
+                        if s_old is None and s_new is not None:
+                            shapes[(id(src), si)] = s_new
+                    in_shapes = filled
+            if any(s is None for s in in_shapes):
+                for i in range(node.num_outputs()):
+                    shapes[(id(node), i)] = None
+                continue
+            opdef = get_op(node.op)
+            attrs = opdef.parse_attrs(node.attrs)
+            specs = [jax.ShapeDtypeStruct(s, dtypes.get((id(n), i), np.float32) or np.float32)
+                     for s, (n, i) in zip(in_shapes, node.inputs)]
+            try:
+                out = jax.eval_shape(lambda *xs: opdef.fcompute(attrs, *xs), *specs)
+            except Exception as e:
+                if partial:
+                    for i in range(node.num_outputs()):
+                        shapes[(id(node), i)] = None
+                    continue
+                raise MXNetError(
+                    "shape inference failed at op %s(%s): %s"
+                    % (node.op, node.name, e))
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for i, o in enumerate(outs):
+                shapes[(id(node), i)] = tuple(o.shape)
+                dtypes[(id(node), i)] = o.dtype
+
+        arg_shapes = [shapes.get((id(n), 0)) for n in self._topo_nodes()
+                      if n.is_var() and not _is_aux_node(n, self)]
+        out_shapes = [shapes.get((id(n), i)) for n, i in self._outputs]
+        aux_shapes = []
+        for node in self._topo_nodes():
+            if node.is_var() and _is_aux_node(node, self):
+                shp = shapes.get((id(node), 0))
+                if shp is None:
+                    # aux shape mirrors the op's expectation; infer from the
+                    # consuming node's sibling input (gamma)
+                    shp = _guess_aux_shape(node, shapes, self)
+                aux_shapes.append(shp)
+        if not partial and any(s is None for s in out_shapes):
+            raise MXNetError("infer_shape: insufficient information")
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        dtype = np.float32
+        if args and args[0] is not None:
+            dtype = args[0]
+        arg_types = [np.dtype(dtype) for _ in arg_names]
+        out_types = [np.dtype(dtype) for _ in self._outputs]
+        aux_types = [np.dtype(np.float32) for _ in self.list_auxiliary_states()]
+        return arg_types, out_types, aux_types
+
+    # ------------------------------------------------------------------
+    # JSON (reference *-symbol.json format, Appendix B)
+    # ------------------------------------------------------------------
+    def tojson(self) -> str:
+        nodes = self._topo_nodes()
+        node_ids = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        arg_nodes = []
+        for i, n in enumerate(nodes):
+            if n.is_var():
+                arg_nodes.append(i)
+            entry = {
+                "op": n.op if n.op is not None else "null",
+                "name": n.name,
+                "inputs": [[node_ids[id(src)], idx, 0] for src, idx in n.inputs],
+            }
+            attrs = _str_attrs(n)
+            if n._extra_attrs:
+                attrs = dict(attrs)
+                attrs.update(n._extra_attrs)
+            if attrs:
+                entry["attrs"] = {k: str(v) for k, v in attrs.items()}
+            out_nodes.append(entry)
+        heads = [[node_ids[id(n)], idx, 0] for n, idx in self._outputs]
+        js = {
+            "nodes": out_nodes,
+            "arg_nodes": arg_nodes,
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": heads,
+            "attrs": {"mxnet_version": ["int", 10300]},
+        }
+        return json.dumps(js, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # ------------------------------------------------------------------
+    # evaluation / binding
+    # ------------------------------------------------------------------
+    def eval_jax(self, value_map: Dict[str, Any], is_train=False,
+                 aux_updates: Optional[Dict[str, Any]] = None):
+        """Evaluate outputs as jax arrays given name→jax value bindings.
+        Traced under jit by the Executor. When ``aux_updates`` is a dict, BN
+        moving-stat updates (reference FMutateInputs semantics) are recorded
+        into it keyed by the aux variable name."""
+        from . import _global
+
+        vals: Dict[Tuple[int, int], Any] = {}
+        for node in self._topo_nodes():
+            if node.is_var():
+                if node.name not in value_map:
+                    raise MXNetError("eval: missing binding for %r" % node.name)
+                vals[(id(node), 0)] = value_map[node.name]
+                continue
+            opdef = get_op(node.op)
+            attrs = opdef.parse_attrs(node.attrs)
+            inputs = [vals[(id(n), i)] for n, i in node.inputs]
+            out = opdef.fcompute(attrs, *inputs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for i, o in enumerate(outs):
+                vals[(id(node), i)] = o
+            if (aux_updates is not None and node.op == "BatchNorm"
+                    and _global.is_train() and not attrs.get("use_global_stats")):
+                m = attrs.get("momentum", 0.9)
+                in_names = opdef.input_names(attrs)
+                for slot, stat in (("moving_mean", outs[1]), ("moving_var", outs[2])):
+                    k = in_names.index(slot)
+                    src_node, _ = node.inputs[k]
+                    if src_node.is_var():
+                        old = vals[(id(src_node), 0)]
+                        aux_updates[src_node.name] = m * old + (1 - m) * stat
+        return [vals[(id(n), i)] for n, i in self._outputs]
+
+    def eval_nd(self, arg_dict, ctx=None):
+        """Eager evaluation from NDArray bindings (SymbolBlock path)."""
+        from .ndarray.ndarray import NDArray
+
+        ctx = ctx or current_context()
+        vm = {}
+        for k, v in arg_dict.items():
+            vm[k] = v._data if isinstance(v, NDArray) else v
+        outs = self.eval_jax(vm)
+        nd_outs = [NDArray(o, ctx) for o in outs]
+        return nd_outs[0] if len(nd_outs) == 1 else nd_outs
+
+    def eval(self, ctx=None, **kwargs):
+        """Reference symbol.py:eval — bind + forward in one call."""
+        return self.eval_nd(kwargs, ctx)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None):
+        from .executor import Executor
+
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def simple_bind(self, ctx, grad_req="write", type_dict=None, stype_dict=None,
+                    group2ctx=None, shared_arg_names=None, shared_exec=None,
+                    shared_buffer=None, **kwargs):
+        """Allocate argument arrays automatically from shapes
+        (reference symbol.py:1289 → GraphExecutor::Init)."""
+        from .executor import Executor
+        from .ndarray import ndarray as nd_mod
+
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        if any(s is None for s in arg_shapes):
+            missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+            raise MXNetError("simple_bind: cannot infer shapes for %s" % missing)
+        type_dict = type_dict or {}
+        args = {}
+        args_grad = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            dt = type_dict.get(name, np.float32)
+            args[name] = nd_mod.zeros(shape, ctx=ctx, dtype=dt)
+            if grad_req != "null":
+                args_grad[name] = nd_mod.zeros(shape, ctx=ctx, dtype=dt)
+        aux_states = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            aux_states[name] = nd_mod.zeros(shape, ctx=ctx)
+        return Executor(self, ctx, args, args_grad if grad_req != "null" else None,
+                        grad_req, aux_states)
+
+    # -- gradient graph (reference nnvm Gradient pass) ----------------------
+    def grad(self, wrt):
+        raise MXNetError(
+            "Symbol.grad is not supported; gradients come from "
+            "Executor.backward (whole-graph XLA vjp)")
+
+    def save_checkpoint_compatible(self):
+        return True
+
+
+def _str_attrs(node: _Node) -> Dict[str, str]:
+    if node.op is None:
+        out = {}
+        return out
+    opdef = get_op(node.op)
+    return opdef.serialize_attrs(opdef.parse_attrs(node.attrs))
+
+
+def _is_aux_node(node: _Node, sym: Symbol) -> bool:
+    """A variable is an aux state if any consumer binds it to an aux-named
+    op input slot (moving_mean/moving_var — reference FMutateInputs)."""
+    if getattr(node, "_forced_aux", False):
+        return True
+    for n in sym._topo_nodes():
+        if n.is_var():
+            continue
+        opdef = get_op(n.op)
+        in_names = opdef.input_names(opdef.parse_attrs(n.attrs))
+        for (src, _), iname in zip(n.inputs, in_names):
+            if src is node and iname in _AUX_INPUT_NAMES:
+                return True
+    return False
+
+
+def _guess_aux_shape(node, shapes, sym):
+    for n in sym._topo_nodes():
+        if n.is_var():
+            continue
+        for k, (src, _) in enumerate(n.inputs):
+            if src is node and k >= 1:
+                sib = n.inputs[1][0]
+                s = shapes.get((id(sib), 0))
+                if s is not None:
+                    return s
+    return None
+
+
+def _fill_param_shapes(node: _Node, in_shapes):
+    """Back-fill unknown parameter-input shapes from op attrs + data shape
+    (reference per-op InferShape, e.g. src/operator/nn/fully_connected.cc).
+    Returns a filled copy of in_shapes, or None if this op has no hint."""
+    op = node.op
+    opdef = get_op(op)
+    attrs = opdef.parse_attrs(node.attrs)
+    in_names = opdef.input_names(attrs)
+    named = dict(zip(in_names, in_shapes))
+    data = named.get("data")
+    out = list(in_shapes)
+
+    def put(slot, shape):
+        if slot in in_names and named.get(slot) is None and shape is not None:
+            out[in_names.index(slot)] = tuple(int(s) for s in shape)
+
+    if op == "FullyConnected" and data is not None:
+        in_units = int(np.prod(data[1:])) if attrs.flatten else data[-1]
+        put("weight", (attrs.num_hidden, in_units))
+        put("bias", (attrs.num_hidden,))
+    elif op in ("Convolution",) and data is not None:
+        layout = attrs.layout or ""
+        c = data[1] if not layout or layout.startswith("NC") else data[-1]
+        put("weight", (attrs.num_filter, c // attrs.num_group) + tuple(attrs.kernel))
+        put("bias", (attrs.num_filter,))
+    elif op == "Deconvolution" and data is not None:
+        layout = attrs.layout or ""
+        c = data[1] if not layout or layout.startswith("NC") else data[-1]
+        put("weight", (c, attrs.num_filter // attrs.num_group) + tuple(attrs.kernel))
+        put("bias", (attrs.num_filter,))
+    elif op in ("BatchNorm", "InstanceNorm") and data is not None:
+        ax = attrs.get("axis", 1)
+        c = (data[ax % len(data)],)
+        for slot in ("gamma", "beta", "moving_mean", "moving_var"):
+            put(slot, c)
+    elif op == "LayerNorm" and data is not None:
+        ax = attrs.get("axis", -1)
+        c = (data[ax % len(data)],)
+        put("gamma", c)
+        put("beta", c)
+    elif op == "Embedding":
+        put("weight", (attrs.input_dim, attrs.output_dim))
+    elif op == "LeakyReLU" and data is not None and attrs.get("act_type") == "prelu":
+        put("gamma", (data[1] if len(data) > 1 else data[0],))
+    elif op == "RNN" and data is not None:
+        from .ops.nn import rnn_param_size
+
+        put("parameters", (rnn_param_size(
+            attrs.mode, data[2], attrs.state_size, attrs.num_layers,
+            attrs.bidirectional),))
+        D = 2 if attrs.bidirectional else 1
+        st = (attrs.num_layers * D, data[1], attrs.state_size)
+        put("state", st)
+        put("state_cell", st)
+    elif op in ("SoftmaxOutput", "LinearRegressionOutput",
+                "LogisticRegressionOutput", "MAERegressionOutput",
+                "SVMOutput") and data is not None:
+        put("label", data[:-1] if op == "SoftmaxOutput" else data)
+    else:
+        return None
+    return out
+
+
+def _invoke(op_name: str, sym_inputs: List[Symbol], attrs: Dict[str, Any],
+            name: Optional[str] = None) -> Symbol:
+    opdef = get_op(op_name)
+    parsed = opdef.parse_attrs(attrs)
+    hint = op_name.lower().lstrip("_")
+    name = NameManager.current().get(name, hint)
+    entries: List[Tuple[_Node, int]] = []
+    for s in sym_inputs:
+        if len(s._outputs) != 1:
+            # multi-output symbol used as single input: take all outputs
+            entries.extend(s._outputs)
+        else:
+            entries.append(s._outputs[0])
+
+    # auto-create missing trailing inputs as variables (MXNet behavior:
+    # FullyConnected(data) creates name_weight/name_bias vars)
+    in_names = opdef.input_names(parsed)
+    if len(entries) < len(in_names):
+        for missing in in_names[len(entries):]:
+            vnode = _Node(None, "%s_%s" % (name, missing), {}, [])
+            entries.append((vnode, 0))
+    node = _Node(op_name, name, dict(attrs), entries)
+    n_out = opdef.num_outputs(parsed)
+    # primary output only for multi-output layer ops whose extra outputs are
+    # internal (BatchNorm mean/var); SliceChannel-style ops expose all
+    outputs = [(node, i) for i in range(n_out)]
+    if op_name in ("BatchNorm", "LayerNorm") :
+        outputs = [(node, 0)]
+    return Symbol(outputs)
+
+
+def _make_sym_op(op_name: str):
+    opdef = OP_REGISTRY[op_name]
+    param_names = list(opdef.params.keys())
+
+    def fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        attr = kwargs.pop("attr", None)
+        sym_inputs = []
+        scalars = []
+        for a in args:
+            if isinstance(a, Symbol):
+                sym_inputs.append(a)
+            else:
+                scalars.append(a)
+        # keyword Symbol inputs (data=..., weight=...)
+        in_names = opdef.input_names(opdef.parse_attrs(
+            {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}))
+        kw_syms = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+        for k in kw_syms:
+            kwargs.pop(k)
+        if kw_syms and not sym_inputs:
+            sym_inputs = [kw_syms[n] for n in in_names if n in kw_syms]
+        elif kw_syms:
+            sym_inputs.extend(kw_syms[n] for n in in_names if n in kw_syms)
+        if scalars:
+            free = [p for p in param_names if p not in kwargs]
+            for p, v in zip(free, scalars):
+                kwargs[p] = v
+        out = _invoke(op_name, sym_inputs, kwargs, name=name)
+        if attr:
+            out._set_attr(**attr)
+        return out
+
+    fn.__name__ = op_name
+    fn.__qualname__ = op_name
+    fn.__doc__ = opdef.doc
+    return fn
+
+
+def invoke(op_name, *sym_inputs, **kwargs):
+    """Symbol-side counterpart of nd.invoke (used by hybrid_forward F=symbol)."""
+    name = kwargs.pop("name", None)
+    return _invoke(op_name, list(sym_inputs), kwargs, name=name)
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs) -> Symbol:
+    """Create a variable (reference symbol.py:var)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    node = _Node(None, name, {}, [])
+    sym = Symbol([(node, 0)])
+    extra = {}
+    if shape is not None:
+        extra["__shape__"] = json.dumps(list(shape))
+    if lr_mult is not None:
+        extra["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        extra["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        from .base import dtype_name
+
+        extra["__dtype__"] = dtype_name(dtype)
+    if init is not None:
+        if not isinstance(init, str):
+            init = init.dumps()
+        extra["__init__"] = init
+    if attr:
+        extra.update({k: str(v) for k, v in attr.items()})
+    for k, v in kwargs.items():
+        if k.startswith("__") and k.endswith("__"):
+            extra[k] = str(v)
+    node._extra_attrs = extra
+    return sym
+
+
+Variable = var
+
+
+def Group(symbols) -> Symbol:
+    """Group symbols into one multi-output Symbol (reference symbol.py:Group)."""
+    if not symbols or any(not isinstance(s, Symbol) for s in symbols):
+        raise TypeError("Expected a list of symbols as input")
+    outputs = []
+    for s in symbols:
+        outputs.extend(s._outputs)
+    return Symbol(outputs)
+
+
+def load(fname) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str: str) -> Symbol:
+    """Parse reference-format symbol JSON (legacy versions upgraded like
+    src/nnvm/legacy_json_util.cc: accepts 'attr' or 'attrs' or 'param')."""
+    js = json.loads(json_str)
+    nodes_js = js["nodes"]
+    nodes: List[_Node] = []
+    for nj in nodes_js:
+        op = nj["op"]
+        attrs = nj.get("attrs", nj.get("attr", nj.get("param", {}))) or {}
+        if op == "null":
+            node = _Node(None, nj["name"], {}, [])
+            node._extra_attrs = dict(attrs)
+        else:
+            if op not in OP_REGISTRY:
+                raise MXNetError("symbol JSON references unknown op %r" % op)
+            inputs = [(nodes[i], idx) for i, idx, *_ in nj.get("inputs", [])]
+            node = _Node(op, nj["name"], dict(attrs), inputs)
+        nodes.append(node)
+    heads = [(nodes[i], idx) for i, idx, *_ in js["heads"]]
+    return Symbol(heads)
+
+
+def zeros(shape, dtype=None, **kwargs):
+    return _invoke("_zeros", [], {"shape": shape}, name=kwargs.get("name"))
+
+
+def ones(shape, dtype=None, **kwargs):
+    return _invoke("_ones", [], {"shape": shape}, name=kwargs.get("name"))
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype=None, **kwargs):
+    return _invoke("_arange", [], {"start": start, "stop": stop, "step": step,
+                                   "repeat": repeat}, name=kwargs.get("name"))
+
+
+# generated op wrappers: sym.FullyConnected(...), sym.relu(...) etc.
+import sys as _sys  # noqa: E402
+
+_mod = _sys.modules[__name__]
+for _opname in list(OP_REGISTRY):
+    if not hasattr(_mod, _opname):
+        setattr(_mod, _opname, _make_sym_op(_opname))

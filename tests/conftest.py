@@ -4,7 +4,7 @@ reference's `--launcher local` single-host distributed tests, SURVEY.md §4.2).
 Must set env before jax initializes."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: kernel env pins axon otherwise
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,385 @@
+"""Gluon tests — mirrors reference tests/python/unittest/test_gluon.py
+strategy: parameter lifecycle, block composition, hybridize consistency,
+layer shape/numerics checks, trainer convergence, save/load round-trips."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=mx.cpu())
+    assert p.name == "weight"
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    assert p.list_ctx() == [mx.cpu()]
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(Exception):
+        p.data()
+
+
+def test_parameter_dict():
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    params.save("/tmp/test_paramdict.params")
+    params.load("/tmp/test_paramdict.params", mx.cpu())
+    # shared dict
+    shared = gluon.ParameterDict("net_", shared=params)
+    p2 = shared.get("weight")
+    assert p2 is params["net_weight"]
+
+
+def test_constant_param():
+    const = np.random.uniform(size=(2, 2)).astype(np.float32)
+
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.c = self.params.get_constant("const", const)
+
+        def hybrid_forward(self, F, x, c):
+            return x + c
+
+    net = Net()
+    net.initialize()
+    x = mx.nd.zeros((2, 2))
+    out = net(x)
+    np.testing.assert_allclose(out.asnumpy(), const)
+    # constants get no gradient
+    with autograd.record():
+        y = net(x)
+    assert net.c.grad_req == "null"
+
+
+def test_dense():
+    net = nn.Dense(5, use_bias=True, flatten=True, in_units=4)
+    net.initialize()
+    x = mx.nd.ones((3, 4))
+    out = net(x)
+    assert out.shape == (3, 5)
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    np.testing.assert_allclose(out.asnumpy(), np.ones((3, 4)) @ w.T + b, rtol=1e-5)
+    # no flatten: applies to last dim
+    net2 = nn.Dense(5, flatten=False)
+    net2.initialize()
+    assert net2(mx.nd.ones((2, 3, 4))).shape == (2, 3, 5)
+
+
+def test_deferred_init_and_reinit():
+    net = nn.Dense(5)
+    net.initialize()
+    assert net.weight.shape == (5, 0)
+    net(mx.nd.ones((2, 7)))
+    assert net.weight.shape == (5, 7)
+
+
+def test_sequential_and_getitem():
+    net = nn.Sequential()
+    net.add(nn.Dense(4), nn.Dense(3), nn.Dense(2))
+    assert len(net) == 3
+    net.initialize()
+    out = net(mx.nd.ones((1, 5)))
+    assert out.shape == (1, 2)
+    sliced = net[1:]
+    assert len(sliced) == 2
+
+
+def test_hybrid_consistency():
+    def make():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(8, activation="tanh"),
+                    nn.LayerNorm(),
+                    nn.Dense(4))
+        return net
+
+    mx.random.seed(7)
+    net = make()
+    net.initialize()
+    x = mx.nd.array(np.random.randn(3, 6).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    compiled = net(x).asnumpy()
+    np.testing.assert_allclose(eager, compiled, rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_multi_input_output():
+    class Net(gluon.HybridBlock):
+        def hybrid_forward(self, F, a, b):
+            return a + b, a * b
+
+    net = Net()
+    net.hybridize()
+    a, b = mx.nd.ones((2, 2)), mx.nd.full((2, 2), 3.0)
+    s, p = net(a, b)
+    np.testing.assert_allclose(s.asnumpy(), 4.0)
+    np.testing.assert_allclose(p.asnumpy(), 3.0)
+
+
+def test_conv_layers():
+    for layer, shape, expected in [
+        (nn.Conv1D(4, 3), (1, 2, 10), (1, 4, 8)),
+        (nn.Conv2D(4, 3, padding=1), (1, 2, 8, 8), (1, 4, 8, 8)),
+        (nn.Conv2D(4, 3, strides=2, groups=2), (1, 2, 8, 8), (1, 4, 3, 3)),
+        (nn.Conv3D(2, 2), (1, 2, 4, 4, 4), (1, 2, 3, 3, 3)),
+        (nn.Conv2DTranspose(4, 2, strides=2), (1, 2, 4, 4), (1, 4, 8, 8)),
+        (nn.MaxPool2D(2), (1, 2, 8, 8), (1, 2, 4, 4)),
+        (nn.AvgPool2D(2, strides=1), (1, 2, 4, 4), (1, 2, 3, 3)),
+        (nn.GlobalAvgPool2D(), (1, 3, 5, 5), (1, 3, 1, 1)),
+        (nn.GlobalMaxPool1D(), (1, 3, 5), (1, 3, 1)),
+    ]:
+        layer.initialize()
+        out = layer(mx.nd.ones(shape))
+        assert out.shape == expected, (type(layer).__name__, out.shape, expected)
+
+
+def test_pool_ceil_mode():
+    x = mx.nd.ones((1, 2, 6, 6))
+    assert nn.MaxPool2D(3, 2)(x).shape == (1, 2, 2, 2)
+    assert nn.MaxPool2D(3, 2, ceil_mode=True)(x).shape == (1, 2, 3, 3)
+
+
+def test_batchnorm_train_eval():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    x = mx.nd.array(np.random.randn(4, 3, 2, 2).astype(np.float32))
+    with autograd.record():
+        out = net(x)
+    # train mode: normalized by batch stats → per-channel mean ~0
+    m = out.asnumpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, 0, atol=1e-5)
+    assert np.abs(net.running_mean.data().asnumpy()).sum() > 0
+    # eval mode uses running stats
+    out_eval = net(x)
+    assert not np.allclose(out_eval.asnumpy(), out.asnumpy())
+
+
+def test_embedding_flatten_dropout():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = mx.nd.array([1, 2, 3])
+    assert emb(idx).shape == (3, 4)
+
+    fl = nn.Flatten()
+    assert fl(mx.nd.ones((2, 3, 4))).shape == (2, 12)
+
+    do = nn.Dropout(0.5)
+    x = mx.nd.ones((10, 10))
+    assert np.allclose(do(x).asnumpy(), 1.0)  # eval: identity
+    with autograd.record():
+        y = do(x)
+    a = y.asnumpy()
+    assert (a == 0).sum() > 0 and not np.allclose(a, 1.0)
+
+
+def test_activations_layers():
+    x = mx.nd.array([-2.0, 0.0, 2.0])
+    assert np.allclose(nn.LeakyReLU(0.1)(x).asnumpy(), [-0.2, 0, 2])
+    selu = nn.SELU()
+    assert selu(x).shape == x.shape
+    sw = nn.Swish()
+    assert sw(x).shape == x.shape
+    pr = nn.PReLU()
+    pr.initialize()
+    assert pr(x.reshape((1, 3))).shape == (1, 3)
+
+
+def test_losses():
+    from mxnet_tpu.gluon import loss as gloss
+
+    pred = mx.nd.array(np.random.randn(4, 5).astype(np.float32))
+    label_idx = mx.nd.array([0, 1, 2, 3])
+    label_same = mx.nd.array(np.random.randn(4, 5).astype(np.float32))
+
+    l = gloss.SoftmaxCrossEntropyLoss()(pred, label_idx)
+    assert l.shape == (4,)
+    # cross-check vs numpy
+    p = pred.asnumpy()
+    logp = p - p.max(1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(1, keepdims=True))
+    expected = -logp[np.arange(4), label_idx.asnumpy().astype(int)]
+    np.testing.assert_allclose(l.asnumpy(), expected, rtol=1e-5)
+
+    assert gloss.L2Loss()(pred, label_same).shape == (4,)
+    assert gloss.L1Loss()(pred, label_same).shape == (4,)
+    assert gloss.SigmoidBCELoss()(pred, (label_same > 0)).shape == (4,)
+    assert gloss.HuberLoss()(pred, label_same).shape == (4,)
+    assert gloss.HingeLoss()(pred, label_same.sign()).shape == (4,)
+    assert gloss.SquaredHingeLoss()(pred, label_same.sign()).shape == (4,)
+    assert gloss.LogisticLoss()(pred.reshape((20,)), label_same.reshape((20,)).sign()).shape == (20,)
+    assert gloss.KLDivLoss()(pred.log_softmax(), label_same.softmax()).shape == (4,)
+    t = gloss.TripletLoss()(pred, label_same, -label_same)
+    assert t.shape == (4,)
+
+
+def test_ctc_loss():
+    from mxnet_tpu.gluon import loss as gloss
+
+    loss = gloss.CTCLoss()
+    # uniform predictions over 4 classes, T=10, L=2
+    pred = mx.nd.zeros((2, 10, 4))
+    label = mx.nd.array([[1, 2], [2, 3]])
+    l = loss(pred, label)
+    assert l.shape == (2,)
+    assert np.all(np.isfinite(l.asnumpy()))
+    assert np.all(l.asnumpy() > 0)
+    # grads flow
+    pred.attach_grad()
+    with autograd.record():
+        l = loss(pred, label)
+    l.backward()
+    assert np.abs(pred.grad.asnumpy()).sum() > 0
+
+
+def test_trainer_convergence():
+    net = nn.Dense(1, in_units=2)
+    net.initialize(init="zeros")
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    target_w = np.array([[2.0, -1.0]], dtype=np.float32)
+    rng = np.random.RandomState(0)
+    for _ in range(100):
+        x_np = rng.randn(16, 2).astype(np.float32)
+        y_np = x_np @ target_w.T
+        x, y = mx.nd.array(x_np), mx.nd.array(y_np)
+        with autograd.record():
+            out = net(x)
+            loss = ((out - y) ** 2).sum(axis=1)  # per-sample loss (gluon idiom)
+        loss.backward()
+        trainer.step(16)
+    got = net.weight.data().asnumpy()
+    np.testing.assert_allclose(got, target_w, atol=0.05)
+
+
+def test_trainer_states_roundtrip(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    x = mx.nd.ones((2, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(2)
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+    tr.load_states(f)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net2.load_parameters(f)
+    x = mx.nd.ones((1, 3))
+    np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy(), rtol=1e-6)
+
+
+def test_params_file_format(tmp_path):
+    """The .params container must match the reference byte format
+    (SURVEY Appendix B)."""
+    import struct
+
+    f = str(tmp_path / "fmt.params")
+    mx.nd.save(f, {"w": mx.nd.ones((2, 3))})
+    with open(f, "rb") as fin:
+        buf = fin.read()
+    magic, reserved = struct.unpack_from("<QQ", buf, 0)
+    assert magic == 0x112
+    count = struct.unpack_from("<Q", buf, 16)[0]
+    assert count == 1
+    nd_magic = struct.unpack_from("<I", buf, 24)[0]
+    assert nd_magic == 0xF993FAC9
+    loaded = mx.nd.load(f)
+    np.testing.assert_allclose(loaded["w"].asnumpy(), 1.0)
+
+
+def test_clip_global_norm_split_load():
+    from mxnet_tpu.gluon import utils
+
+    arrays = [mx.nd.full((2, 2), 3.0), mx.nd.full((2,), 4.0)]
+    norm = utils.clip_global_norm(arrays, 1.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(total - 1.0) < 1e-4
+
+    splits = utils.split_and_load(mx.nd.arange(12).reshape((6, 2)),
+                                  [mx.cpu(), mx.cpu()])
+    assert len(splits) == 2 and splits[0].shape == (3, 2)
+
+
+def test_block_naming_and_repr():
+    net = nn.Dense(2)
+    assert net.prefix.startswith("dense")
+    with mx.name.Prefix("model_"):
+        pass
+    d1 = nn.Dense(2, prefix="d1_")
+    assert d1.prefix == "d1_"
+    assert d1.weight.name == "d1_weight"
+    repr(net)
+
+
+def test_summary_and_hooks():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    calls = []
+    h = net.register_forward_hook(lambda blk, inp, out: calls.append(1))
+    net(mx.nd.ones((1, 3)))
+    assert calls
+    h.detach()
+    net(mx.nd.ones((1, 3)))
+    assert len(calls) == 1
+    net.summary(mx.nd.ones((1, 3)))
+
+
+def test_zero_grad_and_cast():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    with autograd.record():
+        loss = net(mx.nd.ones((1, 2))).sum()
+    loss.backward()
+    assert np.abs(net.weight.grad().asnumpy()).sum() > 0
+    net.collect_params().zero_grad()
+    assert np.abs(net.weight.grad().asnumpy()).sum() == 0
+    net.cast("float16")
+    assert net.weight.data().dtype == np.float16
+
+
+def test_contrib_layers():
+    from mxnet_tpu.gluon.contrib import nn as cnn
+
+    c = cnn.HybridConcurrent(axis=1)
+    c.add(nn.Dense(3), nn.Dense(3))
+    c.initialize()
+    out = c(mx.nd.ones((2, 4)))
+    assert out.shape == (2, 6)
+    ident = cnn.Identity()
+    x = mx.nd.ones((2, 2))
+    assert np.allclose(ident(x).asnumpy(), 1.0)
+    se = cnn.SparseEmbedding(5, 3)
+    se.initialize()
+    assert se(mx.nd.array([0, 4])).shape == (2, 3)
+
+
+def test_lambda_layers():
+    lam = nn.Lambda("tanh")
+    hl = nn.HybridLambda(lambda F, x: F.relu(x))
+    x = mx.nd.array([-1.0, 1.0])
+    assert np.allclose(lam(x).asnumpy(), np.tanh([-1, 1]), rtol=1e-5)
+    assert np.allclose(hl(x).asnumpy(), [0, 1])

@@ -1,0 +1,143 @@
+"""Gluon data tests — mirrors reference tests/python/unittest/test_gluon_data.py."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import data as gdata
+from mxnet_tpu.gluon.data.vision import transforms
+
+
+def test_array_dataset():
+    X = np.random.uniform(size=(10, 20))
+    Y = np.random.uniform(size=(10,))
+    dataset = gdata.ArrayDataset(X, Y)
+    assert len(dataset) == 10
+    x, y = dataset[3]
+    np.testing.assert_allclose(x, X[3])
+
+    single = gdata.ArrayDataset(X)
+    assert np.allclose(single[0], X[0])
+
+
+def test_simple_dataset_transform():
+    ds = gdata.SimpleDataset(list(range(10)))
+    doubled = ds.transform(lambda x: 2 * x, lazy=False)
+    assert doubled[3] == 6
+    filtered = ds.filter(lambda x: x % 2 == 0)
+    assert len(filtered) == 5
+    pairs = gdata.ArrayDataset(np.arange(4), np.arange(4))
+    tf = pairs.transform_first(lambda x: x + 100)
+    x, y = tf[1]
+    assert x == 101 and y == 1
+
+
+def test_samplers():
+    seq = list(gdata.SequentialSampler(5))
+    assert seq == [0, 1, 2, 3, 4]
+    rnd = list(gdata.RandomSampler(5))
+    assert sorted(rnd) == [0, 1, 2, 3, 4]
+    bs = gdata.BatchSampler(gdata.SequentialSampler(10), 3, "keep")
+    batches = list(bs)
+    assert len(batches) == 4 and len(batches[-1]) == 1
+    assert len(gdata.BatchSampler(gdata.SequentialSampler(10), 3, "discard")) == 3
+    ro = gdata.BatchSampler(gdata.SequentialSampler(10), 3, "rollover")
+    assert len(list(ro)) == 3
+    assert len(list(ro)) == 3  # rollover carries remainder
+
+
+def test_dataloader_batching():
+    X = np.arange(20).reshape(10, 2).astype(np.float32)
+    Y = np.arange(10).astype(np.float32)
+    loader = gdata.DataLoader(gdata.ArrayDataset(X, Y), batch_size=4,
+                              last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 3
+    x0, y0 = batches[0]
+    assert x0.shape == (4, 2) and y0.shape == (4,)
+    np.testing.assert_allclose(x0.asnumpy(), X[:4])
+
+    # threaded loader returns the same content in order
+    loader2 = gdata.DataLoader(gdata.ArrayDataset(X, Y), batch_size=4,
+                               num_workers=2)
+    batches2 = list(loader2)
+    np.testing.assert_allclose(batches2[0][0].asnumpy(), X[:4])
+
+
+def test_mnist_fake(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FAKE_DATA", "1")
+    from mxnet_tpu.gluon.data.vision import MNIST
+
+    ds = MNIST(root=str(tmp_path / "no-mnist"))
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    loader = gdata.DataLoader(ds, batch_size=32)
+    x, y = next(iter(loader))
+    assert x.shape == (32, 28, 28, 1)
+
+
+def test_transforms():
+    img = mx.nd.array(np.random.randint(0, 255, (32, 32, 3)).astype(np.uint8),
+                      dtype="uint8")
+    t = transforms.ToTensor()(img)
+    assert t.shape == (3, 32, 32)
+    assert float(t.max()) <= 1.0
+
+    norm = transforms.Normalize([0.5, 0.5, 0.5], [0.25, 0.25, 0.25])(t)
+    assert norm.shape == (3, 32, 32)
+
+    r = transforms.Resize(16)(img)
+    assert r.shape == (16, 16, 3)
+
+    cc = transforms.CenterCrop(20)(img)
+    assert cc.shape == (20, 20, 3)
+
+    rrc = transforms.RandomResizedCrop(16)(img)
+    assert rrc.shape == (16, 16, 3)
+
+    for t_cls in (transforms.RandomFlipLeftRight, transforms.RandomFlipTopBottom):
+        out = t_cls()(img)
+        assert out.shape == (32, 32, 3)
+
+    for t_obj in (transforms.RandomBrightness(0.5), transforms.RandomContrast(0.5),
+                  transforms.RandomSaturation(0.5), transforms.RandomHue(0.1),
+                  transforms.RandomColorJitter(0.1, 0.1, 0.1, 0.1),
+                  transforms.RandomLighting(0.1)):
+        out = t_obj(img)
+        assert out.shape == (32, 32, 3), type(t_obj).__name__
+
+    comp = transforms.Compose([transforms.Resize(16), transforms.ToTensor()])
+    assert comp(img).shape == (3, 16, 16)
+
+
+def test_model_zoo_constructors():
+    """Every family constructs and produces logits (reference
+    test_gluon_model_zoo.py); kept to the small nets for speed."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    x = mx.nd.array(np.random.randn(1, 3, 32, 32).astype(np.float32))
+    net = vision.get_model("resnet18_v1", classes=7)
+    net.initialize()
+    assert net(x).shape == (1, 7)
+    net2 = vision.get_model("mobilenet0.25", classes=7)
+    net2.initialize()
+    assert net2(x).shape == (1, 7)
+    with pytest.raises(Exception):
+        vision.get_model("not_a_model")
+
+
+def test_model_zoo_save_load(tmp_path):
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_model("resnet18_v1", classes=4)
+    net.initialize()
+    x = mx.nd.array(np.random.randn(1, 3, 32, 32).astype(np.float32))
+    y1 = net(x)
+    f = str(tmp_path / "resnet.params")
+    net.save_parameters(f)
+    net2 = vision.get_model("resnet18_v1", classes=4)
+    net2.load_parameters(f)
+    y2 = net2(x)
+    np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(), rtol=1e-5)

@@ -1,0 +1,139 @@
+"""RNN tests — mirrors reference tests/python/unittest/test_gluon_rnn.py:
+cell shapes, unroll, stacked/bidirectional, fused layer vs cell numerics."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import rnn
+
+
+def test_rnn_cells_shapes():
+    for cell_cls, n_states in [(rnn.RNNCell, 1), (rnn.LSTMCell, 2), (rnn.GRUCell, 1)]:
+        cell = cell_cls(8)
+        cell.initialize()
+        x = mx.nd.ones((2, 4))
+        states = cell.begin_state(2)
+        assert len(states) == n_states
+        out, new_states = cell(x, states)
+        assert out.shape == (2, 8)
+        assert len(new_states) == n_states
+
+
+def test_unroll_merge():
+    cell = rnn.GRUCell(5)
+    cell.initialize()
+    seq = mx.nd.ones((3, 4, 2))  # NTC
+    outs, states = cell.unroll(4, seq, layout="NTC", merge_outputs=True)
+    assert outs.shape == (3, 4, 5)
+    outs2, _ = cell.unroll(4, seq, layout="NTC", merge_outputs=False)
+    assert isinstance(outs2, list) and len(outs2) == 4
+
+
+def test_stacked_and_modifiers():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(4))
+    stack.add(rnn.ResidualCell(rnn.LSTMCell(4)))
+    stack.add(rnn.DropoutCell(0.3))
+    stack.initialize()
+    outs, states = stack.unroll(3, mx.nd.ones((2, 3, 4)), layout="NTC",
+                                merge_outputs=True)
+    assert outs.shape == (2, 3, 4)
+    assert len(states) == 4  # 2 per LSTM
+
+
+def test_zoneout():
+    cell = rnn.ZoneoutCell(rnn.RNNCell(4), zoneout_outputs=0.5, zoneout_states=0.5)
+    cell.initialize()
+    with autograd.record():  # zoneout active in train mode
+        outs, states = cell.unroll(3, mx.nd.ones((2, 3, 4)), layout="NTC",
+                                   merge_outputs=True)
+    assert outs.shape == (2, 3, 4)
+
+
+def test_bidirectional_cell():
+    cell = rnn.BidirectionalCell(rnn.LSTMCell(3), rnn.LSTMCell(3))
+    cell.initialize()
+    outs, states = cell.unroll(4, mx.nd.ones((2, 4, 5)), layout="NTC",
+                               merge_outputs=True)
+    assert outs.shape == (2, 4, 6)
+
+
+def test_fused_layers_shapes():
+    for layer_cls, mode_states in [(rnn.RNN, 1), (rnn.LSTM, 2), (rnn.GRU, 1)]:
+        layer = layer_cls(6, num_layers=2)
+        layer.initialize()
+        x = mx.nd.ones((5, 3, 4))  # TNC
+        out = layer(x)
+        assert out.shape == (5, 3, 6)
+        out, states = layer(x, layer.begin_state(3))
+        assert len(states) == mode_states
+        assert states[0].shape == (2, 3, 6)
+
+
+def test_fused_bidirectional_ntc():
+    layer = rnn.LSTM(6, num_layers=1, bidirectional=True, layout="NTC")
+    layer.initialize()
+    x = mx.nd.ones((3, 5, 4))
+    out = layer(x)
+    assert out.shape == (3, 5, 12)
+
+
+def test_lstm_layer_vs_cell_numerics():
+    """Fused LSTM must match the LSTMCell unroll given identical weights —
+    the reference checks fused-vs-cell consistency the same way."""
+    T, B, I, H = 4, 2, 3, 5
+    layer = rnn.LSTM(H, num_layers=1, layout="TNC")
+    layer.initialize()
+    x = mx.nd.array(np.random.randn(T, B, I).astype(np.float32))
+    layer._finish_deferred(x)
+    out_fused = layer(x).asnumpy()
+
+    cell = rnn.LSTMCell(H, input_size=I)
+    cell.initialize()
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    outs, _ = cell.unroll(T, x, layout="TNC", merge_outputs=False)
+    out_cell = np.stack([o.asnumpy() for o in outs], axis=0)
+    np.testing.assert_allclose(out_fused, out_cell, rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_gradient():
+    layer = rnn.GRU(4, num_layers=1)
+    layer.initialize()
+    x = mx.nd.ones((3, 2, 5))
+    x.attach_grad()
+    with autograd.record():
+        out = layer(x)
+        loss = (out * out).sum()
+    loss.backward()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+    for name, p in layer.collect_params().items():
+        assert np.abs(p.grad().asnumpy()).sum() > 0, name
+
+
+def test_contrib_cells():
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+
+    cell = crnn.VariationalDropoutCell(rnn.LSTMCell(4), drop_inputs=0.3,
+                                       drop_states=0.3)
+    cell.initialize()
+    with autograd.record():
+        outs, states = cell.unroll(3, mx.nd.ones((2, 3, 4)), layout="NTC",
+                                   merge_outputs=True)
+    assert outs.shape == (2, 3, 4)
+
+    lstmp = crnn.LSTMPCell(8, projection_size=3)
+    lstmp.initialize()
+    out, states = lstmp(mx.nd.ones((2, 4)), lstmp.begin_state(2))
+    assert out.shape == (2, 3)
+    assert states[1].shape == (2, 8)
+
+    conv_cell = crnn.Conv2DLSTMCell(input_shape=(2, 6, 6), hidden_channels=3,
+                                    i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    conv_cell.initialize()
+    st = conv_cell.begin_state(1)
+    out, st = conv_cell(mx.nd.ones((1, 2, 6, 6)), st)
+    assert out.shape == (1, 3, 6, 6)

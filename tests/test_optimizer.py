@@ -1,0 +1,343 @@
+"""Optimizer / lr_scheduler / initializer / metric tests.
+
+Mirrors the strategy of reference tests/python/unittest/test_optimizer.py:
+each optimizer is checked against a straightforward numpy re-implementation
+on small dense weights, plus API-surface checks (registry, updater state
+round-trip, schedulers, multipliers).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu import lr_scheduler, initializer, metric
+
+
+def _nd(a):
+    return mx.nd.array(np.asarray(a, dtype=np.float32))
+
+
+def test_registry_create():
+    for name in ["sgd", "adam", "adagrad", "rmsprop", "adadelta", "ftrl",
+                 "adamax", "nadam", "nag", "signum", "ftml", "sgld", "dcasgd",
+                 "lbsgd", "signsgd", "test"]:
+        o = opt.create(name)
+        assert isinstance(o, opt.Optimizer), name
+    with pytest.raises(Exception):
+        opt.create("does_not_exist")
+
+
+def test_sgd_matches_numpy():
+    w0 = np.random.randn(4, 3).astype(np.float32)
+    g0 = np.random.randn(4, 3).astype(np.float32)
+    lr, wd, mom = 0.1, 0.01, 0.9
+
+    o = opt.SGD(learning_rate=lr, momentum=mom, wd=wd)
+    w = _nd(w0)
+    state = o.create_state(0, w)
+    state = o.update(0, w, _nd(g0), state)
+    # numpy reference
+    g = g0 + wd * w0
+    m = -lr * g
+    w_ref = w0 + m
+    np.testing.assert_allclose(w.asnumpy(), w_ref, rtol=1e-5)
+    # second step exercises momentum accumulation
+    state = o.update(0, w, _nd(g0), state)
+    g2 = g0 + wd * w_ref
+    m2 = mom * m - lr * g2
+    np.testing.assert_allclose(w.asnumpy(), w_ref + m2, rtol=1e-5)
+
+
+def test_sgd_clip_and_rescale():
+    w0 = np.zeros(5, dtype=np.float32)
+    g0 = np.array([10.0, -10.0, 0.5, 2.0, -2.0], dtype=np.float32)
+    o = opt.SGD(learning_rate=1.0, rescale_grad=0.5, clip_gradient=1.0)
+    w = _nd(w0)
+    o.update(0, w, _nd(g0), None)
+    expected = -np.clip(g0 * 0.5, -1.0, 1.0)
+    np.testing.assert_allclose(w.asnumpy(), expected, rtol=1e-6)
+
+
+def test_adam_matches_numpy():
+    w0 = np.random.randn(6).astype(np.float32)
+    g0 = np.random.randn(6).astype(np.float32)
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    o = opt.Adam(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps)
+    w = _nd(w0)
+    state = o.create_state(0, w)
+    state = o.update(0, w, _nd(g0), state)
+    m = (1 - b1) * g0
+    v = (1 - b2) * g0 * g0
+    lr_t = lr * np.sqrt(1 - b2) / (1 - b1)
+    w_ref = w0 - lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(w.asnumpy(), w_ref, rtol=1e-5)
+
+
+def test_adagrad_rmsprop_adadelta_converge():
+    # each optimizer should descend x^2 quickly from x=5
+    for name, kwargs in [("adagrad", dict(learning_rate=2.0)),
+                         ("rmsprop", dict(learning_rate=0.5)),
+                         ("rmsprop", dict(learning_rate=0.5, centered=True)),
+                         ("adadelta", dict(rho=0.5, epsilon=1.0)),
+                         ("adam", dict(learning_rate=0.5)),
+                         ("adamax", dict(learning_rate=0.5)),
+                         ("nadam", dict(learning_rate=0.5)),
+                         ("ftml", dict(learning_rate=0.5)),
+                         ("ftrl", dict(learning_rate=2.0)),
+                         ("nag", dict(learning_rate=0.1, momentum=0.9)),
+                         ("signum", dict(learning_rate=0.1, momentum=0.9)),
+                         ("dcasgd", dict(learning_rate=0.2, momentum=0.5)),
+                         ("lbsgd", dict(learning_rate=0.2, momentum=0.5))]:
+        o = opt.create(name, **kwargs)
+        w = _nd([5.0])
+        state = o.create_state(0, w)
+        for _ in range(60):
+            g = _nd([2.0 * float(w.asnumpy()[0])])
+            ns = o.update(0, w, g, state)
+            state = ns if ns is not None else state
+        assert abs(float(w.asnumpy()[0])) < 1.0, (name, w.asnumpy())
+
+
+def test_updater_state_roundtrip():
+    o = opt.Adam(learning_rate=0.1)
+    u = opt.get_updater(o)
+    w = _nd(np.random.randn(3))
+    for i in range(3):
+        u(0, _nd(np.random.randn(3)), w)
+    blob = u.get_states()
+    u2 = opt.get_updater(opt.Adam(learning_rate=0.1))
+    u2.set_states(blob)
+    assert set(u2.states.keys()) == {0}
+    # states numerically equal
+    for a, b in zip(u.states[0], u2.states[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_multi_precision_sgd():
+    w16 = mx.nd.array(np.random.randn(4).astype(np.float16), dtype="float16")
+    g16 = mx.nd.array(np.random.randn(4).astype(np.float16), dtype="float16")
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    u = opt.get_updater(o)
+    u(0, g16, w16)
+    assert w16.dtype == np.float16
+    master, _mom = u.states[0]
+    assert np.asarray(master).dtype == np.float32
+
+
+def test_lr_wd_mult():
+    o = opt.SGD(learning_rate=1.0, param_idx2name={0: "a_weight", 1: "b_bias"})
+    o.set_lr_mult({"a_weight": 0.1})
+    assert abs(o._get_lr(0) - 0.1) < 1e-9
+    assert abs(o._get_lr(1) - 1.0) < 1e-9
+    # bias gets wd_mult 0 automatically (reference set_wd_mult behavior)
+    o2 = opt.SGD(learning_rate=1.0, wd=0.5, param_idx2name={0: "a_weight", 1: "b_bias"})
+    assert abs(o2._get_wd(0) - 0.5) < 1e-9
+    assert abs(o2._get_wd(1) - 0.0) < 1e-9
+
+
+def test_num_update_counting():
+    o = opt.SGD(learning_rate=0.1)
+    w, g = _nd([1.0]), _nd([1.0])
+    o.update(0, w, g, None)
+    o.update(0, w, g, None)
+    o.update(1, w, g, None)
+    assert o.num_update == 2
+    assert o._index_update_count[0] == 2
+    assert o._index_update_count[1] == 1
+
+
+# ---------------------------------------------------------------------------
+# lr schedulers
+# ---------------------------------------------------------------------------
+
+
+def test_factor_scheduler():
+    s = lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert abs(s(11) - 0.5) < 1e-9
+    assert abs(s(21) - 0.25) < 1e-9
+
+
+def test_multifactor_scheduler():
+    s = lr_scheduler.MultiFactorScheduler(step=[5, 10], factor=0.1, base_lr=1.0)
+    assert s(2) == 1.0
+    assert abs(s(6) - 0.1) < 1e-9
+    assert abs(s(11) - 0.01) < 1e-9
+
+
+def test_poly_cosine_warmup():
+    p = lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=2, final_lr=0.0)
+    assert p(0) == 1.0
+    assert p(100) < 1e-6
+    c = lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0)
+    assert abs(c(0) - 1.0) < 1e-9
+    assert c(100) < 1e-6
+    w = lr_scheduler.FactorScheduler(step=1000, factor=1.0, base_lr=1.0,
+                                     warmup_steps=10, warmup_begin_lr=0.0)
+    assert w(0) == 0.0
+    assert abs(w(5) - 0.5) < 1e-9
+    assert w(10) == 1.0
+
+
+def test_scheduler_in_optimizer():
+    s = lr_scheduler.FactorScheduler(step=1, factor=0.5, base_lr=1.0)
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=s)
+    w, g = _nd([1.0]), _nd([0.0])
+    for _ in range(3):
+        o.update(0, w, g, None)
+    assert o.learning_rate < 1.0
+
+
+# ---------------------------------------------------------------------------
+# initializers
+# ---------------------------------------------------------------------------
+
+
+def test_initializer_dispatch():
+    w = mx.nd.zeros((4, 4))
+    initializer.Uniform(1.0)(initializer.InitDesc("fc_weight"), w)
+    assert np.abs(w.asnumpy()).max() > 0
+    b = mx.nd.ones((4,))
+    initializer.Uniform(1.0)(initializer.InitDesc("fc_bias"), b)
+    np.testing.assert_allclose(b.asnumpy(), 0)
+    g = mx.nd.zeros((4,))
+    initializer.Uniform(1.0)(initializer.InitDesc("bn_gamma"), g)
+    np.testing.assert_allclose(g.asnumpy(), 1)
+
+
+def test_xavier_scale():
+    w = mx.nd.zeros((100, 100))
+    initializer.Xavier(factor_type="avg", magnitude=3)(initializer.InitDesc("w_weight"), w)
+    scale = np.sqrt(3.0 / 100)
+    a = w.asnumpy()
+    assert np.abs(a).max() <= scale + 1e-6
+    assert np.abs(a).std() > 0
+
+
+def test_orthogonal():
+    w = mx.nd.zeros((16, 16))
+    initializer.Orthogonal(scale=1.0)(initializer.InitDesc("q_weight"), w)
+    a = w.asnumpy()
+    np.testing.assert_allclose(a @ a.T, np.eye(16), atol=1e-4)
+
+
+def test_constant_load_mixed():
+    w = mx.nd.zeros((3,))
+    initializer.Constant(2.5)(initializer.InitDesc("c_weight"), w)
+    np.testing.assert_allclose(w.asnumpy(), 2.5)
+
+    src = {"p_weight": np.arange(3).astype(np.float32)}
+    w2 = mx.nd.zeros((3,))
+    initializer.Load(src)("p_weight", w2)
+    np.testing.assert_allclose(w2.asnumpy(), [0, 1, 2])
+
+    m = initializer.Mixed([".*fc2.*", ".*"], [initializer.Constant(1.0), initializer.Constant(9.0)])
+    b = mx.nd.zeros((2,))
+    m(initializer.InitDesc("fc2_weight"), b)
+    np.testing.assert_allclose(b.asnumpy(), 1.0)
+    b2 = mx.nd.zeros((2,))
+    m(initializer.InitDesc("fc1_weight"), b2)
+    np.testing.assert_allclose(b2.asnumpy(), 9.0)
+
+
+def test_lstmbias():
+    # param-specific init flows through the InitDesc __init__ attr (the
+    # reference gluon Parameter path), which dispatches straight to
+    # _init_weight regardless of the name suffix
+    b = mx.nd.zeros((8,))  # num_hidden=2 → gates i,f,c,o
+    desc = initializer.InitDesc(
+        "l0_bias", {"__init__": initializer.LSTMBias(forget_bias=1.0).dumps()})
+    initializer.Uniform()(desc, b)
+    np.testing.assert_allclose(b.asnumpy(), [0, 0, 1, 1, 0, 0, 0, 0])
+
+
+def test_create_by_name():
+    assert isinstance(initializer.create("xavier"), initializer.Xavier)
+    assert isinstance(initializer.create("uniform", scale=0.1), initializer.Uniform)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+def test_accuracy():
+    m = metric.Accuracy()
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3) < 1e-6
+
+
+def test_topk():
+    m = metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.1, 0.2, 0.7], [0.5, 0.4, 0.1]])
+    label = mx.nd.array([1, 1])
+    m.update([label], [pred])
+    _, acc = m.get()
+    assert abs(acc - 1.0) < 1e-6  # 1 in top2 both times
+
+
+def test_f1_mcc():
+    pred = mx.nd.array([[0.7, 0.3], [0.2, 0.8], [0.1, 0.9], [0.6, 0.4]])
+    label = mx.nd.array([0, 1, 1, 1])
+    f1 = metric.F1()
+    f1.update([label], [pred])
+    _, v = f1.get()
+    assert 0 < v <= 1
+    mcc = metric.MCC()
+    mcc.update([label], [pred])
+    _, v2 = mcc.get()
+    assert -1 <= v2 <= 1
+
+
+def test_mse_mae_rmse():
+    pred = mx.nd.array([1.0, 2.0, 3.0])
+    label = mx.nd.array([1.5, 2.0, 2.5])
+    for name, expected in [("mse", np.mean([0.25, 0, 0.25])),
+                           ("mae", np.mean([0.5, 0, 0.5])),
+                           ("rmse", np.sqrt(np.mean([0.25, 0, 0.25])))]:
+        m = metric.create(name)
+        m.update([label], [pred])
+        _, v = m.get()
+        assert abs(v - expected) < 1e-6, name
+
+
+def test_perplexity_crossentropy_nll():
+    pred = mx.nd.array([[0.25, 0.75], [0.9, 0.1]])
+    label = mx.nd.array([1, 0])
+    perp = metric.Perplexity(ignore_label=None)
+    perp.update([label], [pred])
+    _, v = perp.get()
+    expected = np.exp(-(np.log(0.75) + np.log(0.9)) / 2)
+    assert abs(v - expected) < 1e-5
+    ce = metric.CrossEntropy()
+    ce.update([label], [pred])
+    _, vce = ce.get()
+    assert abs(vce - (-(np.log(0.75) + np.log(0.9)) / 2)) < 1e-5
+    nll = metric.NegativeLogLikelihood()
+    nll.update([label], [pred])
+    _, vn = nll.get()
+    assert abs(vn - vce) < 1e-6
+
+
+def test_pearson_loss_custom_composite():
+    pred = mx.nd.array([1.0, 2.0, 3.0, 4.0])
+    label = mx.nd.array([2.0, 4.0, 6.0, 8.0])
+    p = metric.PearsonCorrelation()
+    p.update([label], [pred])
+    _, v = p.get()
+    assert abs(v - 1.0) < 1e-6
+
+    custom = metric.np(lambda l, pr: float(np.abs(l - pr).sum()))
+    custom.update([label], [pred])
+
+    comp = metric.create(["acc", "mse"])
+    assert isinstance(comp, metric.CompositeEvalMetric)
+    pred_c = mx.nd.array([[0.3, 0.7]])
+    label_c = mx.nd.array([1])
+    comp.update([label_c], [pred_c])
+    names, values = comp.get()
+    assert "accuracy" in names and "mse" in names
