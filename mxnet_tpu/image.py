@@ -1,0 +1,543 @@
+"""Image pipeline: decode, resize, augmenters, ImageIter.
+
+API parity with reference ``python/mxnet/image/image.py`` (imdecode/imread/
+imresize, resize_short, fixed/random/center crop, color_normalize, the
+Augmenter zoo + CreateAugmenter, ImageIter) and the C++ decode path
+(``src/io/image_io.cc``, ``image_aug_default.cc``). Decoding is host-side
+(PIL) feeding the device via device_put; augmentation math is numpy —
+identical division of labor to the reference's CPU augmenter threads.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import random as _pyrandom
+
+import numpy as np
+
+from .base import MXNetError
+from .io import DataBatch, DataDesc, DataIter
+from .ndarray import ndarray as nd_mod
+from .ndarray.ndarray import NDArray
+
+__all__ = [
+    "imdecode", "imencode", "imread", "imresize", "resize_short", "fixed_crop",
+    "random_crop", "center_crop", "color_normalize", "random_size_crop",
+    "Augmenter", "SequentialAug", "RandomOrderAug", "ResizeAug",
+    "ForceResizeAug", "RandomCropAug", "RandomSizedCropAug", "CenterCropAug",
+    "HorizontalFlipAug", "CastAug", "BrightnessJitterAug", "ContrastJitterAug",
+    "SaturationJitterAug", "HueJitterAug", "ColorJitterAug", "LightingAug",
+    "ColorNormalizeAug", "RandomGrayAug", "CreateAugmenter", "ImageIter",
+]
+
+
+def _np_rng():
+    from . import random as _random
+
+    return _random.np_rng()
+
+
+def _to_nd(a):
+    return nd_mod.array(np.ascontiguousarray(a), dtype=a.dtype)
+
+
+def imdecode(buf, flag=1, to_rgb=1, to_numpy=False):
+    """Decode image bytes to HWC (RGB) array (reference image.py:imdecode →
+    src/io/image_io.cc)."""
+    from PIL import Image
+
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    img = Image.open(_io.BytesIO(bytes(buf)))
+    if flag == 0:
+        img = img.convert("L")
+        arr = np.asarray(img)[:, :, None]
+    else:
+        img = img.convert("RGB")
+        arr = np.asarray(img)
+        if not to_rgb:
+            arr = arr[:, :, ::-1]
+    return arr.copy() if to_numpy else _to_nd(arr)
+
+
+def imencode(img, fmt=".jpg", quality=95):
+    """Encode HWC array to image bytes."""
+    from PIL import Image
+
+    if isinstance(img, NDArray):
+        img = img.asnumpy()
+    img = np.asarray(img).astype(np.uint8)
+    if img.ndim == 3 and img.shape[2] == 1:
+        img = img[:, :, 0]
+    pil = Image.fromarray(img)
+    bio = _io.BytesIO()
+    pil.save(bio, format="JPEG" if fmt in (".jpg", ".jpeg") else "PNG",
+             quality=quality)
+    return bio.getvalue()
+
+
+def imread(filename, flag=1, to_rgb=1):
+    """Read image file (reference image.py:imread)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    """Resize HWC (reference image.py:imresize)."""
+    import jax
+
+    arr = src._data if isinstance(src, NDArray) else np.asarray(src)
+    method = {0: "nearest", 1: "bilinear", 2: "cubic", 3: "bilinear",
+              4: "lanczos3"}.get(interp, "bilinear")
+    out = jax.image.resize(np.asarray(arr).astype(np.float32),
+                           (h, w, arr.shape[2]), method=method)
+    return NDArray(out, src.context if isinstance(src, NDArray) else None) \
+        if isinstance(src, NDArray) else _to_nd(np.asarray(out))
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter side equals size (reference image.py:resize_short)."""
+    h, w = src.shape[0], src.shape[1]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w, :]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    """Random crop to size, resize if needed (reference image.py:random_crop)."""
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = _np_rng().randint(0, w - new_w + 1)
+    y0 = _np_rng().randint(0, h - new_h + 1)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    """Random area/aspect crop (reference image.py:random_size_crop)."""
+    h, w = src.shape[0], src.shape[1]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    rng = _np_rng()
+    for _ in range(10):
+        target_area = rng.uniform(area[0], area[1]) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(rng.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = rng.randint(0, w - new_w + 1)
+            y0 = rng.randint(0, h - new_h + 1)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    if mean is not None:
+        src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+class Augmenter(object):
+    """Base augmenter (reference image.py:Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for aug in self.ts:
+            src = aug(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        _np_rng().shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _np_rng().rand() < self.p:
+            return src.flip(axis=1)
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _np_rng().uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+        self.coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __call__(self, src):
+        alpha = 1.0 + _np_rng().uniform(-self.contrast, self.contrast)
+        gray = (src * nd_mod.array(self.coef)).sum()
+        gray = (3.0 * (1.0 - alpha) / float(np.prod(src.shape))) * gray
+        return src * alpha + gray
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+        self.coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __call__(self, src):
+        alpha = 1.0 + _np_rng().uniform(-self.saturation, self.saturation)
+        gray = (src * nd_mod.array(self.coef)).sum(axis=2, keepdims=True)
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class HueJitterAug(Augmenter):
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]], dtype=np.float32)
+        self.ityiq = np.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]], dtype=np.float32)
+
+    def __call__(self, src):
+        alpha = _np_rng().uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                      dtype=np.float32)
+        t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
+        return src.dot(nd_mod.array(t))
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """AlexNet PCA lighting (reference image.py:LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, dtype=np.float32)
+        self.eigvec = np.asarray(eigvec, dtype=np.float32)
+
+    def __call__(self, src):
+        alpha = _np_rng().normal(0, self.alphastd, size=(3,)).astype(np.float32)
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return src + nd_mod.array(rgb.reshape((1, 1, 3)))
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = nd_mod.array(np.asarray(mean, dtype=np.float32)) \
+            if mean is not None else None
+        self.std = nd_mod.array(np.asarray(std, dtype=np.float32)) \
+            if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+        self.mat = np.array([[0.21, 0.21, 0.21],
+                             [0.72, 0.72, 0.72],
+                             [0.07, 0.07, 0.07]], dtype=np.float32)
+
+    def __call__(self, src):
+        if _np_rng().rand() < self.p:
+            src = src.dot(nd_mod.array(self.mat))
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """Standard augmenter list (reference image.py:CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and np.any(np.asarray(mean) > 0):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Python image iterator over .rec or .lst+images with augmenters
+    (reference image.py:ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1, path_imgrec=None,
+                 path_imglist=None, path_root=None, shuffle=False,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or isinstance(imglist, list)
+        assert len(data_shape) == 3 and data_shape[0] in (1, 3)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.path_root = path_root
+
+        self.imgrec = None
+        self.imglist = None
+        self.seq = None
+        if path_imgrec:
+            from . import recordio
+
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.exists(idx_path):
+                self.imgrec = recordio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+        elif path_imglist:
+            imglist2 = {}
+            with open(path_imglist) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    label = np.array(line[1:-1], dtype=np.float32)
+                    imglist2[int(line[0])] = (label, line[-1])
+            self.imglist = imglist2
+            self.seq = list(imglist2.keys())
+        else:
+            result = {}
+            for i, img in enumerate(imglist):
+                result[i] = (np.array(img[:-1], dtype=np.float32)
+                             if len(img) > 2 else np.float32(img[0]), img[-1])
+            self.imglist = result
+            self.seq = list(result.keys())
+
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_resize", "rand_mirror",
+                         "mean", "std", "brightness", "contrast", "saturation",
+                         "hue", "pca_noise", "rand_gray", "inter_method")})
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            _np_rng().shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        from . import recordio
+
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                return header.label, img
+            label, fname = self.imglist[idx]
+            with open(os.path.join(self.path_root or "", fname), "rb") as f:
+                return label, f.read()
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, img
+
+    def next(self):
+        batch_data = []
+        batch_label = []
+        pad = 0
+        try:
+            while len(batch_data) < self.batch_size:
+                label, s = self.next_sample()
+                img = imdecode(s, 1 if self.data_shape[0] == 3 else 0)
+                for aug in self.auglist:
+                    img = aug(img)
+                chw = img.asnumpy().transpose(2, 0, 1).astype(np.float32)
+                batch_data.append(chw)
+                batch_label.append(label)
+        except StopIteration:
+            if not batch_data:
+                raise
+            pad = self.batch_size - len(batch_data)
+            while len(batch_data) < self.batch_size:
+                batch_data.append(batch_data[-1])
+                batch_label.append(batch_label[-1])
+        data = nd_mod.array(np.stack(batch_data))
+        label = nd_mod.array(np.asarray(batch_label, dtype=np.float32))
+        return DataBatch([data], [label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

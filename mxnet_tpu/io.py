@@ -1,0 +1,510 @@
+"""Data iterators.
+
+API parity with reference ``python/mxnet/io.py`` (DataDesc, DataBatch :118,
+DataIter :182, NDArrayIter, ResizeIter, PrefetchingIter :349, CSVIter,
+MNISTIter) and the C++ iterator registry semantics (SURVEY §2.1 Data I/O).
+Host-side batching feeds the device through async device_put; heavy decode
+paths live in gluon.data / image.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+from .context import cpu
+from .ndarray import ndarray as nd_mod
+from .ndarray.ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """Name+shape (+dtype/layout) descriptor (reference io.py:DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
+                                          self.layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch(object):
+    """One mini-batch (reference io.py:118)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None:
+            assert isinstance(data, (list, tuple)), "Data must be list of NDArrays"
+        if label is not None:
+            assert isinstance(label, (list, tuple)), "Label must be list of NDArrays"
+        self.data = data
+        self.label = label
+        self.pad = pad if pad is not None else 0
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data]
+        if self.label:
+            label_shapes = [l.shape for l in self.label]
+        else:
+            label_shapes = None
+        return "{}: data shapes: {} label shapes: {}".format(
+            self.__class__.__name__, data_shapes, label_shapes)
+
+
+class DataIter(object):
+    """Base iterator (reference io.py:182)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input data to list of (name, numpy array)
+    (reference io.py:_init_data)."""
+    assert (data is not None) or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = OrderedDictItems([(default_name, data[0])])
+        else:
+            data = OrderedDictItems(
+                [("_%d_%s" % (i, default_name), d) for i, d in enumerate(data)])
+    if isinstance(data, dict):
+        data = OrderedDictItems(sorted(data.items()))
+    out = []
+    for k, v in data:
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, np.asarray(v)))
+    return out
+
+
+class OrderedDictItems(list):
+    pass
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays with shuffle/pad (reference
+    io.py:NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+
+        if shuffle:
+            from . import random as _random
+
+            idx = np.arange(self.num_data)
+            _random.np_rng().shuffle(idx)
+            self.data = [(k, v[idx]) for k, v in self.data]
+            self.label = [(k, v[idx]) for k, v in self.label]
+
+        if last_batch_handle == "discard":
+            new_n = self.num_data - self.num_data % batch_size
+            self.data = [(k, v[:new_n]) for k, v in self.data]
+            self.label = [(k, v[:new_n]) for k, v in self.label]
+            self.num_data = new_n
+
+        assert self.num_data >= batch_size, \
+            "batch_size needs to be smaller than data size."
+        self.cursor = -batch_size
+        self.batch_size = batch_size
+        self.last_batch_handle = last_batch_handle
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def hard_reset(self):
+        self.cursor = -self.batch_size
+
+    def reset(self):
+        if self.last_batch_handle == "roll_over" and self.cursor > self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _getdata(self, data_source):
+        assert self.cursor < self.num_data, "DataIter needs reset."
+        if self.cursor + self.batch_size <= self.num_data:
+            return [nd_mod.array(v[self.cursor:self.cursor + self.batch_size],
+                                 dtype=v.dtype)
+                    for _, v in data_source]
+        # padding with wrap-around (last_batch_handle='pad')
+        pad = self.batch_size - self.num_data + self.cursor
+        return [nd_mod.array(np.concatenate([v[self.cursor:], v[:pad]], axis=0),
+                             dtype=v.dtype)
+                for _, v in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches (reference
+    io.py:ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Threaded prefetch over one or more iterators (reference io.py:349;
+    the Python-side analogue of the C++ prefetcher iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        assert self.n_iter > 0
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0][1][0]
+        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
+        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
+        for e in self.data_taken:
+            e.set()
+        self.started = True
+        self.current_batch = [None for _ in range(self.n_iter)]
+        self.next_batch = [None for _ in range(self.n_iter)]
+
+        def prefetch_func(self, i):
+            while True:
+                self.data_taken[i].wait()
+                if not self.started:
+                    break
+                try:
+                    self.next_batch[i] = self.iters[i].next()
+                except StopIteration:
+                    self.next_batch[i] = None
+                self.data_taken[i].clear()
+                self.data_ready[i].set()
+
+        self.prefetch_threads = [
+            threading.Thread(target=prefetch_func, args=[self, i])
+            for i in range(self.n_iter)]
+        for thread in self.prefetch_threads:
+            thread.daemon = True
+            thread.start()
+
+    def __del__(self):
+        self.started = False
+        for e in self.data_taken:
+            e.set()
+        for thread in self.prefetch_threads:
+            thread.join(timeout=1)
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(x, DataDesc) else DataDesc(*x)
+                     for x in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(x, DataDesc) else DataDesc(*x)
+                     for x in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def reset(self):
+        for e in self.data_ready:
+            e.wait()
+        for i in self.iters:
+            i.reset()
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+
+    def iter_next(self):
+        for e in self.data_ready:
+            e.wait()
+        if self.next_batch[0] is None:
+            for i in self.next_batch:
+                assert i is None, "Number of entry mismatches between iterators"
+            return False
+        for batch in self.next_batch:
+            assert batch.pad == self.next_batch[0].pad, \
+                "Different pad size in the data batches"
+        self.current_batch = DataBatch(
+            sum([batch.data for batch in self.next_batch], []),
+            sum([batch.label for batch in self.next_batch], []),
+            self.next_batch[0].pad,
+            self.next_batch[0].index,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class CSVIter(NDArrayIter):
+    """CSV file iterator (reference src/io/iter_csv.cc / io.py CSVIter)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label.shape[1:] == (1,):
+                label = label.reshape(label.shape[0])
+        super().__init__(data, label, batch_size=batch_size,
+                         last_batch_handle="pad" if round_batch else "discard")
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST idx-file iterator (reference src/io/iter_mnist.cc)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 silent=False, seed=None, input_shape=None, **kwargs):
+        import gzip
+        import struct
+
+        opener = gzip.open if image.endswith(".gz") else open
+        with opener(label, "rb") as fin:
+            struct.unpack(">II", fin.read(8))
+            lab = np.frombuffer(fin.read(), dtype=np.uint8).astype(np.float32)
+        with opener(image, "rb") as fin:
+            _, n, r, c = struct.unpack(">IIII", fin.read(16))
+            img = np.frombuffer(fin.read(), dtype=np.uint8).astype(np.float32) / 255.0
+            img = img.reshape(n, 1, r, c)
+        if flat:
+            img = img.reshape(n, r * c)
+        elif input_shape is not None:
+            img = img.reshape((n,) + tuple(input_shape))
+        super().__init__(img, lab, batch_size=batch_size, shuffle=shuffle)
+
+
+def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=128,
+                    shuffle=False, preprocess_threads=4, prefetch_buffer=4,
+                    label_width=1, **kwargs):
+    """ImageRecordIter over a .rec file (reference
+    src/io/iter_image_recordio_2.cc:663). Decodes JPEG payloads host-side
+    through mxnet_tpu.image, batches, and prefetches on threads."""
+    from . import image as image_mod
+    from . import recordio
+
+    class _Iter(DataIter):
+        def __init__(self):
+            super().__init__(batch_size)
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.exists(idx_path):
+                self._rec = recordio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
+                self._keys = list(self._rec.keys)
+            else:
+                self._rec = recordio.MXRecordIO(path_imgrec, "r")
+                self._keys = None
+            self._order = None
+            self._pos = 0
+            self.data_shape = tuple(data_shape)
+            self.reset()
+
+        @property
+        def provide_data(self):
+            return [DataDesc("data", (batch_size,) + self.data_shape)]
+
+        @property
+        def provide_label(self):
+            shape = (batch_size,) if label_width == 1 else (batch_size, label_width)
+            return [DataDesc("softmax_label", shape)]
+
+        def reset(self):
+            self._pos = 0
+            if self._keys is not None:
+                self._order = list(self._keys)
+                if shuffle:
+                    from . import random as _random
+
+                    _random.np_rng().shuffle(self._order)
+            else:
+                self._rec.reset()
+
+        def _read_one(self):
+            if self._keys is not None:
+                if self._pos >= len(self._order):
+                    return None
+                rec = self._rec.read_idx(self._order[self._pos])
+                self._pos += 1
+            else:
+                rec = self._rec.read()
+                if rec is None:
+                    return None
+            header, img_bytes = recordio.unpack(rec)
+            img = image_mod.imdecode(img_bytes)  # HWC
+            c, h, w = self.data_shape
+            if img.shape[0] != h or img.shape[1] != w:
+                img = image_mod.imresize(img, w, h)
+            chw = img.asnumpy().transpose(2, 0, 1).astype(np.float32)
+            label = header.label
+            return chw, label
+
+        def next(self):
+            datas, labels = [], []
+            pad = 0
+            while len(datas) < batch_size:
+                one = self._read_one()
+                if one is None:
+                    if not datas:
+                        raise StopIteration
+                    pad = batch_size - len(datas)
+                    while len(datas) < batch_size:
+                        datas.append(datas[-1])
+                        labels.append(labels[-1])
+                    break
+                datas.append(one[0])
+                labels.append(one[1])
+            data = nd_mod.array(np.stack(datas))
+            label = nd_mod.array(np.asarray(labels, dtype=np.float32))
+            return DataBatch([data], [label], pad=pad,
+                             provide_data=self.provide_data,
+                             provide_label=self.provide_label)
+
+        def iter_next(self):
+            try:
+                self._next_cache = self.next()
+                return True
+            except StopIteration:
+                return False
+
+    it = _Iter()
+    if preprocess_threads and prefetch_buffer:
+        return PrefetchingIter(it)
+    return it

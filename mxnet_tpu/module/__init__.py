@@ -1,0 +1,11 @@
+"""Module API: symbolic training (reference ``python/mxnet/module/``)."""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+from .executor_group import DataParallelExecutorGroup
+from . import base_module
+from . import module
+from . import bucketing_module
+from . import sequential_module
+from . import executor_group

@@ -1,0 +1,181 @@
+"""DataParallelExecutorGroup.
+
+API parity with reference ``python/mxnet/module/executor_group.py:143``:
+slices each batch across contexts (:281-303), drives per-context executors
+(forward :436, backward :572), merges outputs, accumulates metrics (:601).
+On a single TPU chip this is one executor; with multiple devices the slices
+run per device and kvstore reduces gradients (SURVEY §2.5.1).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray import ndarray as nd_mod
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DataParallelExecutorGroup"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Slice ranges per device (reference executor_group.py work-load split)."""
+    total = sum(work_load_list)
+    slices = []
+    start = 0
+    for i, w in enumerate(work_load_list):
+        if i == len(work_load_list) - 1:
+            stop = batch_size
+        else:
+            stop = start + int(round(batch_size * w / total))
+        slices.append(slice(start, stop))
+        start = stop
+    return slices
+
+
+class DataParallelExecutorGroup(object):
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad, shared_group=None,
+                 logger=None, fixed_param_names=None, grad_req="write",
+                 state_names=None):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload if workload else [1] * len(contexts)
+        self.param_names = param_names
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.state_names = state_names or []
+
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+
+        self.data_names = [x[0] for x in data_shapes]
+        self.label_names = [x[0] for x in label_shapes] if label_shapes else []
+        self.batch_size = data_shapes[0][1][0]
+
+        self._grad_req = {}
+        for name in self.arg_names:
+            if name in self.param_names and name not in self.fixed_param_names:
+                self._grad_req[name] = grad_req if for_training else "null"
+            elif name in self.data_names:
+                self._grad_req[name] = grad_req if inputs_need_grad else "null"
+            else:
+                self._grad_req[name] = "null"
+
+        self.slices = _split_input_slice(self.batch_size, self.workload)
+        self.execs = []
+        self._bind_execs(data_shapes, label_shapes, shared_group)
+
+    def _bind_execs(self, data_shapes, label_shapes, shared_group):
+        all_shapes = dict((n, s) for n, s in data_shapes)
+        if label_shapes:
+            all_shapes.update(dict((n, s) for n, s in label_shapes))
+        for i, ctx in enumerate(self.contexts):
+            sl = self.slices[i]
+            dev_n = sl.stop - sl.start
+            dev_shapes = {
+                n: (dev_n,) + tuple(s[1:]) for n, s in all_shapes.items()}
+            exec_ = self.symbol.simple_bind(
+                ctx, grad_req=self._grad_req, **dev_shapes)
+            self.execs.append(exec_)
+        self.data_arrays = [
+            [(self.slices[i], e.arg_dict[name]) for i, e in enumerate(self.execs)]
+            for name in self.data_names]
+        self.label_arrays = [
+            [(self.slices[i], e.arg_dict[name]) for i, e in enumerate(self.execs)]
+            for name in self.label_names]
+        self.param_arrays = [
+            [e.arg_dict[name] for e in self.execs]
+            for name in self.arg_names if name in self.param_names]
+        self.grad_arrays = [
+            [e.grad_dict[name] for e in self.execs if name in e.grad_dict]
+            for name in self.arg_names
+            if name in self.param_names and self._grad_req.get(name) != "null"]
+        self.aux_arrays = [
+            [e.aux_dict[name] for e in self.execs] for name in self.aux_names]
+
+    # ------------------------------------------------------------------
+    def get_params(self, arg_params, aux_params):
+        """Copy (averaged) params out (reference executor_group.py:get_params)."""
+        for name, block in zip(
+                [n for n in self.arg_names if n in self.param_names],
+                self.param_arrays):
+            if len(block) == 1:
+                weight = block[0]
+            else:
+                acc = block[0].asnumpy()
+                for w in block[1:]:
+                    acc = acc + w.asnumpy()
+                weight = nd_mod.array(acc / len(block))
+            arg_params[name] = weight.copyto(weight.context) if name not in arg_params \
+                else arg_params[name]
+            arg_params[name]._data = weight._data
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            aux_params.setdefault(name, block[0].copy())
+            aux_params[name]._data = block[0]._data
+
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        for e in self.execs:
+            e.copy_params_from(arg_params, aux_params, allow_extra_params=allow_extra)
+
+    def _load_slices(self, arrays, batch_arrays):
+        for per_name, src in zip(arrays, batch_arrays):
+            for sl, dst in per_name:
+                dst._data = src[sl]._data if (sl.stop - sl.start) != src.shape[0] \
+                    else src._data
+
+    def forward(self, data_batch, is_train=None):
+        """Slice batch onto devices + forward (reference executor_group.py:436)."""
+        if is_train is None:
+            is_train = self.for_training
+        self._load_slices(self.data_arrays, data_batch.data)
+        if is_train and self.label_arrays and data_batch.label:
+            self._load_slices(self.label_arrays, data_batch.label)
+        elif self.label_arrays and data_batch.label:
+            self._load_slices(self.label_arrays, data_batch.label)
+        for e in self.execs:
+            e.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        """Backward on each executor (reference executor_group.py:572)."""
+        assert self.for_training, "re-bind with for_training=True to run backward"
+        for i, e in enumerate(self.execs):
+            og = None
+            if out_grads is not None:
+                og = [g[self.slices[i]] for g in out_grads]
+            e.backward(og)
+
+    def get_outputs(self, merge_multi_context=True):
+        outputs = [[e.outputs[i] for e in self.execs]
+                   for i in range(len(self.execs[0].outputs))]
+        if merge_multi_context:
+            return [outs[0] if len(outs) == 1 else nd_mod.concat(*outs, dim=0)
+                    for outs in outputs]
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        grads = [[e.grad_dict[name] for e in self.execs] for name in self.data_names]
+        if merge_multi_context:
+            return [g[0] if len(g) == 1 else nd_mod.concat(*g, dim=0) for g in grads]
+        return grads
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        """Per-device metric update (reference executor_group.py:601)."""
+        for i, e in enumerate(self.execs):
+            labels_slice = []
+            for label in labels:
+                sl = self.slices[i]
+                labels_slice.append(label[sl] if (sl.stop - sl.start) != label.shape[0]
+                                    else label)
+            eval_metric.update_dict(
+                dict(zip(self.label_names, labels_slice)),
+                dict(zip(self.output_names, e.outputs)))
+
+    def install_monitor(self, mon):
+        for e in self.execs:
+            e.set_monitor_callback(mon.stat_helper if hasattr(mon, "stat_helper") else mon)

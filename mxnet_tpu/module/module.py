@@ -1,0 +1,337 @@
+"""Module: symbolic computation over one Symbol.
+
+API parity with reference ``python/mxnet/module/module.py`` (bind :422,
+init_params, init_optimizer :474, forward/backward, update :644,
+save/load_checkpoint).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..context import Context, cpu
+from ..initializer import Uniform, InitDesc
+from ..ndarray import ndarray as nd_mod
+from .base_module import BaseModule, _check_input_names
+from .executor_group import DataParallelExecutorGroup
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = [cpu()]
+        if isinstance(context, Context):
+            context = [context]
+        self._context = context
+        self._work_load_list = work_load_list
+
+        self._symbol = symbol
+        data_names = list(data_names) if data_names is not None else []
+        label_names = list(label_names) if label_names is not None else []
+        state_names = list(state_names) if state_names is not None else []
+        fixed_param_names = list(fixed_param_names) if fixed_param_names is not None else []
+
+        _check_input_names(symbol, data_names, "data", True)
+        _check_input_names(symbol, label_names, "label", False)
+        _check_input_names(symbol, state_names, "state", True)
+        _check_input_names(symbol, fixed_param_names, "fixed_param", True)
+
+        arg_names = symbol.list_arguments()
+        input_names = data_names + label_names + state_names
+        self._param_names = [x for x in arg_names if x not in input_names]
+        self._fixed_param_names = fixed_param_names
+        self._aux_names = symbol.list_auxiliary_states()
+        self._data_names = data_names
+        self._label_names = label_names
+        self._state_names = state_names
+        self._output_names = symbol.list_outputs()
+
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+        self._compression_params = compression_params
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._exec_group = None
+        self._data_shapes = None
+        self._label_shapes = None
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Load from checkpoint (reference module.py:load)."""
+        from .. import model
+
+        sym, args, auxs = model.load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """Save symbol + params (reference module.py:save_checkpoint)."""
+        self._symbol.save("%s-symbol.json" % prefix)
+        param_name = "%s-%04d.params" % (prefix, epoch)
+        self.save_params(param_name)
+        self.logger.info('Saved checkpoint to "%s"', param_name)
+        if save_optimizer_states:
+            state_name = "%s-%04d.states" % (prefix, epoch)
+            self.save_optimizer_states(state_name)
+
+    # ------------------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return list(zip(self._output_names, self._inferred_out_shapes))
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        if self._params_dirty:
+            self._sync_params_from_devices()
+        return (self._arg_params, self._aux_params)
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        """Initialize parameters (reference module.py:init_params)."""
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing the parameters"
+        if initializer is None:
+            initializer = Uniform(0.01)
+
+        if self._arg_params is None:
+            self._arg_params = {
+                name: nd_mod.zeros(shape, ctx=cpu())
+                for name, shape in self._param_shapes.items()}
+        if self._aux_params is None:
+            self._aux_params = {
+                name: nd_mod.zeros(shape, ctx=cpu())
+                for name, shape in self._aux_shapes.items()}
+
+        attrs = self._symbol.attr_dict()
+
+        def _impl(name, arr, cache):
+            """Init from provided dict if present, else via initializer
+            (reference module.py:init_params _impl)."""
+            if cache is not None:
+                if name in cache:
+                    src = cache[name]
+                    arr._data = src._data if hasattr(src, "_data") \
+                        else nd_mod.array(src)._data
+                    return
+                if not allow_missing:
+                    raise RuntimeError("%s is not presented" % name)
+            if initializer is not None:
+                initializer(InitDesc(name, attrs.get(name)), arr)
+
+        for name, arr in sorted(self._arg_params.items()):
+            _impl(name, arr, arg_params)
+        for name, arr in sorted(self._aux_params.items()):
+            _impl(name, arr, aux_params)
+
+        self.params_initialized = True
+        self._params_dirty = False
+        self._exec_group.set_params(self._arg_params, self._aux_params,
+                                    allow_extra=allow_extra)
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """Bind executors (reference module.py:bind → executor_group)."""
+        if force_rebind:
+            self._exec_group = None
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already binded, ignoring bind()")
+            return
+
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+        from ..io import DataDesc
+
+        data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                       for x in data_shapes]
+        if label_shapes is not None:
+            label_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                            for x in label_shapes]
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+
+        # infer parameter shapes once for init
+        shape_kwargs = dict(data_shapes)
+        if label_shapes:
+            shape_kwargs.update(dict(label_shapes))
+        arg_shapes, out_shapes, aux_shapes = self._symbol.infer_shape(**shape_kwargs)
+        self._inferred_out_shapes = out_shapes
+        arg_names = self._symbol.list_arguments()
+        self._param_shapes = {
+            n: s for n, s in zip(arg_names, arg_shapes) if n in self._param_names}
+        self._aux_shapes = dict(zip(self._aux_names, aux_shapes))
+
+        self._exec_group = DataParallelExecutorGroup(
+            self._symbol, self._context, self._work_load_list, data_shapes,
+            label_shapes, self._param_names, for_training, inputs_need_grad,
+            fixed_param_names=self._fixed_param_names, grad_req=grad_req,
+            state_names=self._state_names)
+        self.binded = True
+
+        if self.params_initialized:
+            self._exec_group.set_params(self._arg_params, self._aux_params)
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """Set up optimizer/kvstore (reference module.py:474)."""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring...")
+            return
+
+        from ..model import _create_kvstore
+
+        batch_size = self._exec_group.batch_size
+        kvstore_obj, update_on_kvstore = _create_kvstore(
+            kvstore, len(self._context), self._arg_params)
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(
+                [n for n in self._symbol.list_arguments() if n in self._param_names])}
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = 1.0 / batch_size
+            optimizer = opt.create(optimizer, param_idx2name=idx2name,
+                                   **optimizer_params)
+        self._optimizer = optimizer
+        self._kvstore = kvstore_obj
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+
+        if kvstore_obj:
+            if self._compression_params:
+                kvstore_obj.set_gradient_compression(self._compression_params)
+            param_names = [n for n in self._symbol.list_arguments()
+                           if n in self._param_names]
+            for idx, name in enumerate(param_names):
+                kvstore_obj.init(idx, self._arg_params[name])
+            if update_on_kvstore:
+                kvstore_obj.set_optimizer(self._optimizer)
+        if not update_on_kvstore:
+            self._updater = opt.get_updater(optimizer)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        self._exec_group.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec_group.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply optimizer to gradients (reference module.py:644 →
+        model._update_params[_on_kvstore])."""
+        assert self.binded and self.params_initialized and self.optimizer_initialized
+        self._params_dirty = True
+        param_names = [n for n in self._symbol.list_arguments()
+                       if n in self._param_names]
+        if self._update_on_kvstore and self._kvstore:
+            for idx, name in enumerate(param_names):
+                grads = [e.grad_dict[name] for e in self._exec_group.execs
+                         if name in e.grad_dict]
+                if not grads:
+                    continue
+                self._kvstore.push(idx, grads, priority=-idx)
+                weights = [e.arg_dict[name] for e in self._exec_group.execs]
+                self._kvstore.pull(idx, weights, priority=-idx)
+            return
+        for idx, name in enumerate(param_names):
+            grads = [e.grad_dict[name] for e in self._exec_group.execs
+                     if name in e.grad_dict]
+            if not grads:
+                continue
+            if self._kvstore:
+                self._kvstore.push(idx, grads, priority=-idx)
+                self._kvstore.pull(idx, grads, priority=-idx)
+            for e, g in zip(self._exec_group.execs, grads):
+                self._updater(idx, g, e.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec_group.get_outputs(merge_multi_context=merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and self.inputs_need_grad
+        return self._exec_group.get_input_grads(merge_multi_context=merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._exec_group.update_metric(eval_metric, labels, pre_sliced)
+
+    def _sync_params_from_devices(self):
+        self._exec_group.get_params(self._arg_params, self._aux_params)
+        if self._kvstore and self._update_on_kvstore:
+            pass
+        self._params_dirty = False
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+
+    def install_monitor(self, mon):
+        assert self.binded
+        self._exec_group.install_monitor(mon)
+
+    def reshape(self, data_shapes, label_shapes=None):
+        assert self.binded
+        self.binded = False
+        self._exec_group = None
+        self.bind(data_shapes, label_shapes, self.for_training,
+                  self.inputs_need_grad, force_rebind=True)
+        if self.params_initialized:
+            self._exec_group.set_params(self._arg_params, self._aux_params)

@@ -1,0 +1,219 @@
+"""RecordIO: the reference's binary record format, byte-compatible.
+
+API parity with reference ``python/mxnet/recordio.py`` (MXRecordIO :36,
+MXIndexedRecordIO :170, IRHeader/pack/unpack/pack_img/unpack_img :291-367)
+and dmlc-core RecordIO framing (SURVEY Appendix B): each record is
+``uint32 magic(0xced7230a) | uint32 lrec | payload | pad-to-4``, where
+lrec's upper 3 bits are the continuation flag (0 = complete record) and
+lower 29 bits the payload length. Keeping the format means existing ``.rec``
+datasets and ``im2rec`` outputs load unchanged.
+"""
+from __future__ import annotations
+
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xCED7230A
+_LREC_FLAG_BITS = 29
+_LREC_MASK = (1 << _LREC_FLAG_BITS) - 1
+
+
+class MXRecordIO(object):
+    """Sequential .rec reader/writer (reference recordio.py:36)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.fid = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fid = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fid = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError("Invalid flag %s" % self.flag)
+        self.pid = os.getpid()
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["fid"] = None
+        d["pid"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+
+    def _check_pid(self, allow_reset=False):
+        if self.pid != os.getpid():
+            if allow_reset:
+                self.reset()
+            else:
+                raise MXNetError("forked process must call reset() first")
+
+    def close(self):
+        if self.fid is not None and not self.fid.closed:
+            self.fid.close()
+        self.pid = None
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        """Write one record (dmlc framing, single chunk)."""
+        assert self.writable
+        self._check_pid(allow_reset=False)
+        lrec = len(buf) & _LREC_MASK
+        self.fid.write(struct.pack("<II", _MAGIC, lrec))
+        self.fid.write(buf)
+        pad = (4 - (len(buf) % 4)) % 4
+        if pad:
+            self.fid.write(b"\x00" * pad)
+
+    def read(self):
+        """Read next record or None at EOF."""
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        head = self.fid.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _MAGIC:
+            raise MXNetError("Invalid RecordIO magic 0x%x" % magic)
+        cflag = lrec >> _LREC_FLAG_BITS
+        length = lrec & _LREC_MASK
+        payload = self.fid.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.fid.read(pad)
+        if cflag == 0:
+            return payload
+        # multi-chunk record: continue until end flag (cflag 3)
+        chunks = [payload]
+        while cflag in (1, 2):
+            head = self.fid.read(8)
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _MAGIC:
+                raise MXNetError("Invalid RecordIO magic in continuation")
+            cflag = lrec >> _LREC_FLAG_BITS
+            length = lrec & _LREC_MASK
+            chunks.append(self.fid.read(length))
+            pad = (4 - (length % 4)) % 4
+            if pad:
+                self.fid.read(pad)
+        return b"".join(chunks)
+
+    def tell(self):
+        return self.fid.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access .rec via .idx file (reference recordio.py:170)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.writable and self.fid is not None and not self.fid.closed:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write("%s\t%d\n" % (str(k), self.idx[k]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        self.fid.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# header layout (reference recordio.py:291): flag uint32, label float32 (or
+# flag>0 → label array of that many float32s after the header), id uint64,
+# id2 uint64
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a header + byte payload into one record (reference recordio.py:pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+        return struct.pack(_IR_FORMAT, *header) + s
+    label = np.asarray(header.label, dtype=np.float32)
+    header = header._replace(flag=label.size, label=0)
+    return struct.pack(_IR_FORMAT, *header) + label.tobytes() + s
+
+
+def unpack(s):
+    """Unpack a record into (IRHeader, payload) (reference recordio.py:unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array (reference recordio.py:pack_img). Encodes via
+    mxnet_tpu.image (PNG/raw fallback without OpenCV)."""
+    from . import image as image_mod
+
+    buf = image_mod.imencode(img, img_fmt, quality)
+    return pack(header, buf)
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack a record into (IRHeader, image array)."""
+    from . import image as image_mod
+
+    header, img_bytes = unpack(s)
+    img = image_mod.imdecode(img_bytes, 1 if iscolor != 0 else 0, to_numpy=True)
+    return header, img

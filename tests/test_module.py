@@ -1,0 +1,268 @@
+"""Module/io/recordio/image tests — mirrors reference test_module.py,
+test_io.py, test_recordio.py, test_image.py and the train/test_mlp.py
+convergence check."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu import io as mio
+from mxnet_tpu import recordio
+from mxnet_tpu.module import Module, BucketingModule, SequentialModule
+
+
+def _mlp_symbol(num_classes=4):
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=32)
+    act1 = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act1, name="fc2", num_hidden=num_classes)
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy_classification(n=256, d=16, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, d) * 3
+    y = rng.randint(0, k, n)
+    x = centers[y] + rng.randn(n, d)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def test_ndarray_iter():
+    x = np.arange(40).reshape(10, 4).astype(np.float32)
+    y = np.arange(10).astype(np.float32)
+    it = mio.NDArrayIter(x, y, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[-1].pad == 2
+    it.reset()
+    b0 = next(it)
+    np.testing.assert_allclose(b0.data[0].asnumpy(), x[:3])
+    np.testing.assert_allclose(b0.label[0].asnumpy(), y[:3])
+    # discard mode
+    it2 = mio.NDArrayIter(x, y, batch_size=3, last_batch_handle="discard")
+    assert len(list(it2)) == 3
+    # provide_data/label descriptors
+    assert it.provide_data[0].name == "data"
+    assert it.provide_data[0].shape == (3, 4)
+
+
+def test_resize_and_prefetch_iter():
+    x = np.random.randn(10, 4).astype(np.float32)
+    it = mio.NDArrayIter(x, None, batch_size=2)
+    r = mio.ResizeIter(it, 3)
+    assert len(list(r)) == 3
+    it2 = mio.NDArrayIter(x, np.zeros(10, np.float32), batch_size=5)
+    p = mio.PrefetchingIter(it2)
+    n = 0
+    for batch in p:
+        n += 1
+        assert batch.data[0].shape == (5, 4)
+    assert n == 2
+
+
+def test_module_mlp_convergence():
+    """Small real training asserting accuracy — reference tests/python/train/
+    test_mlp.py pattern (SURVEY §4.1)."""
+    x, y = _toy_classification()
+    train_iter = mio.NDArrayIter(x, y, batch_size=32, shuffle=True)
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train_iter, num_epoch=12,
+            optimizer_params={"learning_rate": 0.5},
+            eval_metric="acc")
+    score = mod.score(mio.NDArrayIter(x, y, batch_size=32), "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_forward_shapes_and_predict():
+    x, y = _toy_classification(n=64)
+    it = mio.NDArrayIter(x, y, batch_size=16)
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (64, 4)
+    # outputs sum to 1 (softmax)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), 1.0, rtol=1e-4)
+    assert mod.output_shapes[0][1] == (16, 4)
+    assert mod.data_shapes[0].shape == (16, 16)
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    x, y = _toy_classification(n=64)
+    it = mio.NDArrayIter(x, y, batch_size=16)
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 1)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0001.params")
+
+    mod2 = Module.load(prefix, 1, context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    out1 = mod.predict(it).asnumpy()
+    out2 = mod2.predict(it).asnumpy()
+    np.testing.assert_allclose(out1, out2, rtol=1e-5)
+
+
+def test_module_input_grads():
+    x, y = _toy_classification(n=32)
+    it = mio.NDArrayIter(x, y, batch_size=16)
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             inputs_need_grad=True)
+    mod.init_params()
+    batch = next(it)
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    g = mod.get_input_grads()[0]
+    assert g.shape == (16, 16)
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        # weights shared across buckets (only time dim varies), the
+        # variable-length RNN pattern bucketing exists for
+        data = sym.var("data")  # (batch, seq_len, 6)
+        pooled = sym.mean(data, axis=1)
+        fc = sym.FullyConnected(pooled, name="fc", num_hidden=4)
+        s = sym.SoftmaxOutput(fc, name="softmax")
+        return s, ("data",), ("softmax_label",)
+
+    mod = BucketingModule(sym_gen, default_bucket_key=10, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    batch = mio.DataBatch(
+        data=[mx.nd.ones((8, 5, 6))], label=[mx.nd.zeros((8,))], bucket_key=5,
+        provide_data=[mio.DataDesc("data", (8, 5, 6))],
+        provide_label=[mio.DataDesc("softmax_label", (8,))])
+    mod.forward(batch, is_train=False)
+    assert mod.get_outputs()[0].shape == (8, 4)
+    # switching back reuses the default-bucket module
+    batch10 = mio.DataBatch(
+        data=[mx.nd.ones((8, 10, 6))], label=[mx.nd.zeros((8,))], bucket_key=10,
+        provide_data=[mio.DataDesc("data", (8, 10, 6))],
+        provide_label=[mio.DataDesc("softmax_label", (8,))])
+    mod.forward(batch10, is_train=False)
+    assert mod.get_outputs()[0].shape == (8, 4)
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(b"payload-%d" % i)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert r.read() == b"payload-%d" % i
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(5):
+        w.write_idx(i, b"rec-%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx_path, path, "r")
+    assert r.keys == [0, 1, 2, 3, 4]
+    assert r.read_idx(3) == b"rec-3"
+    assert r.read_idx(0) == b"rec-0"
+    r.close()
+
+
+def test_pack_unpack_header():
+    h = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack(h, b"imgbytes")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"imgbytes"
+    assert h2.label == 3.0 and h2.id == 7
+    # array label
+    h3 = recordio.IRHeader(0, np.array([1.0, 2.0], np.float32), 9, 0)
+    s3 = recordio.pack(h3, b"x")
+    h4, p4 = recordio.unpack(s3)
+    np.testing.assert_allclose(h4.label, [1.0, 2.0])
+
+
+def test_image_encode_decode_resize():
+    from mxnet_tpu import image
+
+    arr = np.random.randint(0, 255, (20, 30, 3)).astype(np.uint8)
+    buf = image.imencode(arr, ".png")
+    img = image.imdecode(buf)
+    assert img.shape == (20, 30, 3)
+    np.testing.assert_array_equal(img.asnumpy(), arr)  # png lossless
+
+    small = image.imresize(img, 15, 10)
+    assert small.shape == (10, 15, 3)
+    rs = image.resize_short(img, 10)
+    assert min(rs.shape[:2]) == 10
+    crop, _ = image.center_crop(img, (8, 8))
+    assert crop.shape == (8, 8, 3)
+
+
+def test_image_augmenters():
+    from mxnet_tpu import image
+
+    img = mx.nd.array(np.random.randint(0, 255, (32, 32, 3)).astype(np.float32))
+    augs = image.CreateAugmenter((3, 24, 24), rand_crop=True, rand_mirror=True,
+                                 brightness=0.1, contrast=0.1, saturation=0.1,
+                                 hue=0.1, pca_noise=0.1,
+                                 mean=np.array([1.0, 1.0, 1.0]),
+                                 std=np.array([2.0, 2.0, 2.0]))
+    out = img
+    for a in augs:
+        out = a(out)
+    assert out.shape == (24, 24, 3)
+
+
+def test_image_iter_rec(tmp_path):
+    from mxnet_tpu import image
+
+    # build a small .rec of random images (im2rec output format)
+    rec_path = str(tmp_path / "imgs.rec")
+    idx_path = str(tmp_path / "imgs.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(7):
+        arr = np.random.randint(0, 255, (36, 36, 3)).astype(np.uint8)
+        packed = recordio.pack_img(recordio.IRHeader(0, float(i % 3), i, 0),
+                                   arr, img_fmt=".png")
+        w.write_idx(i, packed)
+    w.close()
+
+    it = image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                         path_imgrec=rec_path, rand_crop=True)
+    batch = next(it)
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    assert batch.label[0].shape == (4,)
+
+    # the C++-style registry iterator wrapper
+    it2 = mio.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 32, 32),
+                              batch_size=4, preprocess_threads=0,
+                              prefetch_buffer=0)
+    b2 = it2.next()
+    assert b2.data[0].shape == (4, 3, 32, 32)
+
+
+def test_sequential_module():
+    net1 = sym.FullyConnected(sym.var("data"), name="fc1", num_hidden=8)
+    net2 = sym.SoftmaxOutput(sym.FullyConnected(sym.var("data"), name="fc2",
+                                                num_hidden=4), name="softmax")
+    mod = SequentialModule()
+    mod.add(Module(net1, label_names=None, context=mx.cpu()))
+    mod.add(Module(net2, context=mx.cpu()),
+            take_labels=True, auto_wiring=True)
+    mod.bind(data_shapes=[("data", (8, 16))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    batch = mio.DataBatch(data=[mx.nd.ones((8, 16))],
+                          label=[mx.nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    assert mod.get_outputs()[0].shape == (8, 4)
