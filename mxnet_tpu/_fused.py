@@ -1,0 +1,35 @@
+"""Closure→jaxpr conversion for the fused fwd/bwd training pair.
+
+``jax.closure_convert`` hoists only inexact-dtype residuals out of a vjp
+closure; any bool/int intermediate (relu masks, argmax indices, BN flags)
+stays captured as a tracer and leaks across jit boundaries. This helper
+hoists EVERY captured constant by materialising the closure's jaxpr
+directly, so the backward half of the training pair is a fully pure
+function of (residuals, cotangents) — the equivalent of the reference
+splitting one nnvm graph into forward and backward segments that
+communicate only through saved node outputs
+(src/executor/graph_executor.cc:231-295).
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["convert_closure"]
+
+
+def convert_closure(fun, *examples):
+    """Convert closure ``fun`` into (pure_fn, residuals).
+
+    ``fun`` is traced with abstract ``examples``; every value it captures
+    from an enclosing trace is hoisted into the returned ``residuals`` list
+    (valid jit outputs). ``pure_fn(residuals, *args)`` replays the jaxpr.
+    """
+    closed, shapes = jax.make_jaxpr(fun, return_shape=True)(*examples)
+    out_tree = jax.tree_util.tree_structure(shapes)
+    jaxpr, consts = closed.jaxpr, list(closed.consts)
+
+    def pure_fn(residuals, *args):
+        outs = jax.core.eval_jaxpr(jaxpr, list(residuals), *args)
+        return jax.tree_util.tree_unflatten(out_tree, outs)
+
+    return pure_fn, consts

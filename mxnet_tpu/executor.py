@@ -15,7 +15,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
-from . import _global
+from . import _fused, _global
 from .base import MXNetError
 from .context import Context, current_context
 from .ndarray.ndarray import NDArray
@@ -58,8 +58,9 @@ class Executor(object):
 
         self.outputs: List[NDArray] = []
         self._monitor_callback = None
-        self._fwd_cache: Dict[bool, Any] = {}
-        self._vjp_fn = None
+        self._fwd_cache: Dict[Any, Any] = {}
+        self._residuals = None
+        self._bwd_pair = None
         self._output_shapes = None
 
     @staticmethod
@@ -99,6 +100,59 @@ class Executor(object):
         self._fwd_cache[is_train] = jit_fn
         return jit_fn
 
+    def _train_pair(self, diff_names, shape_sig):
+        """Cached (fwd_jit, bwd_jit) pair for training: fwd returns
+        (outputs, aux_updates, residuals); bwd maps (residuals, cotangents)
+        to input gradients. Residuals are hoisted out of the vjp closure so
+        both halves compile exactly once. Keyed on the input shape
+        signature: a reshaped executor gets a fresh pair rather than a
+        backward replaying a stale jaxpr."""
+        key = ("fb", diff_names, shape_sig)
+        if key in self._fwd_cache:
+            return self._fwd_cache[key]
+        sym = self._symbol
+        cell = {}
+
+        def run_graph(arg_vals, aux_vals, rng):
+            prev = _global.set_train(True)
+            _global.push_rng_key(rng)
+            try:
+                vm = dict(arg_vals)
+                vm.update(aux_vals)
+                aux_updates = {}
+                outs = sym.eval_jax(vm, aux_updates=aux_updates)
+            finally:
+                _global.pop_rng_key()
+                _global.set_train(prev)
+            return tuple(outs), aux_updates
+
+        def fwd(diff_vals, const_args, aux_vals, rng):
+            def f(dv):
+                av = dict(const_args)
+                av.update(zip(diff_names, dv))
+                return run_graph(av, aux_vals, rng)
+
+            outs, vjp_fn, aux = jax.vjp(f, list(diff_vals), has_aux=True)
+
+            def vjp_flat(*cts_flat):
+                return vjp_fn(tuple(cts_flat))
+
+            examples = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
+            vjp_pure, res = _fused.convert_closure(vjp_flat, *examples)
+            cell["bwd"] = vjp_pure
+            return outs, aux, res
+
+        def bwd(res, cts):
+            if "bwd_jit" not in cell:
+                raw = cell["bwd"]
+                cell["bwd_jit"] = jax.jit(lambda res, cts: raw(res, *cts))
+            (grads,) = cell["bwd_jit"](list(res), list(cts))
+            return grads
+
+        pair = {"fwd": jax.jit(fwd), "bwd": bwd}
+        self._fwd_cache[key] = pair
+        return pair
+
     def forward(self, is_train=False, **kwargs):
         """Run forward (reference executor.py:114). kwargs update arg data."""
         for name, val in kwargs.items():
@@ -112,24 +166,24 @@ class Executor(object):
         rng = _global.next_key()
 
         if is_train:
-            # capture the vjp of the whole graph w.r.t. grad-requiring args
-            diff_names = [n for n in self.arg_names
-                          if self.grad_req.get(n, "null") != "null"
-                          and n in self.grad_dict]
-            const_args = {n: v for n, v in arg_vals.items() if n not in diff_names}
-            jit_fn = self._graph_fn(True)
-
-            def closed(diff_vals):
-                av = dict(const_args)
-                av.update(dict(zip(diff_names, diff_vals)))
-                return jit_fn(av, aux_vals, rng)
-
-            outputs, self._vjp_fn, aux_updates = jax.vjp(
-                closed, [arg_vals[n] for n in diff_names], has_aux=True)
+            # fused fwd+bwd: outputs + vjp residuals from ONE compiled
+            # module; backward is a second compiled module (reference
+            # GraphExecutor full fwd+bwd graph, graph_executor.cc:231-295)
+            diff_names = tuple(n for n in self.arg_names
+                               if self.grad_req.get(n, "null") != "null"
+                               and n in self.grad_dict)
+            shape_sig = tuple(sorted(
+                (n, v.shape, str(v.dtype)) for n, v in arg_vals.items()))
+            pair = self._train_pair(diff_names, shape_sig)
+            const_args = {n: v for n, v in arg_vals.items()
+                          if n not in diff_names}
+            outputs, aux_updates, self._residuals = pair["fwd"](
+                [arg_vals[n] for n in diff_names], const_args, aux_vals, rng)
+            self._bwd_pair = pair
             self._diff_names = diff_names
         else:
             outputs, aux_updates = self._graph_fn(False)(arg_vals, aux_vals, rng)
-            self._vjp_fn = None
+            self._residuals = None
         for name, val in aux_updates.items():
             if name in self.aux_dict:
                 self.aux_dict[name]._data = val
@@ -146,7 +200,7 @@ class Executor(object):
         grad_arrays honoring per-arg grad_req write/add."""
         import jax.numpy as jnp
 
-        if self._vjp_fn is None:
+        if self._residuals is None:
             raise MXNetError("backward called before forward(is_train=True)")
         if out_grads is None:
             cts = tuple(jnp.ones(s, dtype=o._data.dtype)
@@ -156,7 +210,7 @@ class Executor(object):
                 out_grads = [out_grads]
             cts = tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
                         for g in out_grads)
-        (grads,) = self._vjp_fn(cts)
+        grads = self._bwd_pair["bwd"](self._residuals, list(cts))
         for name, g in zip(self._diff_names, grads):
             tgt = self.grad_dict.get(name)
             if tgt is None:

@@ -23,7 +23,7 @@ from collections import OrderedDict
 import jax
 import numpy as np
 
-from .. import _global, autograd
+from .. import _fused, _global, autograd
 from ..base import MXNetError
 from ..context import Context, cpu, current_context
 from ..ndarray import ndarray as nd_mod
@@ -353,6 +353,67 @@ def _indent(s_, num_spaces):
     return first + "\n" + "\n".join(" " * num_spaces + line for line in lines)
 
 
+class _TrainPair(object):
+    """One compiled forward module + one compiled backward module.
+
+    ``forward`` runs a jitted function that computes outputs, aux updates,
+    and the vjp residuals (via jax.closure_convert, which hoists the vjp
+    closure's captured intermediates into explicit arrays). ``backward``
+    runs the hoisted, jitted transpose on (residuals, cotangents). Both are
+    traced exactly once per shape signature — the TPU counterpart of the
+    reference building forward+backward as one nnvm graph up front
+    (src/executor/graph_executor.cc:231-295) instead of re-deriving the
+    backward every iteration.
+    """
+
+    def __init__(self, base_fn, diff_pnames, diff_arg_idx):
+        self._diff_pnames = list(diff_pnames)
+        self._diff_arg_idx = list(diff_arg_idx)
+        self._cell = {}
+        cell = self._cell
+
+        def fwd(diff_pvals, const_pvals, rng, arg_datas):
+            def f(dp_list, da_list):
+                pv = dict(const_pvals)
+                pv.update(zip(diff_pnames, dp_list))
+                full = list(arg_datas)
+                for i, a in zip(diff_arg_idx, da_list):
+                    full[i] = a
+                return base_fn(pv, rng, *full)
+
+            da_list = [arg_datas[i] for i in diff_arg_idx]
+            outs, vjp_fn, aux = jax.vjp(f, list(diff_pvals), da_list,
+                                        has_aux=True)
+            flat_outs, out_tree = jax.tree_util.tree_flatten(outs)
+
+            def vjp_flat(*cts_flat):
+                return vjp_fn(jax.tree_util.tree_unflatten(
+                    out_tree, list(cts_flat)))
+
+            examples = [jax.ShapeDtypeStruct(o.shape, o.dtype)
+                        for o in flat_outs]
+            vjp_pure, res = _fused.convert_closure(vjp_flat, *examples)
+            cell["bwd"] = vjp_pure
+            cell["single"] = not isinstance(outs, (tuple, list))
+            return outs, aux, res
+
+        self._fwd_jit = jax.jit(fwd)
+
+    def forward(self, diff_pvals, const_pvals, rng, arg_datas):
+        outs, aux, res = self._fwd_jit(diff_pvals, const_pvals, rng,
+                                       list(arg_datas))
+        single = self._cell["single"]
+        outs_t = (outs,) if single else tuple(outs)
+        return outs_t, aux, res, single
+
+    def backward(self, res, cts_flat):
+        if "bwd_jit" not in self._cell:
+            bwd = self._cell["bwd"]
+            self._cell["bwd_jit"] = jax.jit(
+                lambda res, cts: bwd(res, *cts))
+        return self._cell["bwd_jit"](list(res), list(cts_flat))
+
+
 class HybridBlock(Block):
     """Block that can compile its forward (reference gluon/block.py:672).
 
@@ -430,8 +491,19 @@ class HybridBlock(Block):
             if self._active:
                 return self._call_cached(x, *args)
             return self._eager_forward(x, *args)
+        from .. import symbol as sym_mod
+
+        if isinstance(x, sym_mod.Symbol):
+            # symbolic trace (reference block.py:_build_cache / export path):
+            # params enter as Symbol variables; children recurse through the
+            # same dispatch since their __call__ receives Symbols
+            params = {name: p.var() for name, p in self._reg_params.items()}
+            for name, p in self._reg_params.items():
+                if p.grad_req == "null":
+                    params[name]._outputs[0][0]._forced_aux = True
+            return self.hybrid_forward(sym_mod, x, *args, **params)
         raise MXNetError(
-            "HybridBlock requires NDArray inputs, got %s" % type(x))
+            "HybridBlock requires NDArray or Symbol inputs, got %s" % type(x))
 
     # -- compiled path (CachedOp equivalent) --------------------------------
     def _call_cached(self, x, *args):
@@ -455,11 +527,6 @@ class HybridBlock(Block):
                      if p._data is not None}
 
         train = bool(_global.is_train())
-        key = (train,)
-        if key not in self._jit_cache:
-            self._jit_cache[key] = self._build_jit_fn(in_fmt, train)
-        jit_fn = self._jit_cache[key]
-
         rng = _global.next_key()
         record = autograd.is_recording() and (
             any(a is not None and a._in_graph for a in flat_args)
@@ -468,38 +535,39 @@ class HybridBlock(Block):
         param_nds = {name: params[name].data(x.context) for name in pvals}
 
         if not record:
-            out_datas, aux_out = jit_fn(pvals, rng, *arg_datas)
+            key = (train,)
+            if key not in self._jit_cache:
+                self._jit_cache[key] = self._build_jit_fn(in_fmt, train)
+            out_datas, aux_out = self._jit_cache[key](pvals, rng, *arg_datas)
             self._apply_aux(params, aux_out, x.context)
             return self._wrap_outputs(out_datas, x.context)
 
-        # one tape node for the whole compiled block: vjp over the jitted fn
-        diff_pnames = [n for n in pvals if params[n].grad_req != "null"]
+        # fused fwd+bwd: one compiled forward module (outputs + residuals)
+        # and one compiled backward module — the counterpart of the
+        # reference GraphExecutor building fwd+bwd as a single graph
+        # (graph_executor.cc:231-295). No retracing on later steps: the
+        # pair is cached per (shapes, dtypes) signature.
+        diff_pnames = tuple(n for n in pvals if params[n].grad_req != "null")
         const_pvals = {n: v for n, v in pvals.items() if n not in diff_pnames}
-        diff_arg_idx = [i for i, a in enumerate(flat_args) if a is not None]
+        diff_arg_idx = tuple(i for i, a in enumerate(flat_args) if a is not None)
+        shape_sig = tuple((a.shape, str(a.dtype)) for a in arg_datas
+                          if a is not None)
+        key = ("fb", train, diff_pnames, diff_arg_idx, shape_sig)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = _TrainPair(
+                self._base_fn(in_fmt, train), diff_pnames, diff_arg_idx)
+        pair = self._jit_cache[key]
 
-        def fn(diff_pv_list, diff_args_list):
-            pv = dict(const_pvals)
-            pv.update(dict(zip(diff_pnames, diff_pv_list)))
-            full_args = list(arg_datas)
-            for i, a in zip(diff_arg_idx, diff_args_list):
-                full_args[i] = a
-            return jit_fn(pv, rng, *full_args)
-
-        outputs, vjp_fn, aux_out = jax.vjp(
-            fn,
-            [pvals[n] for n in diff_pnames],
-            [arg_datas[i] for i in diff_arg_idx],
-            has_aux=True,
-        )
+        outs_t, aux_out, res, single = pair.forward(
+            [pvals[n] for n in diff_pnames], const_pvals, rng, arg_datas)
         self._apply_aux(params, aux_out, x.context)
-        single = not isinstance(outputs, (tuple, list))
-        outs_t = (outputs,) if single else tuple(outputs)
 
         node_inputs = [param_nds[n] for n in diff_pnames] + \
                       [flat_args[i] for i in diff_arg_idx]
 
-        def vjp_wrapper(gs):
-            p_grads, a_grads = vjp_fn(gs)
+        def vjp_wrapper(gs, _pair=pair, _res=res, _single=single):
+            p_grads, a_grads = _pair.backward(
+                _res, (gs,) if _single else tuple(gs))
             return tuple(p_grads) + tuple(a_grads)
 
         node = autograd._TapeNode(
@@ -525,10 +593,14 @@ class HybridBlock(Block):
             params[name].data(ctx)._data = val
 
     def _build_jit_fn(self, in_fmt, train):
-        """Build the jitted whole-block function. Parameters enter as a dict
-        pytree; the RNG key is traced so dropout/rrelu resample per call;
-        returns (outputs, aux_updates) where aux_updates carries new values
-        of non-differentiable state (BN moving stats)."""
+        """Jitted whole-block forward for the non-recording path."""
+        return jax.jit(self._base_fn(in_fmt, train))
+
+    def _base_fn(self, in_fmt, train):
+        """Build the traceable whole-block function. Parameters enter as a
+        dict pytree; the RNG key is traced so dropout/rrelu resample per
+        call; returns (outputs, aux_updates) where aux_updates carries new
+        values of non-differentiable state (BN moving stats)."""
         block = self
 
         def fn(pvals, rng, *arg_datas):
@@ -573,7 +645,7 @@ class HybridBlock(Block):
             block._out_fmt = 0
             return out._data, aux
 
-        return jax.jit(fn)
+        return fn
 
     def _wrap_outputs(self, out_datas, ctx):
         if isinstance(out_datas, tuple):
@@ -597,19 +669,25 @@ class HybridBlock(Block):
 
         sym = self._as_symbol()
         sym.save("%s-symbol.json" % path)
+        arg_names = set(sym.list_arguments())
+        aux_names = set(sym.list_auxiliary_states())
         arg_dict = {}
         for name, param in self.collect_params().items():
-            arg_dict["arg:%s" % name] = param.data()
+            if name in aux_names:
+                arg_dict["aux:%s" % name] = param.data()
+            elif name in arg_names:
+                arg_dict["arg:%s" % name] = param.data()
         io_utils.save("%s-%04d.params" % (path, epoch), arg_dict)
 
     def _as_symbol(self):
-        """Trace hybrid_forward with Symbol inputs to produce a graph
-        (reference _build_cache's symbolic trace)."""
+        """Trace this block (children included) with Symbol inputs to produce
+        a graph (reference _build_cache's symbolic trace)."""
         from .. import symbol as sym_mod
 
-        inputs = sym_mod.var("data")
-        params = {name: p.var() for name, p in self._reg_params.items()}
-        return self.hybrid_forward(sym_mod, inputs, **params)
+        out = self(sym_mod.var("data"))
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        return out
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
@@ -620,7 +698,11 @@ class SymbolBlock(HybridBlock):
     used to import exported models."""
 
     def __init__(self, outputs, inputs, params=None):
-        super().__init__(prefix=None, params=params)
+        super().__init__(prefix=None, params=None)
+        # param names come straight from the symbol graph: empty prefix
+        # (reference block.py SymbolBlock.__init__)
+        self._prefix = ""
+        self._params = ParameterDict("", params)
         from .. import symbol as sym_mod
 
         if isinstance(inputs, sym_mod.Symbol):

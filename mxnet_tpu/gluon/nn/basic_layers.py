@@ -237,6 +237,10 @@ class BatchNorm(HybridBlock):
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
         out = F.invoke("BatchNorm", x, gamma, beta, running_mean, running_var,
                        **self._kwargs)
+        from .. import block as _block_mod
+
+        if not isinstance(x, _block_mod.NDArray):
+            return out  # symbolic trace: single primary output, stats are aux
         y, batch_mean, batch_var = out
         from ... import _global
 

@@ -123,6 +123,11 @@ class Parameter(object):
     def _load_init(self, data, ctx):
         """Initialize from loaded data (reference parameter.py:_load_init)."""
         if self.shape:
+            if len(self.shape) != len(data.shape):
+                raise MXNetError(
+                    "Failed loading Parameter '%s' from saved params: "
+                    "rank mismatch expected %s vs saved %s"
+                    % (self.name, str(self.shape), str(data.shape)))
             for self_dim, data_dim in zip(self.shape, data.shape):
                 if self_dim != 0 and self_dim != data_dim:
                     raise MXNetError(
@@ -385,7 +390,21 @@ class ParameterDict(object):
             param = Parameter(name, **kwargs)
             self._params[name] = param
         else:
+            # constructor-only kwargs live under private names; route them
+            # through the same semantics as __init__ instead of raw setattr
+            _private = {"differentiable": "_differentiable",
+                        "stype": "_stype", "grad_stype": "_grad_stype",
+                        "allow_deferred_init": "_allow_deferred_init"}
             for k, v in kwargs.items():
+                if k in _private:
+                    existing = getattr(param, _private[k])
+                    if v != existing:
+                        raise MXNetError(
+                            "Cannot retrieve Parameter '%s' because desired "
+                            "attribute does not match with stored for attribute "
+                            "'%s': desired '%s' vs stored '%s'."
+                            % (name, k, str(v), str(existing)))
+                    continue
                 if hasattr(param, k) and getattr(param, k) is not None:
                     existing = getattr(param, k)
                     if k == "shape" and v is not None and len(v) == len(existing):

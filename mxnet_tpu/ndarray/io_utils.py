@@ -59,7 +59,7 @@ def _save_one(parts: List[bytes], a: np.ndarray):
     if dt not in _NP_TO_TYPE_FLAG:
         raise MXNetError("dtype %s not serializable to .params" % dt)
     parts.append(struct.pack("<I", NDARRAY_V2_MAGIC))
-    parts.append(struct.pack("<i", 1))  # kDefaultStorage
+    parts.append(struct.pack("<i", 0))  # kDefaultStorage (ndarray.h:63)
     parts.append(struct.pack("<I", a.ndim))
     parts.append(struct.pack("<%dq" % a.ndim, *a.shape))
     parts.append(struct.pack("<ii", 1, 0))  # Context: cpu(0)
@@ -91,12 +91,51 @@ def _load_shape_v2(r: _Reader) -> Tuple[int, ...]:
     return tuple(r.read("%dq" % ndim) if ndim > 1 else (r.read("q"),))
 
 
+def _load_sparse_v2(r: _Reader, stype: int) -> np.ndarray:
+    """Parse a V2 sparse entry (row_sparse=1: 1 aux [indices]; csr=2: 2 aux
+    [indptr, indices] — reference ndarray.cc NDArray::Save) and densify."""
+    nad = 1 if stype == 1 else 2
+    storage_shape = _load_shape_v2(r)
+    shape = _load_shape_v2(r)
+    if len(shape) == 0:
+        return np.zeros((), dtype=np.float32)
+    r.read("ii")  # context
+    type_flag = r.read("i")
+    aux = []
+    for _ in range(nad):
+        aux_type = r.read("i")
+        aux_shape = _load_shape_v2(r)
+        aux.append((np.dtype(_TYPE_FLAG_TO_NP[aux_type]), aux_shape))
+    dt = np.dtype(_TYPE_FLAG_TO_NP[type_flag])
+    count = int(np.prod(storage_shape)) if storage_shape else 0
+    data = np.frombuffer(r.read_bytes(count * dt.itemsize), dtype=dt)
+    data = data.reshape(storage_shape) if storage_shape else data
+    aux_data = []
+    for adt, ashape in aux:
+        acount = int(np.prod(ashape)) if ashape else 0
+        ad = np.frombuffer(r.read_bytes(acount * adt.itemsize), dtype=adt)
+        aux_data.append(ad.reshape(ashape) if ashape else ad)
+    dense = np.zeros(shape, dtype=dt)
+    if stype == 1:  # row_sparse: aux[0] = row indices
+        if aux_data[0].size:
+            dense[aux_data[0].astype(np.int64)] = data
+    else:  # csr: aux[0] = indptr, aux[1] = col indices
+        indptr, indices = aux_data
+        for row in range(shape[0]):
+            lo, hi = int(indptr[row]), int(indptr[row + 1])
+            if hi > lo:
+                dense[row, indices[lo:hi].astype(np.int64)] = data[lo:hi]
+    return dense
+
+
 def _load_one(r: _Reader) -> np.ndarray:
     magic = r.read("I")
     if magic == NDARRAY_V2_MAGIC:
         stype = r.read("i")
-        if stype != 1:
-            raise MXNetError("sparse .params entries not supported yet (stype=%d)" % stype)
+        if stype not in (0, 1, 2):
+            raise MXNetError("unknown storage type in .params (stype=%d)" % stype)
+        if stype != 0:
+            return _load_sparse_v2(r, stype)
         shape = _load_shape_v2(r)
     elif magic == NDARRAY_V1_MAGIC:
         shape = _load_shape_v2(r)

@@ -113,6 +113,18 @@ class Optimizer(object):
     def update(self, index, weight, grad, state):
         raise NotImplementedError()
 
+    def pure_step(self, w, g, state, t, lr, wd):
+        """Pure functional update used by the in-graph SPMD training step
+        (``mxnet_tpu.parallel.TrainStep``): returns ``(new_w, new_state)``
+        from jax arrays only. ``t`` is the traced 1-based update count so
+        bias-corrected optimizers (Adam family) compile once and stay
+        correct on every step. Subclasses implementing ``update`` via a
+        pure inner kernel override this with the same math."""
+        raise MXNetError(
+            "%s does not implement pure_step; it cannot be fused into an "
+            "SPMD train step — use Trainer/Updater instead"
+            % self.__class__.__name__)
+
     def update_multi_precision(self, index, weight, grad, state):
         """fp16 weights: run the update on the fp32 master copy, then cast
         back (reference mp_sgd_update semantics). Returns the new state."""
@@ -273,6 +285,13 @@ class SGD(Optimizer):
             weight._data, new_m = self._fused("sgd_mom", step)(w, g, _as_jax(state), lr, wd)
             return new_m
 
+    def pure_step(self, w, g, state, t, lr, wd):
+        g = self._preprocess(g, w, wd)
+        if self.momentum == 0.0:
+            return w - lr * g, state
+        m = self.momentum * state - lr * g
+        return w + m, m
+
 
 @register
 class NAG(Optimizer):
@@ -305,6 +324,13 @@ class NAG(Optimizer):
                 return w - lr * g2, m
             weight._data, new_m = self._fused("nag", step)(w, g, _as_jax(state), lr, wd)
             return new_m
+
+    def pure_step(self, w, g, state, t, lr, wd):
+        g = self._preprocess(g, w, wd)
+        if self.momentum == 0.0:
+            return w - lr * g, state
+        m = self.momentum * state + g
+        return w - lr * (self.momentum * m + g), m
 
 
 @register
@@ -344,6 +370,12 @@ class SignSGD(Optimizer):
 
         weight._data = self._fused("signsgd", step)(_as_jax(weight), _as_jax(grad), lr, wd)
 
+    def pure_step(self, w, g, state, t, lr, wd):
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return w - lr * (jnp.sign(g) + wd * w), state
+
 
 @register
 class Signum(Optimizer):
@@ -378,6 +410,16 @@ class Signum(Optimizer):
                 return w + lr * jnp.sign(m) - lr * self.wd_lh * w, m
             weight._data, new_m = self._fused("signum", step)(w, g, _as_jax(state), lr, wd)
             return new_m
+
+    def pure_step(self, w, g, state, t, lr, wd):
+        if self.momentum == 0.0:
+            g = g * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            return w - lr * (jnp.sign(g) + wd * w), state
+        g = self._preprocess(g, w, wd)
+        m = self.momentum * state - (1 - self.momentum) * g
+        return w + lr * jnp.sign(m) - lr * self.wd_lh * w, m
 
 
 @register
@@ -539,6 +581,15 @@ class Adam(Optimizer):
         weight._data = new_w
         return (m, v)
 
+    def pure_step(self, w, g, state, t, lr, wd):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        lr = lr * jnp.sqrt(1.0 - jnp.power(b2, t)) / (1.0 - jnp.power(b1, t))
+        g = self._preprocess(g, w, wd)
+        m, v = state
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        return w - lr * m / (jnp.sqrt(v) + eps), (m, v)
+
 
 @register
 class AdaGrad(Optimizer):
@@ -567,6 +618,11 @@ class AdaGrad(Optimizer):
             _as_jax(weight), _as_jax(grad), _as_jax(state), lr, wd)
         weight._data = new_w
         return new_h
+
+    def pure_step(self, w, g, state, t, lr, wd):
+        g = self._preprocess(g, w, wd)
+        h = state + g * g
+        return w - lr * g / jnp.sqrt(h + self.float_stable_eps), h
 
 
 @register
@@ -624,6 +680,25 @@ class RMSProp(Optimizer):
         weight._data = new_w
         return (n, mg, delta)
 
+    def pure_step(self, w, g, state, t, lr, wd):
+        g1, g2, eps = self.gamma1, self.gamma2, self.epsilon
+        g = self._preprocess(g, w, wd)
+        if not self.centered:
+            (n,) = state
+            n = (1 - g1) * g * g + g1 * n
+            w = w - lr * g / jnp.sqrt(n + eps)
+            if self.clip_weights:
+                w = jnp.clip(w, -self.clip_weights, self.clip_weights)
+            return w, (n,)
+        n, mg, delta = state
+        n = (1 - g1) * g * g + g1 * n
+        mg = (1 - g1) * g + g1 * mg
+        delta = g2 * delta - lr * g / jnp.sqrt(n - mg * mg + eps)
+        w = w + delta
+        if self.clip_weights:
+            w = jnp.clip(w, -self.clip_weights, self.clip_weights)
+        return w, (n, mg, delta)
+
 
 @register
 class AdaDelta(Optimizer):
@@ -655,6 +730,15 @@ class AdaDelta(Optimizer):
             _as_jax(weight), _as_jax(grad), acc_g, acc_d, wd)
         weight._data = new_w
         return (acc_g, acc_d)
+
+    def pure_step(self, w, g, state, t, lr, wd):
+        rho, eps = self.rho, self.epsilon
+        g = self._preprocess(g, w, wd)
+        acc_g, acc_d = state
+        acc_g = rho * acc_g + (1 - rho) * g * g
+        delta = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g + eps) * g
+        acc_d = rho * acc_d + (1 - rho) * delta * delta
+        return w - delta, (acc_g, acc_d)
 
 
 @register
@@ -696,6 +780,22 @@ class Ftrl(Optimizer):
         weight._data = new_w
         return (z, n)
 
+    def pure_step(self, w, g, state, t, lr, wd):
+        l1, beta = self.lamda1, self.beta
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        z, n = state
+        sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * w
+        n = n + g * g
+        w = jnp.where(
+            jnp.abs(z) > l1,
+            -(z - jnp.sign(z) * l1) / ((beta + jnp.sqrt(n)) / lr + wd),
+            0.0,
+        ).astype(w.dtype)
+        return w, (z, n)
+
 
 @register
 class Adamax(Optimizer):
@@ -728,6 +828,15 @@ class Adamax(Optimizer):
             _as_jax(weight), _as_jax(grad), m, u, _f32(lr), wd)
         weight._data = new_w
         return (m, u)
+
+    def pure_step(self, w, g, state, t, lr, wd):
+        b1, b2 = self.beta1, self.beta2
+        lr = lr / (1.0 - jnp.power(b1, t))
+        g = self._preprocess(g, w, wd)
+        m, u = state
+        m = b1 * m + (1 - b1) * g
+        u = jnp.maximum(b2 * u, jnp.abs(g))
+        return w - lr * m / (u + 1e-8), (m, u)
 
 
 @register

@@ -1,0 +1,315 @@
+"""Multi-device / multi-host execution: meshes, collectives, SPMD training.
+
+TPU-native replacement for the reference's entire distribution stack
+(SURVEY §5.8): the CPU/GPU reduce trees (``src/kvstore/comm.h:43``,
+``comm_tree.h:50``), NCCL backend (``kvstore_nccl.h:62``) and the ps-lite
+parameter server (``kvstore_dist.h:44``, ``kvstore_dist_server.h``) all
+collapse onto two primitives:
+
+* ``all_reduce`` — an eager cross-device allreduce over per-device gradient
+  copies, lowered to one XLA collective riding ICI (DCN across hosts). This
+  backs ``kvstore=tpu`` push/pull, keeping the imperative KVStore API.
+* ``TrainStep`` — the in-graph path: ONE jitted SPMD module per step
+  containing forward, loss, backward, gradient allreduce, and the optimizer
+  update. Parameters and optimizer state are replicated over the mesh; the
+  batch is sharded along ``dp``; XLA's GSPMD partitioner inserts the
+  collectives (the scaling-book recipe: pick a mesh, annotate shardings,
+  let XLA do the rest). Because reductions over the sharded batch axis are
+  global, every BatchNorm inside a TrainStep is a cross-device SyncBatchNorm
+  (reference ``src/operator/contrib/sync_batch_norm-inl.h``) for free.
+
+Multi-host: under ``jax.distributed`` the same code spans processes —
+``jax.devices()`` is the global device set, each process feeds its local
+shards, and the collectives ride ICI within a slice / DCN across slices.
+The PS server process of the reference disappears: weights stay resident
+in HBM (SURVEY §5.8 north star).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import _global, autograd
+from .base import MXNetError
+from .context import Context, cpu
+from .ndarray.ndarray import NDArray
+
+__all__ = ["device_mesh", "all_reduce", "broadcast_to_devices", "TrainStep"]
+
+
+# ---------------------------------------------------------------------------
+# mesh construction
+# ---------------------------------------------------------------------------
+
+
+def device_mesh(n_devices: Optional[int] = None, axis_names=("dp",),
+                shape: Optional[Sequence[int]] = None, devices=None) -> Mesh:
+    """Build a ``jax.sharding.Mesh``.
+
+    One axis (``dp``) by default — the reference's parity scope is data
+    parallelism (SURVEY §2.5). Pass ``shape``/``axis_names`` for 2-D+
+    meshes (e.g. ``shape=(4, 2), axis_names=('dp', 'mp')``).
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    devices = np.asarray(devices)
+    if shape is not None:
+        devices = devices.reshape(tuple(shape))
+        if len(axis_names) != devices.ndim:
+            raise MXNetError("axis_names must match mesh shape rank")
+    return Mesh(devices, tuple(axis_names))
+
+
+# ---------------------------------------------------------------------------
+# eager collectives (kvstore=tpu backend)
+# ---------------------------------------------------------------------------
+
+_REDUCE_JITS: Dict[Any, Any] = {}
+
+
+def _reduce_fn(mesh: Mesh, op: str):
+    key = (tuple(d.id for d in mesh.devices.flat), op)
+    fn = _REDUCE_JITS.get(key)
+    if fn is None:
+        red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+               "mean": jnp.mean}[op]
+        fn = jax.jit(lambda x: red(x, axis=0),
+                     out_shardings=NamedSharding(mesh, P()))
+        _REDUCE_JITS[key] = fn
+    return fn
+
+
+def all_reduce(arrays: List[Any], op: str = "sum"):
+    """Allreduce per-device copies into one replicated jax.Array.
+
+    ``arrays`` is one array per participating device (jax arrays or
+    NDArrays). The copies are assembled zero-copy into a single array
+    sharded over a device axis and reduced with the output replicated on
+    every participating device — one fused XLA allreduce over ICI instead
+    of the reference's tree/P2P/NCCL reduce hierarchy (comm.h:103,451,
+    comm_tree.h:50, kvstore_nccl.h:285).
+
+    Across processes (``jax.distributed``), every process passes its local
+    copies and the reduction spans the global device set.
+    """
+    datas = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+             for a in arrays]
+    if len(datas) == 1 and jax.process_count() == 1:
+        return datas[0]
+    devs = []
+    for d in datas:
+        ds = list(d.devices())
+        devs.append(ds[0] if len(ds) == 1 else None)
+    if None in devs or len(set(devs)) != len(devs):
+        # copies not on distinct single devices: plain on-device reduce
+        acc = datas[0]
+        for d in datas[1:]:
+            if op in ("sum", "mean"):
+                acc = acc + d
+            elif op == "max":
+                acc = jnp.maximum(acc, d)
+            elif op == "min":
+                acc = jnp.minimum(acc, d)
+            else:
+                raise MXNetError("unsupported all_reduce op %r" % (op,))
+        if op == "mean":
+            acc = acc / len(datas)
+        return acc
+    if jax.process_count() > 1:
+        local = jax.local_devices()
+        if len(datas) != len(local):
+            raise MXNetError(
+                "multi-process all_reduce needs one gradient copy per local "
+                "device (%d devices, got %d arrays); use split_and_load over "
+                "all local devices" % (len(local), len(datas)))
+        mesh = Mesh(np.asarray(jax.devices()), ("dev",))
+    else:
+        mesh = Mesh(np.asarray(devs), ("dev",))
+    shape = (len(mesh.devices.flat),) + datas[0].shape
+    sharding = NamedSharding(mesh, P("dev"))
+    shards = [d.reshape((1,) + d.shape) for d in datas]  # leading shard axis
+    stacked = jax.make_array_from_single_device_arrays(shape, sharding, shards)
+    return _reduce_fn(mesh, op)(stacked)
+
+
+def shard_for_device(array, device):
+    """Extract the replica of a replicated array that lives on ``device``
+    (zero-copy)."""
+    for s in array.addressable_shards:
+        if s.device == device:
+            return s.data
+    return jax.device_put(array, device)
+
+
+def broadcast_to_devices(array, devices):
+    """Replicate a host/single-device array onto each device; returns a list
+    of per-device arrays (reference comm.h Broadcast)."""
+    data = array._data if isinstance(array, NDArray) else jnp.asarray(array)
+    return [jax.device_put(data, d) for d in devices]
+
+
+# ---------------------------------------------------------------------------
+# in-graph SPMD training step
+# ---------------------------------------------------------------------------
+
+
+class TrainStep(object):
+    """One fully-fused SPMD training step over a device mesh.
+
+    ``step = TrainStep(net, loss_fn, optimizer, mesh)`` then
+    ``loss = step(data, label)`` runs forward + loss + backward + gradient
+    reduction + optimizer update as ONE compiled XLA module per shape
+    signature. Parameters/optimizer state live replicated on the mesh; the
+    batch is sharded over the ``dp`` axis; GSPMD inserts the ICI
+    collectives. This is the TPU-native equivalent of the reference's
+    whole training stack for data parallelism: GraphExecutor fwd+bwd
+    (graph_executor.cc:231-295) + kvstore reduce (comm.h:43) + fused
+    optimizer ops (optimizer_op.cc) — in a single HloModule.
+
+    Parameters
+    ----------
+    net : HybridBlock — initialized (or deferred-init) model
+    loss_fn : gluon Loss block, or callable (out_nd, label_nd) -> loss NDArray
+    optimizer : str or Optimizer with ``pure_step``
+    mesh : jax Mesh from ``device_mesh()``; defaults to all devices
+    batch_axis : int — which axis of data/label to shard over ``dp``
+    """
+
+    def __init__(self, net, loss_fn, optimizer, mesh: Optional[Mesh] = None,
+                 optimizer_params=None, batch_axis: int = 0):
+        from . import optimizer as opt_mod
+
+        self._net = net
+        self._loss = loss_fn
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+        self._optimizer = optimizer
+        self._mesh = mesh if mesh is not None else device_mesh()
+        self._batch_axis = batch_axis
+        self._dp_axis = self._mesh.axis_names[0]
+        self._pvals = None          # name -> replicated jax array
+        self._opt_states = None     # name -> state pytree
+        self._grad_reqs = None
+        self._mults = None          # name -> (lr_mult, wd_mult)
+        self._t = 0
+        self._step_jits: Dict[Any, Any] = {}
+
+    # ------------------------------------------------------------------
+    def _repl(self, x):
+        # jnp.copy first: device_put to an already-matching sharding is a
+        # no-op alias, and the step jit donates its param inputs — an alias
+        # would let donation delete a buffer the caller still references
+        return jax.device_put(jnp.copy(x), NamedSharding(self._mesh, P()))
+
+    def _shard_batch(self, x):
+        data = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        spec = [None] * data.ndim
+        spec[self._batch_axis] = self._dp_axis
+        return jax.device_put(data, NamedSharding(self._mesh, P(*spec)))
+
+    def _ensure_init(self, data_nd):
+        if self._pvals is not None:
+            return
+        params = self._net.collect_params()
+        try:
+            pvals = {n: p.data()._data for n, p in params.items()}
+        except Exception:
+            with autograd.pause():
+                self._net(data_nd)  # finish deferred init
+            pvals = {n: p.data()._data for n, p in params.items()}
+        self._grad_reqs = {n: p.grad_req for n, p in params.items()}
+        self._mults = {n: (p.lr_mult, p.wd_mult) for n, p in params.items()}
+        self._pvals = {n: self._repl(v) for n, v in pvals.items()}
+        self._opt_states = {}
+        for n, p in params.items():
+            if self._grad_reqs[n] != "null":
+                st = self._optimizer.create_state(n, p.data())
+                self._opt_states[n] = jax.tree_util.tree_map(self._repl, st) \
+                    if st is not None else None
+
+    # ------------------------------------------------------------------
+    def _build_step(self, in_fmt):
+        # in_fmt is the gluon.block._flatten format of the net's inputs
+        base_fn = self._net._base_fn(in_fmt, train=True)
+        diff_names = tuple(n for n, r in self._grad_reqs.items() if r != "null")
+        const_names = tuple(n for n in self._pvals if n not in diff_names)
+        loss_fn = self._loss
+        optimizer = self._optimizer
+        mults = self._mults
+
+        def step(pvals, opt_states, t, lr, data, label, rng):
+            const = {n: pvals[n] for n in const_names}
+
+            def loss_f(dp):
+                pv = dict(const)
+                pv.update(dp)
+                outs, aux = base_fn(pv, rng, data)
+                out0 = outs[0] if isinstance(outs, tuple) else outs
+                with autograd._RecordingStateScope(False, None):
+                    l_nd = loss_fn(NDArray(out0, cpu()), NDArray(label, cpu()))
+                loss = jnp.mean(l_nd._data)
+                return loss, aux
+
+            diff = {n: pvals[n] for n in diff_names}
+            (loss, aux), grads = jax.value_and_grad(
+                loss_f, has_aux=True)(diff)
+
+            new_p = dict(const)
+            new_states = {}
+            for n in diff_names:
+                lm, wm = mults[n]
+                w, s = optimizer.pure_step(
+                    pvals[n], grads[n], opt_states[n], t,
+                    lr * lm, optimizer.wd * wm)
+                new_p[n] = w
+                new_states[n] = s
+            new_p.update(aux)  # BN moving stats et al.
+            return loss, new_p, new_states
+
+        repl = NamedSharding(self._mesh, P())
+        return jax.jit(
+            step,
+            out_shardings=(repl, repl, repl),
+            donate_argnums=(0, 1),
+        )
+
+    # ------------------------------------------------------------------
+    def __call__(self, data, label):
+        data_nd = data if isinstance(data, NDArray) else NDArray(
+            jnp.asarray(data), cpu())
+        self._ensure_init(data_nd)
+        self._t += 1
+        self._optimizer.num_update = self._t
+
+        d = self._shard_batch(data)
+        l = self._shard_batch(label)
+        rng = _global.next_key()
+        lr = jnp.float32(self._optimizer.learning_rate)
+        t = jnp.float32(self._t)
+
+        key = (tuple(d.shape), str(d.dtype), tuple(l.shape), str(l.dtype))
+        if key not in self._step_jits:
+            self._step_jits[key] = self._build_step([0])
+        loss, self._pvals, self._opt_states = self._step_jits[key](
+            self._pvals, self._opt_states, t, lr, d, l, rng)
+        return NDArray(loss, cpu())
+
+    # ------------------------------------------------------------------
+    def copy_to_net(self):
+        """Write the trained replicated parameters back into the net's
+        Parameter buffers (so save_parameters/export see the result)."""
+        params = self._net.collect_params()
+        for n, v in self._pvals.items():
+            # fresh buffer: the next step() donates (deletes) self._pvals
+            params[n].data()._data = jnp.copy(v)
+        return self._net
+
+    @property
+    def params(self):
+        return self._pvals

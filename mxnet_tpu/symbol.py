@@ -12,6 +12,7 @@ Execution lowers the WHOLE graph into one jitted XLA computation via
 from __future__ import annotations
 
 import json
+from builtins import slice as _py_slice  # module attr `slice` is the op wrapper
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -29,7 +30,8 @@ __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json", "zeros",
 class _Node:
     """One graph node: an op application or a variable (op=None)."""
 
-    __slots__ = ("op", "name", "attrs", "inputs", "_extra_attrs")
+    __slots__ = ("op", "name", "attrs", "inputs", "_extra_attrs",
+                 "_forced_aux")
 
     def __init__(self, op: Optional[str], name: str, attrs: Dict[str, Any],
                  inputs: List[Tuple["_Node", int]]):
@@ -111,7 +113,7 @@ class Symbol(object):
                     if index in names else
                     "Cannot find output that matches name \"%s\"" % index)
             index = names.index(index)
-        if isinstance(index, slice):
+        if isinstance(index, _py_slice):
             return Group([self[i] for i in range(*index.indices(len(self)))])
         if index >= len(self):
             raise IndexError
