@@ -1,0 +1,118 @@
+"""Single-chip ResNet-50 benchmark — prints ONE JSON line.
+
+Counterpart of the reference's headline perf scripts
+(``example/image-classification/benchmark_score.py`` for inference and
+``train_imagenet.py`` for training, docs/faq/perf.md:113-115,177-181).
+Baselines from BASELINE.md: V100 train bs=32 fp32 = 298.51 img/s
+(perf.md:214), infer bs=32 fp32 = 1076.81 img/s (perf.md:156).
+
+Protocol: compile once (warmup), then time steady-state iterations with the
+iteration count auto-scaled so each phase stays within a bounded wall-time.
+Headline metric is the fused training step (forward + loss + backward + SGD
+momentum update in one XLA module); inference fp32/bf16 img/s ride along in
+"extra".  BENCH_QUICK=1 shrinks everything for plumbing checks on CPU.
+"""
+import json
+import os
+import sys
+import time
+
+QUICK = os.environ.get("BENCH_QUICK", "") not in ("", "0")
+
+TRAIN_BASELINE = 298.51   # V100 ResNet-50 train bs=32 fp32, perf.md:214
+INFER_BASELINE = 1076.81  # V100 ResNet-50 infer bs=32 fp32, perf.md:156
+
+
+def _time_iters(run_one, sync, budget_s=30.0, max_iters=20):
+    """Time steady-state iterations: one probe iteration sets the count so
+    the phase stays inside ``budget_s``."""
+    t0 = time.perf_counter()
+    run_one()
+    sync()
+    probe = time.perf_counter() - t0
+    iters = max(3, min(max_iters, int(budget_s / max(probe, 1e-6))))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_one()
+    sync()
+    return iters / (time.perf_counter() - t0)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, parallel
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    if QUICK:
+        batch, side, classes = 4, 32, 10
+        make_net = vision.resnet18_v1
+        budget = 10.0
+    else:
+        batch, side, classes = 32, 224, 1000
+        make_net = vision.resnet50_v1
+        budget = 30.0
+
+    dev = jax.devices()[0]
+    rng = np.random.RandomState(0)
+    x_np = rng.rand(batch, 3, side, side).astype(np.float32)
+    y_np = rng.randint(0, classes, (batch,))
+
+    # ---- inference fp32 --------------------------------------------------
+    net = make_net(classes=classes)
+    net.initialize()
+    net.hybridize()
+    x = nd.array(x_np)
+    out = net(x)  # compile (predict mode)
+    out._data.block_until_ready()
+    infer_fp32 = batch * _time_iters(
+        lambda: net(x), lambda: net(x)._data.block_until_ready(), budget)
+
+    # ---- inference bf16 --------------------------------------------------
+    net_bf = make_net(classes=classes)
+    net_bf.initialize()
+    net_bf.cast("bfloat16")
+    net_bf.hybridize()
+    x_bf = mx.nd.NDArray(jnp.asarray(x_np, jnp.bfloat16), mx.cpu())
+    net_bf(x_bf)._data.block_until_ready()
+    infer_bf16 = batch * _time_iters(
+        lambda: net_bf(x_bf),
+        lambda: net_bf(x_bf)._data.block_until_ready(), budget)
+
+    # ---- fused training step (fwd + loss + bwd + SGD-mom update) ---------
+    net_t = make_net(classes=classes)
+    net_t.initialize()
+    mesh = parallel.device_mesh(1, devices=[dev])
+    step = parallel.TrainStep(
+        net_t, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd", mesh,
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+    xt, yt = nd.array(x_np), nd.array(y_np)
+    step(xt, yt)  # compile
+    losses = []
+    train = batch * _time_iters(
+        lambda: losses.append(step(xt, yt)),
+        lambda: losses[-1]._data.block_until_ready(), budget)
+
+    print(json.dumps({
+        "metric": "resnet50_v1 train img/s (bs=32 fp32, fused step, 1 chip)"
+                  if not QUICK else "resnet18 quick-mode img/s",
+        "value": round(train, 2),
+        "unit": "img/s",
+        "vs_baseline": round(train / TRAIN_BASELINE, 4),
+        "extra": {
+            "infer_fp32_img_s": round(infer_fp32, 2),
+            "infer_fp32_vs_baseline": round(infer_fp32 / INFER_BASELINE, 4),
+            "infer_bf16_img_s": round(infer_bf16, 2),
+            "batch": batch,
+            "device": str(dev),
+            "baseline": "V100 train 298.51 / infer 1076.81 img/s "
+                        "(docs/faq/perf.md:214,156)",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

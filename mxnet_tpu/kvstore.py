@@ -222,20 +222,13 @@ class KVStoreTPU(KVStore):
         return jax.process_count()
 
     def _reduce(self, datas: List[Any]):
-        acc = super()._reduce(datas)
-        if jax.process_count() > 1:
-            # DCN/ICI allreduce across processes: one-element pmap psum over
-            # the process-local device holding the gradient
-            mesh_devs = jax.devices()
-            acc = jax.make_array_from_single_device_arrays(
-                acc.shape,
-                jax.sharding.NamedSharding(
-                    jax.sharding.Mesh(np.array(mesh_devs[:1]), ("x",)),
-                    jax.sharding.PartitionSpec()),
-                [acc]) if False else acc
-            # single-controller deployments fuse collectives in-graph
-            # (mxnet_tpu.parallel); the eager path is process-local here.
-        return acc
+        # one fused XLA allreduce over the devices holding the copies
+        # (ICI within a slice, DCN across processes); parallel.all_reduce
+        # assembles the per-device copies into one sharded array and reduces
+        # with the result replicated on every participating device
+        from . import parallel
+
+        return parallel.all_reduce(datas)
 
     def _barrier(self):
         """Block until all local work completes (reference

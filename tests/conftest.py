@@ -9,6 +9,14 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# site hooks may have pre-imported jax and overridden jax_platforms via
+# config.update (which beats the env var); override it back before any
+# backend initializes so the suite never touches a (possibly absent or
+# wedged) accelerator tunnel
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 
