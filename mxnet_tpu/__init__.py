@@ -58,6 +58,10 @@ for _m in (
     except ImportError:
         pass
 
+# reference python/mxnet/__init__.py:56 aliases the kvstore module as mx.kv
+if "kvstore" in globals():
+    kv = globals()["kvstore"]
+
 if hasattr(globals().get("symbol"), "Symbol"):
     sym = globals()["symbol"]
     Symbol = sym.Symbol

@@ -98,6 +98,7 @@ class KVStore(object):
                 raise MXNetError("key %s has not been initialized" % k)
             vals = v if isinstance(v, (list, tuple)) else [v]
             agg = self._reduce([x._data for x in vals])
+            agg = self._to_store_sharding(agg, self._store[k]._data)
             if self._compression is not None:
                 agg = self._compression.compress(k, agg)
             if self._updater is not None:
@@ -140,6 +141,13 @@ class KVStore(object):
         for d in datas[1:]:
             acc = acc + d
         return acc
+
+    def _to_store_sharding(self, agg, ref):
+        """Reconcile the reduced gradient's placement with the stored value's
+        so the subsequent combine is a single-sharding jit (no-op here; the
+        TPU store overrides it — its allreduce output is replicated over all
+        participating devices while the store entry is single-device)."""
+        return agg
 
     # ------------------------------------------------------------------
     def set_optimizer(self, optimizer):
@@ -229,6 +237,20 @@ class KVStoreTPU(KVStore):
         from . import parallel
 
         return parallel.all_reduce(datas)
+
+    def _to_store_sharding(self, agg, ref):
+        # all_reduce returns an array replicated across every participating
+        # device; the store entry is committed to one device. Extract that
+        # device's replica (zero-copy) so store+agg compiles on one device.
+        from . import parallel
+
+        ref_devs = ref.devices() if hasattr(ref, "devices") else None
+        agg_devs = agg.devices() if hasattr(agg, "devices") else None
+        if not ref_devs or not agg_devs or agg_devs == ref_devs:
+            return agg
+        if len(ref_devs) == 1:
+            return parallel.shard_for_device(agg, next(iter(ref_devs)))
+        return jax.device_put(agg, ref.sharding)
 
     def _barrier(self):
         """Block until all local work completes (reference
