@@ -15,6 +15,7 @@ momentum update in one XLA module); inference fp32/bf16 img/s ride along in
 import json
 import os
 import sys
+import threading
 import time
 
 QUICK = os.environ.get("BENCH_QUICK", "") not in ("", "0")
@@ -23,22 +24,71 @@ TRAIN_BASELINE = 298.51   # V100 ResNet-50 train bs=32 fp32, perf.md:214
 INFER_BASELINE = 1076.81  # V100 ResNet-50 infer bs=32 fp32, perf.md:156
 
 
-def _time_iters(run_one, sync, budget_s=30.0, max_iters=20):
+def _acquire_backend(timeout_s=120.0, retries=2):
+    """Bounded backend acquisition: ``jax.devices()`` can hang indefinitely
+    when the accelerator tunnel is down, which previously made a bench run
+    die with rc=1 and no parseable output (BENCH_r03.json). Probe from a
+    daemon thread with a deadline; on failure print a structured JSON error
+    line so the driver can tell infra failure from code failure."""
+    result = {}
+
+    def probe():
+        try:
+            import jax
+            result["devices"] = list(jax.devices())
+        except Exception as e:  # noqa: BLE001 - report whatever init raised
+            result["error"] = repr(e)
+
+    start = time.perf_counter()
+    err = None
+    for _ in range(retries):
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        if "devices" in result:
+            return result["devices"]
+        err = result.pop("error", None)
+        if err is None:
+            # the probe HUNG (vs raised): it still holds jax's global backend
+            # lock, so a retry thread would just block on the lock — bail now
+            err = "backend init timed out after %.0fs" % (
+                time.perf_counter() - start)
+            break
+    print(json.dumps({
+        "metric": "resnet50_v1 train img/s (bs=32 fp32, fused step, 1 chip)",
+        "value": None,
+        "unit": "img/s",
+        "vs_baseline": None,
+        "error": "backend-init failure (infrastructure): %s" % err,
+    }))
+    sys.stdout.flush()
+    os._exit(1)  # a hung probe thread would block a normal exit
+
+
+def _time_iters(run_one, budget_s=30.0, max_iters=20):
     """Time steady-state iterations: one probe iteration sets the count so
-    the phase stays inside ``budget_s``."""
+    the phase stays inside ``budget_s``. ``run_one`` must return the NDArray
+    output of the iteration; we block on the LAST iteration's own result so
+    the timed window covers exactly ``iters`` iterations (async dispatch
+    executes in-order per device, so the last result readiness implies all)."""
+    def block(out):
+        out._data.block_until_ready()
+
     t0 = time.perf_counter()
-    run_one()
-    sync()
+    block(run_one())
     probe = time.perf_counter() - t0
     iters = max(3, min(max_iters, int(budget_s / max(probe, 1e-6))))
     t0 = time.perf_counter()
+    out = None
     for _ in range(iters):
-        run_one()
-    sync()
+        out = run_one()
+    block(out)
     return iters / (time.perf_counter() - t0)
 
 
 def main():
+    devices = _acquire_backend()
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -56,7 +106,7 @@ def main():
         make_net = vision.resnet50_v1
         budget = 30.0
 
-    dev = jax.devices()[0]
+    dev = devices[0]
     rng = np.random.RandomState(0)
     x_np = rng.rand(batch, 3, side, side).astype(np.float32)
     y_np = rng.randint(0, classes, (batch,))
@@ -66,10 +116,8 @@ def main():
     net.initialize()
     net.hybridize()
     x = nd.array(x_np)
-    out = net(x)  # compile (predict mode)
-    out._data.block_until_ready()
-    infer_fp32 = batch * _time_iters(
-        lambda: net(x), lambda: net(x)._data.block_until_ready(), budget)
+    net(x)._data.block_until_ready()  # compile (predict mode)
+    infer_fp32 = batch * _time_iters(lambda: net(x), budget)
 
     # ---- inference bf16 --------------------------------------------------
     net_bf = make_net(classes=classes)
@@ -78,9 +126,7 @@ def main():
     net_bf.hybridize()
     x_bf = mx.nd.NDArray(jnp.asarray(x_np, jnp.bfloat16), mx.cpu())
     net_bf(x_bf)._data.block_until_ready()
-    infer_bf16 = batch * _time_iters(
-        lambda: net_bf(x_bf),
-        lambda: net_bf(x_bf)._data.block_until_ready(), budget)
+    infer_bf16 = batch * _time_iters(lambda: net_bf(x_bf), budget)
 
     # ---- fused training step (fwd + loss + bwd + SGD-mom update) ---------
     net_t = make_net(classes=classes)
@@ -90,11 +136,8 @@ def main():
         net_t, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd", mesh,
         optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
     xt, yt = nd.array(x_np), nd.array(y_np)
-    step(xt, yt)  # compile
-    losses = []
-    train = batch * _time_iters(
-        lambda: losses.append(step(xt, yt)),
-        lambda: losses[-1]._data.block_until_ready(), budget)
+    step(xt, yt)._data.block_until_ready()  # compile
+    train = batch * _time_iters(lambda: step(xt, yt), budget)
 
     print(json.dumps({
         "metric": "resnet50_v1 train img/s (bs=32 fp32, fused step, 1 chip)"

@@ -12,10 +12,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 # site hooks may have pre-imported jax and overridden jax_platforms via
 # config.update (which beats the env var); override it back before any
 # backend initializes so the suite never touches a (possibly absent or
-# wedged) accelerator tunnel
+# wedged) accelerator tunnel. If a hook already initialized the backends,
+# updating the config is ineffective (and may error) — use what exists.
 import jax
+from jax._src import xla_bridge
 
-jax.config.update("jax_platforms", "cpu")
+if not xla_bridge.backends_are_initialized():
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
