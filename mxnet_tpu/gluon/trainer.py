@@ -132,6 +132,18 @@ class Trainer(object):
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        kv = self._kvstore
+        if not self._update_on_kvstore and kv._can_fuse_pushpull():
+            # fused fast path: every parameter's gradient allreduce compiles
+            # into ONE XLA module (reference batches NCCL keys the same way,
+            # kvstore_nccl.h:285)
+            keys, grads = [], []
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    keys.append(i)
+                    grads.append(param.list_grad())
+            kv.pushpull_multi(keys, grads, grads)
+            return
         for i, param in enumerate(self._params):
             if param.grad_req != "null":
                 self._kvstore.push(i, param.list_grad(), priority=-i)

@@ -92,7 +92,10 @@ class KVStore(object):
     def push(self, key, value, priority=0):
         """Aggregate values into the store (reference kvstore.py:160).
         With an updater set, runs the optimizer server-side (reference
-        KVStore::set_updater semantics)."""
+        KVStore::set_updater semantics); without one, the reduced value
+        REPLACES the stored value (reference kvstore_local.h PushImpl:
+        ``local = merged``) — this is what lets Trainer/Module push
+        gradients and pull the aggregate back each step."""
         for k, v in _key_value_pairs(key, value):
             if k not in self._store:
                 raise MXNetError("key %s has not been initialized" % k)
@@ -105,7 +108,7 @@ class KVStore(object):
                 grad = NDArray(agg, vals[0].context)
                 self._updater(int(k) if k.isdigit() else k, grad, self._store[k])
             else:
-                self._store[k]._data = self._store[k]._data + agg
+                self._store[k]._data = agg
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast stored values into out (reference kvstore.py:240)."""
@@ -159,6 +162,13 @@ class KVStore(object):
 
     def _set_updater(self, updater):
         self._updater = updater
+
+    def _can_fuse_pushpull(self):
+        """Whether callers may use the batched ``pushpull_multi`` fast path;
+        mirrors that method's preconditions (updater and compression are
+        per-key transformations)."""
+        return (self._updater is None and self._compression is None
+                and hasattr(self, "pushpull_multi"))
 
     def set_gradient_compression(self, compression_params):
         ctype = compression_params.get("type", "2bit")
@@ -251,6 +261,42 @@ class KVStoreTPU(KVStore):
         if len(ref_devs) == 1:
             return parallel.shard_for_device(agg, next(iter(ref_devs)))
         return jax.device_put(agg, ref.sharding)
+
+    def pushpull_multi(self, keys, value_lists, out_lists):
+        """Fused push+pull over MANY keys: every key's per-device copies are
+        reduced inside ONE compiled XLA module (parallel.all_reduce_multi),
+        the reduced value replaces the store entry, and each out buffer gets
+        the replica already resident on its device (zero-copy). This is the
+        Trainer/Module fast path — the TPU answer to the reference's batched
+        NCCL push/pull (kvstore_nccl.h:285) without per-key dispatch.
+
+        Not valid with a server-side updater or gradient compression (both
+        are per-key transformations); callers fall back to push/pull then.
+        """
+        assert self._updater is None and self._compression is None
+        from . import parallel
+
+        norm = []
+        for k, v in zip(keys, value_lists):
+            kk = _key(k)
+            if kk not in self._store:
+                raise MXNetError("key %s has not been initialized" % kk)
+            norm.append((kk, v if isinstance(v, (list, tuple)) else [v]))
+        totals = parallel.all_reduce_multi([[x._data for x in v]
+                                            for _, v in norm])
+        for (kk, _), total, o in zip(norm, totals, out_lists):
+            self._store[kk]._data = self._to_store_sharding(
+                total, self._store[kk]._data)
+            outs = o if isinstance(o, (list, tuple)) else [o]
+            for dst in outs:
+                dst_devs = dst._data.devices() if hasattr(dst._data, "devices") \
+                    else None
+                if dst_devs and len(dst_devs) == 1 and hasattr(total, "devices") \
+                        and dst_devs != total.devices():
+                    dst._data = parallel.shard_for_device(
+                        total, next(iter(dst_devs)))
+                else:
+                    dst._data = total
 
     def _barrier(self):
         """Block until all local work completes (reference

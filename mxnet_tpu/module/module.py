@@ -279,15 +279,26 @@ class Module(BaseModule):
                 weights = [e.arg_dict[name] for e in self._exec_group.execs]
                 self._kvstore.pull(idx, weights, priority=-idx)
             return
+        entries = []  # (key idx, name, [(exec, grad)] for execs holding it)
         for idx, name in enumerate(param_names):
-            grads = [e.grad_dict[name] for e in self._exec_group.execs
+            pairs = [(e, e.grad_dict[name]) for e in self._exec_group.execs
                      if name in e.grad_dict]
-            if not grads:
-                continue
-            if self._kvstore:
-                self._kvstore.push(idx, grads, priority=-idx)
-                self._kvstore.pull(idx, grads, priority=-idx)
-            for e, g in zip(self._exec_group.execs, grads):
+            if pairs:
+                entries.append((idx, name, pairs))
+        if self._kvstore is not None:
+            kv = self._kvstore
+            if kv._can_fuse_pushpull():
+                # fused fast path: one XLA module reduces every key
+                grad_lists = [[g for _, g in pairs] for _, _, pairs in entries]
+                kv.pushpull_multi([i for i, _, _ in entries],
+                                  grad_lists, grad_lists)
+            else:
+                for idx, _, pairs in entries:
+                    grads = [g for _, g in pairs]
+                    kv.push(idx, grads, priority=-idx)
+                    kv.pull(idx, grads, priority=-idx)
+        for idx, name, pairs in entries:
+            for e, g in pairs:
                 self._updater(idx, g, e.arg_dict[name])
 
     def get_outputs(self, merge_multi_context=True):

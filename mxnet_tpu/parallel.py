@@ -38,7 +38,8 @@ from .base import MXNetError
 from .context import Context, cpu
 from .ndarray.ndarray import NDArray
 
-__all__ = ["device_mesh", "all_reduce", "broadcast_to_devices", "TrainStep"]
+__all__ = ["device_mesh", "all_reduce", "all_reduce_multi",
+           "broadcast_to_devices", "TrainStep"]
 
 
 # ---------------------------------------------------------------------------
@@ -136,6 +137,57 @@ def all_reduce(arrays: List[Any], op: str = "sum"):
     shards = [d.reshape((1,) + d.shape) for d in datas]  # leading shard axis
     stacked = jax.make_array_from_single_device_arrays(shape, sharding, shards)
     return _reduce_fn(mesh, op)(stacked)
+
+
+_MULTI_REDUCE_JITS: Dict[Any, Any] = {}
+
+
+def _multi_reduce_fn(mesh: Mesh, op: str):
+    key = (tuple(d.id for d in mesh.devices.flat), op)
+    fn = _MULTI_REDUCE_JITS.get(key)
+    if fn is None:
+        red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+               "mean": jnp.mean}[op]
+        fn = jax.jit(lambda xs: [red(x, axis=0) for x in xs],
+                     out_shardings=NamedSharding(mesh, P()))
+        _MULTI_REDUCE_JITS[key] = fn
+    return fn
+
+
+def all_reduce_multi(groups: List[List[Any]], op: str = "sum"):
+    """Allreduce MANY tensors in ONE compiled XLA module.
+
+    ``groups[k]`` is one per-device copy list for tensor ``k``; every group
+    must span the same device set. All reductions compile into a single
+    module so XLA can schedule/fuse the collectives together — the
+    TPU-native analogue of the reference NCCL store's batched key grouping
+    (kvstore_nccl.h:285) and the tree store's multi-tree reduce
+    (comm_tree.h:50). Returns one replicated array per group.
+    """
+    if not groups:
+        return []
+    datas = [[a._data if isinstance(a, NDArray) else jnp.asarray(a)
+              for a in g] for g in groups]
+    devs = []
+    for d in datas[0]:
+        ds = list(d.devices())
+        devs.append(ds[0] if len(ds) == 1 else None)
+    uniform = None not in devs and len(set(devs)) == len(devs) and all(
+        len(g) == len(devs) for g in datas)
+    if not uniform or len(devs) == 1:
+        return [all_reduce(g, op) for g in groups]
+    mesh = Mesh(np.asarray(devs), ("dev",))
+    sharding = NamedSharding(mesh, P("dev"))
+    stacked = []
+    for g in datas:
+        by_dev = {next(iter(d.devices())): d for d in g}
+        if len(by_dev) != len(devs) or any(dv not in by_dev for dv in devs):
+            return [all_reduce(gg, op) for gg in groups]
+        shape = (len(devs),) + g[0].shape
+        shards = [by_dev[dv].reshape((1,) + by_dev[dv].shape) for dv in devs]
+        stacked.append(jax.make_array_from_single_device_arrays(
+            shape, sharding, shards))
+    return _multi_reduce_fn(mesh, op)(stacked)
 
 
 def shard_for_device(array, device):

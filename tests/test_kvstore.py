@@ -1,0 +1,197 @@
+"""KVStore tests (reference tests/python/unittest/test_kvstore.py +
+tests/nightly/dist_sync_kvstore.py exact-value discipline, run here on the
+conftest 8-virtual-device CPU mesh so the 'tpu' store reduces over DISTINCT
+devices)."""
+import jax
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+N = min(8, len(jax.devices()))
+DEVICES = jax.devices()[:N]
+
+SHAPE = (4, 5)
+
+
+def _per_device_copies(vals):
+    """One NDArray per device holding vals[i]."""
+    return [mx.nd.NDArray(jax.device_put(np.asarray(v, np.float32), d),
+                          mx.cpu())
+            for v, d in zip(vals, DEVICES)]
+
+
+def test_kv_alias():
+    # reference python/mxnet/__init__.py:56
+    assert mx.kv is mx.kvstore
+
+
+@pytest.mark.parametrize("kv_type", ["local", "device", "tpu"])
+def test_single_kv_pair(kv_type):
+    """Push without an updater REPLACES the stored value with the reduced
+    result (reference kvstore_local.h PushImpl: ``local = merged``)."""
+    kv = mx.kv.create(kv_type)
+    kv.init(3, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
+    kv.push(3, nd.ones(SHAPE) * 4)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 4.0)
+
+
+@pytest.mark.parametrize("kv_type", ["local", "device", "tpu"])
+def test_list_kv_pairs(kv_type):
+    kv = mx.kv.create(kv_type)
+    keys = [5, 7, 9]
+    kv.init(keys, [nd.ones(SHAPE)] * len(keys))
+    kv.push(keys, [nd.ones(SHAPE) * 2] * len(keys))
+    outs = [nd.zeros(SHAPE) for _ in keys]
+    kv.pull(keys, out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), 2.0)
+
+
+def test_push_per_device_copies_aggregates():
+    """Push with one gradient copy per distinct device sums them all —
+    the reference comm.h Reduce contract, here one fused XLA allreduce."""
+    kv = mx.kv.create("tpu")
+    kv.init("w", nd.zeros(SHAPE))
+    grads = _per_device_copies(
+        [np.full(SHAPE, i + 1.0) for i in range(N)])
+    kv.push("w", grads)
+    outs = [nd.zeros(SHAPE) for _ in range(N)]
+    kv.pull("w", out=outs)
+    expect = sum(range(1, N + 1))
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), expect, rtol=1e-6)
+    # a second push replaces the (now device-committed) entry, not adds
+    kv.push("w", grads)
+    out = nd.zeros(SHAPE)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+
+
+def test_local_push_multi_copy():
+    kv = mx.kv.create("local")
+    kv.init("a", nd.zeros(SHAPE))
+    kv.push("a", [nd.ones(SHAPE), nd.ones(SHAPE) * 2, nd.ones(SHAPE) * 3])
+    out = nd.zeros(SHAPE)
+    kv.pull("a", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 6.0)
+
+
+def test_updater_runs_server_side():
+    """set_optimizer runs the update inside the store on push (reference
+    KVStore::set_updater, kvstore.py:450)."""
+    kv = mx.kv.create("tpu")
+    kv.init("w", nd.ones(SHAPE))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.push("w", _per_device_copies([np.ones(SHAPE)] * N))
+    out = nd.zeros(SHAPE)
+    kv.pull("w", out=out)
+    # w <- w - lr * sum(grads) = 1 - 0.1 * N
+    np.testing.assert_allclose(out.asnumpy(), 1.0 - 0.1 * N, rtol=1e-6)
+
+
+def test_uninitialized_key_raises():
+    kv = mx.kv.create("local")
+    with pytest.raises(mx.MXNetError):
+        kv.push("nope", nd.ones(SHAPE))
+    with pytest.raises(mx.MXNetError):
+        kv.pull("nope", out=nd.zeros(SHAPE))
+
+
+def test_rank_and_num_workers():
+    kv = mx.kv.create("tpu")
+    assert kv.rank == jax.process_index()
+    assert kv.num_workers == jax.process_count()
+    kv._barrier()  # completes without error
+    local = mx.kv.create("local")
+    assert (local.rank, local.num_workers) == (0, 1)
+
+
+def test_factory_aliases_and_errors():
+    assert mx.kv.create("dist_sync").type == "dist_sync"
+    assert mx.kv.create("dist").type == "dist_sync"
+    assert mx.kv.create("device").type == "device"
+    with pytest.raises(mx.MXNetError):
+        mx.kv.create("bogus")
+    with pytest.raises(TypeError):
+        mx.kv.create(7)
+
+
+def test_two_bit_compression_roundtrip():
+    """2-bit quantization with error feedback (reference
+    gradient_compression.h:52-134): each push quantizes grad+residual to
+    {-t, 0, +t}; the residual carries the quantization error so the sum
+    over steps converges to the true gradient sum."""
+    kv = mx.kv.create("tpu")
+    kv.init("g", nd.zeros((6,)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    # |grad| < threshold: the residual stays bounded so the accumulated
+    # quantized sum tracks the true gradient sum within one quantum
+    # (for |grad| > t the per-step magnitude saturates at t by design)
+    grad = np.array([0.3, -0.3, 0.45, -0.1, 0.0, 0.2], np.float32)
+    total = np.zeros_like(grad)
+    out = nd.zeros((6,))
+    for _ in range(8):
+        kv.push("g", nd.array(grad))
+        kv.pull("g", out=out)
+        pulled = out.asnumpy()
+        # each push stores exactly one quantum per element
+        assert set(np.unique(pulled)) <= {-0.5, 0.0, 0.5}
+        total += pulled
+    # error feedback: accumulated quantized sum tracks 8*grad within one t
+    np.testing.assert_allclose(total, 8 * grad, atol=0.5 + 1e-5)
+
+    with pytest.raises(mx.MXNetError):
+        kv.set_gradient_compression({"type": "1bit"})
+
+
+def test_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = np.arange(20, dtype=np.float32).reshape(5, 4)
+    kv.init("emb", nd.array(w))
+    out = nd.zeros((5, 4))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array(np.array([1, 3])))
+    expect = np.zeros_like(w)
+    expect[[1, 3]] = w[[1, 3]]
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+def test_row_sparse_pull_multi_out():
+    kv = mx.kv.create("tpu")
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    kv.init("emb", nd.array(w))
+    outs = [nd.zeros((3, 4)) for _ in range(2)]
+    kv.row_sparse_pull("emb", out=outs,
+                       row_ids=nd.array(np.array([0, 2])))
+    expect = np.zeros_like(w)
+    expect[[0, 2]] = w[[0, 2]]
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), expect)
+
+
+def test_optimizer_state_save_load(tmp_path):
+    kv = mx.kv.create("local")
+    kv.init("w", nd.ones((3,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv.push("w", nd.ones((3,)))
+    fname = str(tmp_path / "states")
+    kv.save_optimizer_states(fname)
+    # resume: same weight AND same momentum state -> identical next update
+    w_now = nd.zeros((3,))
+    kv.pull("w", out=w_now)
+    kv2 = mx.kv.create("local")
+    kv2.init("w", w_now)
+    kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv2.load_optimizer_states(fname)
+    # same momentum state -> same next update
+    kv.push("w", nd.ones((3,)))
+    kv2.push("w", nd.ones((3,)))
+    o1, o2 = nd.zeros((3,)), nd.zeros((3,))
+    kv.pull("w", out=o1)
+    kv2.pull("w", out=o2)
+    np.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(), rtol=1e-6)
