@@ -1,0 +1,103 @@
+"""Monitor: per-op output/weight statistics for debugging (NaN hunting).
+
+Reference ``python/mxnet/monitor.py:33`` — Monitor installs a callback into
+executors that records a statistic of every intermediate output whose name
+matches ``pattern``; ``tic``/``toc`` bracket each batch. Here the executor
+surfaces intermediate outputs to the callback after the whole-graph XLA run
+(executor.py monitor hook) — per-op granularity with whole-graph compilation.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import re
+
+from .ndarray.ndarray import NDArray
+from . import ndarray as nd_mod
+
+__all__ = ["Monitor"]
+
+
+class Monitor(object):
+    """Monitor outputs, weights, and gradients for debugging
+    (reference monitor.py:33).
+
+    Parameters
+    ----------
+    interval : int — batches between collections
+    stat_func : callable(NDArray) -> NDArray, default |x| RMS
+    pattern : str — regex filtering tensor names
+    sort : bool — sort results by name in toc()
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return nd_mod.norm(x) / math.sqrt(x.size)
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+        def stat_helper(name, array):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(array)))
+
+        self.stat_helper = stat_helper
+
+    def install(self, exe):
+        """Install the callback into an executor (reference monitor.py:73)."""
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting stats for the coming batch (reference
+        monitor.py:85)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Finish the batch; returns [(step, name, stat_str)] (reference
+        monitor.py:99). Also samples current arg/aux arrays."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for name, array in zip(exe._symbol.list_arguments(),
+                                   exe.arg_arrays):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name, self.stat_func(array)))
+            for name, array in zip(exe._symbol.list_auxiliary_states(),
+                                   exe.aux_arrays):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name, self.stat_func(array)))
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ""
+            for v in v_list:
+                assert isinstance(v, NDArray)
+                if v.shape == (1,) or v.shape == ():
+                    s += str(v.asnumpy().reshape(-1)[0]) + "\t"
+                else:
+                    s += str(v.asnumpy()) + "\t"
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """Finish the batch and log results (reference monitor.py:139)."""
+        for n, k, v in self.toc():
+            logging.info("Batch: %7d %30s %s", n, k, v)

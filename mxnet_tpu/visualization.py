@@ -1,0 +1,215 @@
+"""Network visualization (reference ``python/mxnet/visualization.py``):
+``print_summary`` — layer table with shapes and parameter counts;
+``plot_network`` — graphviz digraph (gated on graphviz being importable).
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print a Keras-style layer summary of a Symbol (reference
+    visualization.py:print_summary): layer name/type, output shape, param
+    count, previous layers; totals at the bottom."""
+    if shape is not None:
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = {x[0] for x in conf["heads"]}
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields, pos):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:pos[i]]
+            line += " " * (pos[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        pre_filter = 0
+        for item in node["inputs"]:
+            input_node = nodes[item[0]]
+            input_name = input_node["name"]
+            # count op outputs and DATA variables (those the caller gave a
+            # shape for) toward the fan-in; weight/bias variables don't feed
+            # channels. The reference only catches the data node through a
+            # set-construction accident (set(conf["heads"][0]) contains 0);
+            # this implements the intent.
+            if input_node["op"] != "null" or (
+                    shape is not None and input_name in shape):
+                pre_node.append(input_name)
+                if out_shape and shape is not None:
+                    key = input_name + "_output" if input_node["op"] != "null" \
+                        else input_name
+                    if key in shape_dict:
+                        shp = shape_dict[key]
+                        if len(shp) > 1:
+                            pre_filter = pre_filter + int(shp[1])
+        cur_param = 0
+        attrs = node.get("attrs", node.get("param", {})) or {}
+        if op == "Convolution":
+            num_filter = int(attrs["num_filter"])
+            ks = _tuple(attrs["kernel"])
+            cur_param = pre_filter * num_filter
+            for k in ks:
+                cur_param *= k
+            grp = int(attrs.get("num_group", "1"))
+            cur_param //= max(grp, 1)
+            if attrs.get("no_bias", "False") not in ("True", "1", "true"):
+                cur_param += num_filter
+        elif op == "FullyConnected":
+            num_hidden = int(attrs["num_hidden"])
+            cur_param = pre_filter * num_hidden
+            if attrs.get("no_bias", "False") not in ("True", "1", "true"):
+                cur_param += num_hidden
+        elif op == "BatchNorm":
+            cur_param = pre_filter * 4
+        elif op == "Embedding":
+            cur_param = int(attrs["input_dim"]) * int(attrs["output_dim"])
+        first_connection = pre_node[0] if pre_node else ""
+        fields = ["%s(%s)" % (node["name"], op), str(out_shape),
+                  cur_param, first_connection]
+        print_row(fields, positions)
+        for conn in pre_node[1:]:
+            print_row(["", "", "", conn], positions)
+        total_params[0] += cur_param
+
+    for i, node in enumerate(nodes):
+        out_shape = []
+        op = node["op"]
+        if op == "null" and i > 0:
+            continue
+        if op != "null" or i in heads:
+            if shape is not None:
+                key = node["name"] + "_output" if op != "null" else node["name"]
+                if key in shape_dict:
+                    out_shape = shape_dict[key]
+        print_layer_summary(node, out_shape)
+        if i == len(nodes) - 1:
+            print("=" * line_length)
+        else:
+            print("_" * line_length)
+    print("Total params: {params}".format(params=total_params[0]))
+    print("_" * line_length)
+    return total_params[0]
+
+
+def _tuple(s):
+    if isinstance(s, (tuple, list)):
+        return tuple(int(x) for x in s)
+    return tuple(int(x) for x in
+                 s.replace("(", "").replace(")", "").split(",") if x.strip())
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz digraph of the network (reference
+    visualization.py:plot_network). Requires the optional ``graphviz``
+    package; raises MXNetError when absent (nothing may be pip-installed
+    in this environment)."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise MXNetError(
+            "plot_network requires the 'graphviz' python package") from e
+
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    draw_shape = shape is not None
+    if draw_shape:
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs or {})
+    dot = Digraph(name=title, format=save_format)
+    fill_colors = ("#8dd3c7", "#fb8072", "#ffffb3", "#bebada", "#80b1d3",
+                   "#fdb462", "#b3de69", "#fccde5")
+
+    hidden_nodes = set()
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        attrs = dict(node_attr)
+        label = name
+        if op == "null":
+            if name.endswith(("_weight", "_bias", "_beta", "_gamma",
+                              "_moving_var", "_moving_mean", "_running_var",
+                              "_running_mean")):
+                if hide_weights:
+                    hidden_nodes.add(i)
+                continue
+            attrs["shape"] = "oval"
+            attrs["fillcolor"] = fill_colors[0]
+        elif op == "Convolution":
+            a = node.get("attrs", {})
+            label = "Convolution\n%s/%s, %s" % (
+                "x".join(str(x) for x in _tuple(a.get("kernel", "()"))),
+                "x".join(str(x) for x in _tuple(a.get("stride", "(1,1)"))),
+                a.get("num_filter", "?"))
+            attrs["fillcolor"] = fill_colors[1]
+        elif op == "FullyConnected":
+            label = "FullyConnected\n%s" % node.get("attrs", {}).get(
+                "num_hidden", "?")
+            attrs["fillcolor"] = fill_colors[1]
+        elif op == "BatchNorm":
+            attrs["fillcolor"] = fill_colors[3]
+        elif op in ("Activation", "LeakyReLU"):
+            label = "%s\n%s" % (op, node.get("attrs", {}).get("act_type", ""))
+            attrs["fillcolor"] = fill_colors[2]
+        elif op == "Pooling":
+            a = node.get("attrs", {})
+            label = "Pooling\n%s, %s/%s" % (
+                a.get("pool_type", "?"),
+                "x".join(str(x) for x in _tuple(a.get("kernel", "()"))),
+                "x".join(str(x) for x in _tuple(a.get("stride", "(1,1)"))))
+            attrs["fillcolor"] = fill_colors[4]
+        elif op in ("Concat", "Flatten", "Reshape"):
+            attrs["fillcolor"] = fill_colors[5]
+        elif op == "Softmax":
+            attrs["fillcolor"] = fill_colors[6]
+        else:
+            attrs["fillcolor"] = fill_colors[7]
+        dot.node(name=name, label=label, **attrs)
+
+    for i, node in enumerate(nodes):
+        if node["op"] == "null" or i in hidden_nodes:
+            continue
+        for item in node["inputs"]:
+            src = nodes[item[0]]
+            if item[0] in hidden_nodes:
+                continue
+            if src["op"] == "null" and src["name"] not in \
+                    symbol.list_arguments():
+                continue
+            label = ""
+            if draw_shape:
+                key = src["name"] + "_output" if src["op"] != "null" \
+                    else src["name"]
+                if key in shape_dict:
+                    label = "x".join(str(x) for x in shape_dict[key][1:])
+            dot.edge(tail_name=src["name"], head_name=node["name"],
+                     label=label)
+    return dot
