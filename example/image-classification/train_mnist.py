@@ -1,0 +1,88 @@
+"""Train MNIST with the Module API — BASELINE workload 1.
+
+Counterpart of reference ``example/image-classification/train_mnist.py:79,96``
+(Module + MNISTIter + kvstore through ``common/fit.py:148``). Reads the
+standard MNIST idx files from ``--data-dir`` when present; with no dataset on
+disk (this environment has no network egress) it falls back to a synthetic
+MNIST-shaped dataset so the full Module/kvstore/optimizer/metric stack still
+runs end-to-end.
+
+Usage::
+
+    python train_mnist.py --network mlp            # reference default
+    python train_mnist.py --network lenet --devices 8 --kv-store tpu
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) + "/../..")
+
+import numpy as np
+
+import mxnet_tpu as mx
+from common import fit
+
+
+def _synthetic_mnist(n, seed):
+    """MNIST-shaped synthetic data: 10 class blobs around FIXED centers
+    (shared between train and val so validation is meaningful), learnable by
+    an MLP in one epoch — keeps the example runnable with zero egress."""
+    centers = np.random.RandomState(0).rand(10, 1, 28, 28).astype(np.float32)
+    rng = np.random.RandomState(seed)
+    label = rng.randint(0, 10, n)
+    img = centers[label] + 0.3 * rng.rand(n, 1, 28, 28).astype(np.float32)
+    return img, label.astype(np.float32)
+
+
+def get_mnist_iter(args, kv):
+    """MNIST iterators (reference train_mnist.py:get_mnist_iter); synthetic
+    fallback when the idx files are absent."""
+    d = args.data_dir
+    files = ["train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+             "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"]
+
+    def find(name):
+        for suffix in ("", ".gz"):
+            p = os.path.join(d, name + suffix)
+            if os.path.exists(p):
+                return p
+        return None
+
+    paths = [find(f) for f in files]
+    if all(paths):
+        train = mx.io.MNISTIter(image=paths[0], label=paths[1],
+                                batch_size=args.batch_size, shuffle=True)
+        val = mx.io.MNISTIter(image=paths[2], label=paths[3],
+                              batch_size=args.batch_size, shuffle=False)
+        return train, val
+    logging.warning("MNIST files not found under %r; using synthetic data", d)
+    n_train = args.num_examples
+    train_img, train_lbl = _synthetic_mnist(n_train, seed=7)
+    val_img, val_lbl = _synthetic_mnist(max(n_train // 6, args.batch_size),
+                                        seed=8)
+    train = mx.io.NDArrayIter(train_img, train_lbl, args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(val_img, val_lbl, args.batch_size)
+    return train, val
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--num-examples", type=int, default=60000)
+    parser.add_argument("--data-dir", type=str, default="data")
+    fit.add_fit_args(parser)
+    parser.set_defaults(network="mlp", batch_size=64, disp_batches=100,
+                        num_epochs=2, lr=0.05, lr_step_epochs="10")
+    args = parser.parse_args()
+
+    from importlib import import_module
+    net = import_module("symbols." + args.network)
+    sym = net.get_symbol(**vars(args))
+
+    fit.fit(args, sym, get_mnist_iter)
