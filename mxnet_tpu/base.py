@@ -166,3 +166,27 @@ _PARSERS = {
 
 def parser_for(typ) -> Callable:
     return _PARSERS.get(typ, typ if callable(typ) else parse_str)
+
+
+def flatten_list(args):
+    """Flatten nested lists/tuples into (flat list, fmt tree); fmt 0 marks a
+    single leaf, a list recurses. Shared by the control-flow front-ends."""
+    if not isinstance(args, (list, tuple)):
+        return [args], 0
+    flat, fmts = [], []
+    for a in args:
+        f, fmt = flatten_list(a)
+        flat.extend(f)
+        fmts.append(fmt)
+    return flat, fmts
+
+
+def regroup_list(flat, fmt):
+    """Inverse of :func:`flatten_list`; returns (tree, remaining flat)."""
+    if isinstance(fmt, int):
+        return flat[0], flat[1:]
+    out = []
+    for f in fmt:
+        res, flat = regroup_list(flat, f)
+        out.append(res)
+    return out, flat

@@ -75,3 +75,5 @@ lesser = getattr(_mod, "broadcast_lesser")
 lesser_equal = getattr(_mod, "broadcast_lesser_equal")
 negative = getattr(_mod, "negative")
 split = getattr(_mod, "SliceChannel")
+
+from . import contrib  # noqa: E402,F401  (control flow: foreach/while_loop/cond)

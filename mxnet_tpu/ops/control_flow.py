@@ -1,0 +1,177 @@
+"""Control-flow operators lowered to XLA structured control flow.
+
+Reference: ``_foreach`` (src/operator/control_flow.cc:35-73), ``_while_loop``
+and ``_cond`` (subgraph ops, src/operator/subgraph_op_common.cc). The
+reference interprets a captured subgraph once per iteration through its
+dependency engine; here each op compiles into ONE XLA construct —
+``lax.scan`` for ``_foreach``, a masked ``lax.scan`` with a static trip
+count for ``_while_loop`` (predicated state updates keep it reverse-mode
+differentiable, which raw ``lax.while_loop`` is not), and ``lax.cond`` for
+``_cond``. Gradients come free from whole-graph ``jax.vjp`` like every
+other op (registry docstring).
+
+Subgraphs are stored in node attrs as Symbol objects (serialized to nested
+graph JSON by ``OpDef.serialize_attrs``, parsed back on load); the op's
+positional inputs bind to the subgraph's named variables through the
+``*_names`` attrs.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import REQUIRED, register
+
+
+def _subgraph(v):
+    if isinstance(v, str):
+        from ..symbol import load_json
+
+        return load_json(v)
+    return v
+
+
+def _names(v):
+    if isinstance(v, str):
+        v = v.strip().lstrip("(").rstrip(")")  # empty lists serialize as "()"
+        return tuple(x for x in (p.strip() for p in v.split(",")) if x)
+    return tuple(v)
+
+
+def _scalar_bool(x):
+    return jnp.reshape(x, ()).astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# _foreach → lax.scan
+# ---------------------------------------------------------------------------
+
+
+def _foreach_inputs(attrs):
+    return (list(attrs["data_names"]) + list(attrs["state_names"])
+            + list(attrs["free_names"]))
+
+
+@register(
+    "_foreach",
+    params={
+        "__subgraph__": (_subgraph, REQUIRED),
+        "data_names": (_names, REQUIRED),
+        "state_names": (_names, REQUIRED),
+        "free_names": (_names, ()),
+        "num_out_data": (int, REQUIRED),
+    },
+    inputs=_foreach_inputs,
+    num_outputs=lambda a: a["num_out_data"] + len(a["state_names"]),
+)
+def _foreach(attrs, *inputs):
+    """scan the subgraph over axis 0 of each data input; subgraph outputs
+    are [step outputs..., new states...] (reference control_flow.cc:35)."""
+    sub = attrs["__subgraph__"]
+    dn, sn = attrs["data_names"], attrs["state_names"]
+    fn = attrs["free_names"]
+    nd_, ns, nod = len(dn), len(sn), attrs["num_out_data"]
+    data = tuple(inputs[:nd_])
+    states = tuple(inputs[nd_:nd_ + ns])
+    free = dict(zip(fn, inputs[nd_ + ns:]))
+
+    def step(carry, xs):
+        vm = dict(zip(sn, carry))
+        vm.update(zip(dn, xs))
+        vm.update(free)
+        outs = sub.eval_jax(vm)
+        return tuple(outs[nod:]), tuple(outs[:nod])
+
+    final_states, stacked = lax.scan(step, states, data)
+    return tuple(stacked) + tuple(final_states)
+
+
+# ---------------------------------------------------------------------------
+# _while_loop → masked lax.scan (static trip count)
+# ---------------------------------------------------------------------------
+
+
+def _while_inputs(attrs):
+    return list(attrs["loop_var_names"]) + list(attrs["free_names"])
+
+
+@register(
+    "_while_loop",
+    params={
+        "__cond__": (_subgraph, REQUIRED),
+        "__func__": (_subgraph, REQUIRED),
+        "loop_var_names": (_names, REQUIRED),
+        "free_names": (_names, ()),
+        "num_out_data": (int, REQUIRED),
+        "max_iterations": (int, REQUIRED),
+    },
+    inputs=_while_inputs,
+    num_outputs=lambda a: a["num_out_data"] + len(a["loop_var_names"]),
+)
+def _while_loop(attrs, *inputs):
+    """Run the func subgraph while the cond subgraph is true, at most
+    ``max_iterations`` times. Step outputs are stacked into buffers of
+    leading size max_iterations (rows past the final step are zero —
+    reference while_loop leaves them undefined); final loop vars follow.
+    Lowered as a scan with predicated updates: both subgraphs are evaluated
+    every iteration and results are selected by the live mask, trading
+    wasted FLOPs for a static schedule the MXU can run."""
+    cond_g, func_g = attrs["__cond__"], attrs["__func__"]
+    vn, fn = attrs["loop_var_names"], attrs["free_names"]
+    nv, nod = len(vn), attrs["num_out_data"]
+    loop_vars = tuple(inputs[:nv])
+    free = dict(zip(fn, inputs[nv:]))
+
+    def step(carry, _):
+        active, vars_ = carry
+        vm = dict(zip(vn, vars_))
+        vm.update(free)
+        do = jnp.logical_and(active, _scalar_bool(cond_g.eval_jax(vm)[0]))
+        outs = func_g.eval_jax(vm)
+        step_out = tuple(jnp.where(do, o, jnp.zeros_like(o))
+                         for o in outs[:nod])
+        new_vars = tuple(jnp.where(do, n, v)
+                         for n, v in zip(outs[nod:], vars_))
+        return (do, new_vars), step_out
+
+    (_, final_vars), stacked = lax.scan(
+        step, (jnp.bool_(True), loop_vars), None,
+        length=attrs["max_iterations"])
+    return tuple(stacked) + tuple(final_vars)
+
+
+# ---------------------------------------------------------------------------
+# _cond → lax.cond
+# ---------------------------------------------------------------------------
+
+
+def _cond_inputs(attrs):
+    return list(attrs["input_names"])
+
+
+@register(
+    "_cond",
+    params={
+        "__pred__": (_subgraph, REQUIRED),
+        "__then__": (_subgraph, REQUIRED),
+        "__else__": (_subgraph, REQUIRED),
+        "input_names": (_names, REQUIRED),
+        "num_out": (int, REQUIRED),
+    },
+    inputs=_cond_inputs,
+    num_outputs=lambda a: a["num_out"],
+)
+def _cond(attrs, *inputs):
+    """Branch between the then/else subgraphs on the pred subgraph's scalar
+    output; both branches must yield identical shapes/dtypes (reference
+    contract and an XLA requirement alike)."""
+    vm = dict(zip(attrs["input_names"], inputs))
+    pred = _scalar_bool(attrs["__pred__"].eval_jax(vm)[0])
+
+    def then_fn(_):
+        return tuple(attrs["__then__"].eval_jax(vm))
+
+    def else_fn(_):
+        return tuple(attrs["__else__"].eval_jax(vm))
+
+    return lax.cond(pred, then_fn, else_fn, None)

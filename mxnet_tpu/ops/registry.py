@@ -119,8 +119,15 @@ class OpDef:
                 from ..base import dtype_name
 
                 out[k] = dtype_name(v)
+            elif hasattr(v, "tojson"):
+                # subgraph attrs (control-flow ops) nest their graph JSON
+                out[k] = v.tojson()
             elif isinstance(v, (tuple, list)):
-                out[k] = "(" + ", ".join(str(int(x)) for x in v) + ")"
+                if v and all(isinstance(x, str) for x in v):
+                    out[k] = ",".join(v)  # name lists (control-flow ops)
+                else:
+                    # () serializes as "()" so empty shapes/axes round-trip
+                    out[k] = "(" + ", ".join(str(int(x)) for x in v) + ")"
             else:
                 out[k] = str(v)
         return out

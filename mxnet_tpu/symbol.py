@@ -176,6 +176,34 @@ class Symbol(object):
     def __pow__(self, o):
         return self._binop(o, "_power", "_power_scalar")
 
+    def __mod__(self, o):
+        return self._binop(o, "broadcast_mod", "_mod_scalar")
+
+    def __rmod__(self, o):
+        return self._binop(o, "broadcast_mod", "_rmod_scalar", reverse=True)
+
+    # comparisons build graph nodes like the reference (symbol.py:303-339);
+    # identity-based __hash__ is kept so Symbols stay usable in dicts/sets
+    __hash__ = object.__hash__
+
+    def __eq__(self, o):
+        return self._binop(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        return self._binop(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binop(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binop(o, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binop(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binop(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
     def __neg__(self):
         return _invoke("negative", [self], {})
 
@@ -563,6 +591,49 @@ def _fill_param_shapes(node: _Node, in_shapes):
                 "LogisticRegressionOutput", "MAERegressionOutput",
                 "SVMOutput") and data is not None:
         put("label", data[:-1] if op == "SoftmaxOutput" else data)
+    elif op in ("_foreach", "_while_loop", "_cond"):
+        # recurse into the subgraph: bind the interface vars' shapes we know
+        # and run partial inference there to recover free-variable shapes
+        # (layer weights, BN stats used inside the loop) — the counterpart
+        # of the reference's subgraph-op InferShape
+        # (src/operator/subgraph_op_common.cc:InferSubgraphShape)
+        if op == "_foreach":
+            iface = list(attrs["data_names"]) + list(attrs["state_names"])
+            subs = [attrs["__subgraph__"]]
+            known = {}
+            for i, n in enumerate(attrs["data_names"]):
+                if in_shapes[i] is not None:
+                    known[n] = tuple(in_shapes[i][1:])  # slice off time axis
+            off = len(attrs["data_names"])
+            for j, n in enumerate(attrs["state_names"]):
+                if in_shapes[off + j] is not None:
+                    known[n] = tuple(in_shapes[off + j])
+        elif op == "_while_loop":
+            iface = list(attrs["loop_var_names"])
+            subs = [attrs["__cond__"], attrs["__func__"]]
+            known = {n: tuple(s) for n, s in zip(iface, in_shapes)
+                     if s is not None}
+        else:  # _cond
+            iface = []
+            subs = [attrs["__pred__"], attrs["__then__"], attrs["__else__"]]
+            known = {n: tuple(s) for n, s in
+                     zip(attrs["input_names"], in_shapes) if s is not None}
+        filled_any = False
+        for sub in subs:
+            try:
+                arg_shapes, _, aux_shapes = sub.infer_shape_partial(**known)
+            except MXNetError:
+                continue
+            found = dict(zip(sub.list_arguments(), arg_shapes))
+            found.update(zip(sub.list_auxiliary_states(), aux_shapes))
+            for slot in in_names:
+                if slot not in iface:
+                    shp = found.get(slot)
+                    if shp is not None and named.get(slot) is None:
+                        put(slot, shp)
+                        filled_any = True
+        if not filled_any:
+            return None
     else:
         return None
     return out
@@ -701,7 +772,13 @@ def load_json(json_str: str) -> Symbol:
     nodes: List[_Node] = []
     for nj in nodes_js:
         op = nj["op"]
-        attrs = nj.get("attrs", nj.get("attr", nj.get("param", {}))) or {}
+        # v1.0 nodes carry BOTH 'param' (op parameters) and 'attr' (user
+        # attributes like ctx_group/lr_mult); v1.1+ uses a single 'attrs'.
+        # Merge them in upgrade order, op params first, exactly as the
+        # reference's legacy_json_util.cc folds node->param into attrs.
+        attrs = dict(nj.get("param") or {})
+        attrs.update(nj.get("attr") or {})
+        attrs.update(nj.get("attrs") or {})
         if op == "null":
             node = _Node(None, nj["name"], {}, [])
             node._extra_attrs = dict(attrs)
@@ -709,6 +786,20 @@ def load_json(json_str: str) -> Symbol:
             if op not in OP_REGISTRY:
                 raise MXNetError("symbol JSON references unknown op %r" % op)
             inputs = [(nodes[i], idx) for i, idx, *_ in nj.get("inputs", [])]
+            # pre-NNVM graphs (v1.0, e.g. the reference fixture
+            # save_000800.json) list only the differentiable inputs; aux
+            # states (BatchNorm moving stats) lived outside the graph
+            # (reference legacy_op_util.cc ListAuxiliaryStates). Synthesize
+            # the missing trailing aux-variable inputs.
+            opdef = get_op(op)
+            slot_names = opdef.input_names(opdef.parse_attrs(dict(attrs)))
+            if len(inputs) < len(slot_names) and all(
+                    s in _AUX_INPUT_NAMES
+                    for s in slot_names[len(inputs):]):
+                for slot in slot_names[len(inputs):]:
+                    aux = _Node(None, "%s_%s" % (nj["name"], slot), {}, [])
+                    aux._forced_aux = True
+                    inputs.append((aux, 0))
             node = _Node(op, nj["name"], dict(attrs), inputs)
         nodes.append(node)
     heads = [(nodes[i], idx) for i, idx, *_ in js["heads"]]
@@ -735,3 +826,6 @@ _mod = _sys.modules[__name__]
 for _opname in list(OP_REGISTRY):
     if not hasattr(_mod, _opname):
         setattr(_mod, _opname, _make_sym_op(_opname))
+
+# symbolic control flow namespace (reference mx.sym.contrib)
+from . import sym_contrib as contrib  # noqa: E402,F401

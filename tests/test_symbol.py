@@ -1,0 +1,62 @@
+"""Symbol serialization tests, including the legacy-JSON upgrade path
+(reference src/nnvm/legacy_json_util.cc; fixture
+tests/python/unittest/save_000800.json is a REAL v1.0 artifact saved by
+MXNet 0.8)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+LEGACY_JSON = "/root/reference/tests/python/unittest/save_000800.json"
+
+
+@pytest.mark.skipif(not os.path.exists(LEGACY_JSON),
+                    reason="reference fixture not available")
+def test_legacy_v1_json_loads_and_runs():
+    """The v1.0 format keeps op parameters in a per-node 'param' dict next
+    to user 'attr's, and omits aux-state inputs (BatchNorm moving stats);
+    loading must merge the dicts and synthesize the aux variables."""
+    sym = mx.sym.load(LEGACY_JSON)
+    assert sym.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "fc3_weight", "fc3_bias", "batchnorm0_gamma", "batchnorm0_beta",
+        "softmax_label"]
+    assert sym.list_auxiliary_states() == [
+        "batchnorm0_moving_mean", "batchnorm0_moving_var"]
+
+    arg_shapes, out_shapes, aux_shapes = sym.infer_shape(data=(4, 10))
+    assert out_shapes == [(4, 10)]
+    assert aux_shapes == [(10,), (10,)]
+
+    ex = sym.simple_bind(mx.cpu(), data=(4, 10))
+    ex.arg_dict["data"][:] = np.random.rand(4, 10).astype(np.float32)
+    out = ex.forward(is_train=False)
+    # SoftmaxOutput rows sum to one
+    np.testing.assert_allclose(out[0].asnumpy().sum(axis=1), 1.0, rtol=1e-5)
+
+
+@pytest.mark.skipif(not os.path.exists(LEGACY_JSON),
+                    reason="reference fixture not available")
+def test_legacy_json_roundtrips_to_modern_format():
+    sym = mx.sym.load(LEGACY_JSON)
+    js = json.loads(sym.tojson())
+    # modern format: single 'attrs' dict, no 'param'
+    assert all("param" not in n for n in js["nodes"])
+    s2 = mx.sym.load_json(sym.tojson())
+    assert s2.list_arguments() == sym.list_arguments()
+    assert s2.list_auxiliary_states() == sym.list_auxiliary_states()
+
+
+def test_modern_json_roundtrip(tmp_path):
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc")
+    out = mx.sym.SoftmaxOutput(data=fc, name="softmax")
+    fname = str(tmp_path / "m-symbol.json")
+    out.save(fname)
+    back = mx.sym.load(fname)
+    assert back.list_arguments() == out.list_arguments()
+    _, shapes, _ = back.infer_shape(data=(2, 5))
+    assert shapes == [(2, 8)]
