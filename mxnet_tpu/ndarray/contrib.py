@@ -109,3 +109,17 @@ def cond(pred, then_func, else_func):
     if _to_scalar(pred, bool, "pred"):
         return then_func()
     return else_func()
+
+
+def _export_contrib_ops():
+    """Expose every registered _contrib_* op under its short name here
+    (reference mx.nd.contrib.box_nms etc.)."""
+    import sys
+
+    pkg = sys.modules["mxnet_tpu.ndarray"]
+    for flat in dir(pkg):
+        if flat.startswith("_contrib_"):
+            globals().setdefault(flat[len("_contrib_"):], getattr(pkg, flat))
+
+
+_export_contrib_ops()

@@ -8,5 +8,6 @@ from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import linalg  # noqa: F401
 from . import control_flow  # noqa: F401
+from . import detection  # noqa: F401
 
 __all__ = ["OP_REGISTRY", "OpDef", "AttrDict", "get_op", "list_ops", "register", "REQUIRED"]

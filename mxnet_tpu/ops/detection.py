@@ -1,0 +1,437 @@
+"""Detection operator family (SSD/R-CNN tail).
+
+Reference: ``src/operator/contrib/bounding_box.cc`` (box_nms/box_iou/
+bipartite_matching), ``multibox_prior.cc``, ``multibox_target.cc``,
+``multibox_detection.cc``, ``roi_align.cc``. The reference implements these
+as custom CPU/CUDA kernels with data-dependent loops; here everything is
+padded, masked, vectorized XLA — except the NMS suppression loop, which is
+a first-party Pallas TPU kernel (``pallas_kernels.nms_keep``). Suppressed/
+invalid slots carry -1 exactly like the reference, so downstream consumers
+see identical semantics with static shapes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import REQUIRED, register
+from . import pallas_kernels
+
+
+def _floats(v):
+    if isinstance(v, str):
+        s = v.strip().lstrip("([").rstrip(")]")
+        return tuple(float(x) for x in s.split(",") if x.strip())
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+_FMT = {"corner": 0, "center": 1, 0: 0, 1: 1, "0": 0, "1": 1}
+
+
+def _to_corner(boxes, fmt):
+    if _FMT[fmt] == 0:
+        return boxes
+    x, y, w, h = (boxes[..., i] for i in range(4))
+    return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+
+
+def _from_corner(boxes, fmt):
+    if _FMT[fmt] == 0:
+        return boxes
+    x1, y1, x2, y2 = (boxes[..., i] for i in range(4))
+    return jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1],
+                     axis=-1)
+
+
+def _pair_iou(a, b):
+    """IoU matrix between corner boxes a (..., N, 4) and b (..., M, 4)."""
+    a = a[..., :, None, :]
+    b = b[..., None, :, :]
+    iw = jnp.maximum(jnp.minimum(a[..., 2], b[..., 2])
+                     - jnp.maximum(a[..., 0], b[..., 0]), 0.0)
+    ih = jnp.maximum(jnp.minimum(a[..., 3], b[..., 3])
+                     - jnp.maximum(a[..., 1], b[..., 1]), 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0.0) * \
+        jnp.maximum(a[..., 3] - a[..., 1], 0.0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0.0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0.0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+# ---------------------------------------------------------------------------
+# box_iou
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_box_iou",
+          params={"format": (str, "corner")},
+          inputs=("lhs", "rhs"))
+def _box_iou(attrs, lhs, rhs):
+    """IoU between every pair (reference bounding_box.cc box_iou)."""
+    return _pair_iou(_to_corner(lhs, attrs.format),
+                     _to_corner(rhs, attrs.format))
+
+
+# ---------------------------------------------------------------------------
+# box_nms
+# ---------------------------------------------------------------------------
+
+
+_NMS_PARAMS = {
+    "overlap_thresh": (float, 0.5),
+    "valid_thresh": (float, 0.0),
+    "topk": (int, -1),
+    "coord_start": (int, 2),
+    "score_index": (int, 1),
+    "id_index": (int, -1),
+    "force_suppress": (bool, False),
+    "in_format": (str, "corner"),
+    "out_format": (str, "corner"),
+}
+
+
+def _nms_one(flat, attrs):
+    """NMS over one (N, K) box table; returns (N, K) with suppressed rows
+    -1, remaining rows sorted by descending score (reference semantics)."""
+    n, k = flat.shape
+    cs, si, ii = attrs.coord_start, attrs.score_index, attrs.id_index
+    scores = flat[:, si]
+    valid = scores > attrs.valid_thresh
+    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+    rank = jnp.arange(n)
+    if attrs.topk > 0:
+        in_topk = rank < attrs.topk
+    else:
+        in_topk = jnp.ones((n,), bool)
+    sorted_rows = flat[order]
+    boxes = _to_corner(sorted_rows[:, cs:cs + 4], attrs.in_format)
+    cls_ids = sorted_rows[:, ii] if ii >= 0 else jnp.full((n,), -1.0)
+    valid_sorted = jnp.logical_and(valid[order], in_topk)
+    keep = pallas_kernels.nms_keep(
+        boxes, cls_ids, valid_sorted, attrs.overlap_thresh,
+        attrs.force_suppress or ii < 0)
+    out_rows = sorted_rows
+    if attrs.out_format != attrs.in_format:
+        conv = _from_corner(boxes, attrs.out_format)
+        out_rows = out_rows.at[:, cs:cs + 4].set(conv)
+    return jnp.where(keep[:, None], out_rows, -jnp.ones_like(out_rows))
+
+
+@register("_contrib_box_nms", params=_NMS_PARAMS,
+          aliases=("_contrib_box_non_maximum_suppression",))
+def _box_nms(attrs, data):
+    """Non-maximum suppression (reference bounding_box.cc BoxNMSForward →
+    Pallas suppression kernel, vmapped over batch). Output keeps the input
+    shape; suppressed and invalid entries are -1; survivors are sorted by
+    score."""
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])
+    return jax.vmap(lambda f: _nms_one(f, attrs))(flat).reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# bipartite_matching
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_bipartite_matching",
+          params={"is_ascend": (bool, False), "threshold": (float, REQUIRED),
+                  "topk": (int, -1)},
+          num_outputs=2)
+def _bipartite_matching(attrs, data):
+    """Greedy bipartite matching on a score matrix (reference
+    bounding_box.cc BipartiteMatchingForward): repeatedly take the globally
+    best unmatched pair while it passes ``threshold``. Returns (row_match,
+    col_match) with -1 for unmatched."""
+    shape = data.shape
+    n, m = shape[-2], shape[-1]
+    flat = data.reshape((-1, n, m))
+    sign = 1.0 if attrs.is_ascend else -1.0
+    limit = n if attrs.topk < 0 else min(attrs.topk, n)
+
+    def one(mat):
+        def body(_, state):
+            mat, row, col = state
+            idx = jnp.argmin(sign * mat)
+            r, c = idx // m, idx % m
+            v = mat[r, c]
+            ok = (v <= attrs.threshold) if attrs.is_ascend \
+                else (v >= attrs.threshold)
+            row = jnp.where(ok, row.at[r].set(c.astype(jnp.float32)), row)
+            col = jnp.where(ok, col.at[c].set(r.astype(jnp.float32)), col)
+            fill = jnp.inf * sign
+            mat = jnp.where(ok, mat.at[r, :].set(fill).at[:, c].set(fill), mat)
+            return mat, row, col
+
+        row0 = jnp.full((n,), -1.0)
+        col0 = jnp.full((m,), -1.0)
+        _, row, col = lax.fori_loop(0, min(limit, m), body, (mat, row0, col0))
+        return row, col
+
+    rows, cols = jax.vmap(one)(flat)
+    return (rows.reshape(shape[:-1]), cols.reshape(shape[:-2] + (m,)))
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_MultiBoxPrior",
+          params={"sizes": (_floats, (1.0,)), "ratios": (_floats, (1.0,)),
+                  "clip": (bool, False), "steps": (_floats, (-1.0, -1.0)),
+                  "offsets": (_floats, (0.5, 0.5))},
+          aliases=("MultiBoxPrior",))
+def _multibox_prior(attrs, data):
+    """Anchor boxes per feature-map location (reference
+    multibox_prior.cc:40-78, fully vectorized). Output (1, H*W*A, 4)."""
+    h, w = data.shape[2], data.shape[3]
+    sizes, ratios = attrs.sizes, attrs.ratios
+    step_y = attrs.steps[0] if attrs.steps[0] > 0 else 1.0 / h
+    step_x = attrs.steps[1] if attrs.steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + attrs.offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + attrs.offsets[1]) * step_x
+    # per-location half-extents, order: sizes first (ratio 1), then
+    # ratios[1:] at sizes[0] — reference multibox_prior.cc:46-69
+    half = []
+    for s in sizes:
+        half.append((s * h / w / 2.0, s / 2.0))
+    for r in ratios[1:]:
+        sr = float(np.sqrt(r))
+        half.append((sizes[0] * h / w * sr / 2.0, sizes[0] / sr / 2.0))
+    hw = jnp.asarray([p[0] for p in half], jnp.float32)  # (A,)
+    hh = jnp.asarray([p[1] for p in half], jnp.float32)
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")      # (H, W)
+    cyg = cyg[:, :, None]
+    cxg = cxg[:, :, None]
+    out = jnp.stack([cxg - hw, cyg - hh, cxg + hw, cyg + hh], axis=-1)
+    out = out.reshape(1, h * w * len(half), 4)
+    if attrs.clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget
+# ---------------------------------------------------------------------------
+
+
+def _encode_loc(anchors, gt, variances):
+    """SSD box encoding (reference multibox_target.cc TargetEncoding)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) / 2
+    ay = (anchors[:, 1] + anchors[:, 3]) / 2
+    gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-12)
+    gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-12)
+    gx = (gt[:, 0] + gt[:, 2]) / 2
+    gy = (gt[:, 1] + gt[:, 3]) / 2
+    v0, v1, v2, v3 = variances
+    return jnp.stack([
+        (gx - ax) / jnp.maximum(aw, 1e-12) / v0,
+        (gy - ay) / jnp.maximum(ah, 1e-12) / v1,
+        jnp.log(gw / jnp.maximum(aw, 1e-12)) / v2,
+        jnp.log(gh / jnp.maximum(ah, 1e-12)) / v3,
+    ], axis=-1)
+
+
+@register("_contrib_MultiBoxTarget",
+          params={"overlap_threshold": (float, 0.5),
+                  "ignore_label": (float, -1.0),
+                  "negative_mining_ratio": (float, -1.0),
+                  "negative_mining_thresh": (float, 0.5),
+                  "minimum_negative_samples": (int, 0),
+                  "variances": (_floats, (0.1, 0.1, 0.2, 0.2))},
+          inputs=("anchor", "label", "cls_pred"), num_outputs=3)
+def _multibox_target(attrs, anchor, label, cls_pred):
+    """Training targets for SSD (reference multibox_target.cc): greedy
+    bipartite anchor-GT matching + per-anchor threshold matching, encoded
+    location targets, and optional hard-negative mining ranked by the
+    anchors' max non-background class probability.
+    Outputs: loc_target (B, N*4), loc_mask (B, N*4), cls_target (B, N)."""
+    anchors = anchor.reshape(-1, 4)
+    n = anchors.shape[0]
+    m = label.shape[1]
+
+    def one(lab, pred):
+        gt_valid = lab[:, 0] >= 0
+        gt_boxes = lab[:, 1:5]
+        iou = jnp.where(gt_valid[None, :],
+                        _pair_iou(anchors, gt_boxes), 0.0)  # (N, M)
+
+        # greedy bipartite: best anchor for each gt, globally ordered
+        def body(_, state):
+            mat, match = state
+            idx = jnp.argmax(mat)
+            a, g = idx // m, idx % m
+            ok = mat[a, g] > 1e-12
+            match = jnp.where(ok, match.at[a].set(g), match)
+            mat = jnp.where(ok, mat.at[a, :].set(-1.0).at[:, g].set(-1.0),
+                            mat)
+            return mat, match
+
+        match0 = jnp.full((n,), -1, jnp.int32)
+        _, match = lax.fori_loop(0, m, body, (iou, match0))
+
+        # threshold matching for the rest
+        best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+        best_iou = jnp.max(iou, axis=1)
+        thresh_ok = jnp.logical_and(match < 0,
+                                    best_iou >= attrs.overlap_threshold)
+        match = jnp.where(thresh_ok, best_gt, match)
+        matched = match >= 0
+        safe = jnp.maximum(match, 0)
+
+        loc_t = _encode_loc(anchors, gt_boxes[safe], attrs.variances)
+        loc_t = jnp.where(matched[:, None], loc_t, 0.0).reshape(-1)
+        loc_m = jnp.broadcast_to(matched[:, None], (n, 4)) \
+            .astype(jnp.float32).reshape(-1)
+
+        cls_t = jnp.where(matched, lab[safe, 0] + 1.0, 0.0)
+        if attrs.negative_mining_ratio > 0:
+            num_pos = jnp.sum(matched)
+            max_neg = jnp.maximum(
+                (attrs.negative_mining_ratio * num_pos).astype(jnp.int32),
+                attrs.minimum_negative_samples)
+            neg_cand = jnp.logical_and(
+                ~matched, best_iou < attrs.negative_mining_thresh)
+            # rank negatives by max non-background confidence (hardest first)
+            conf = jnp.max(pred[1:, :], axis=0) if pred.shape[0] > 1 \
+                else pred[0]
+            score = jnp.where(neg_cand, conf, -jnp.inf)
+            order = jnp.argsort(-score)
+            neg_rank = jnp.zeros((n,), jnp.int32).at[order].set(
+                jnp.arange(n, dtype=jnp.int32))
+            keep_neg = jnp.logical_and(neg_cand, neg_rank < max_neg)
+            cls_t = jnp.where(jnp.logical_or(matched, keep_neg),
+                              cls_t, attrs.ignore_label)
+        return loc_t, loc_m, cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(label, cls_pred)
+    return loc_t, loc_m, cls_t
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_MultiBoxDetection",
+          params={"clip": (bool, True), "threshold": (float, 0.01),
+                  "background_id": (int, 0), "nms_threshold": (float, 0.5),
+                  "force_suppress": (bool, False),
+                  "variances": (_floats, (0.1, 0.1, 0.2, 0.2)),
+                  "nms_topk": (int, -1)},
+          inputs=("cls_prob", "loc_pred", "anchor"))
+def _multibox_detection(attrs, cls_prob, loc_pred, anchor):
+    """Decode + per-class NMS (reference multibox_detection.cc).
+    Output (B, N, 6): [class_id, score, xmin, ymin, xmax, ymax]; invalid
+    entries -1. class_id skips the background class."""
+    b, _, n = cls_prob.shape
+    anchors = anchor.reshape(-1, 4)
+    if anchors.shape[0] != n or loc_pred.shape[-1] != n * 4:
+        from ..base import MXNetError
+
+        raise MXNetError(
+            "MultiBoxDetection: cls_prob has %d anchors but anchor/loc_pred "
+            "carry %d/%d" % (n, anchors.shape[0], loc_pred.shape[-1] // 4))
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) / 2
+    ay = (anchors[:, 1] + anchors[:, 3]) / 2
+    v0, v1, v2, v3 = attrs.variances
+
+    def one(probs, locs):
+        p = locs.reshape(n, 4)
+        ox = p[:, 0] * v0 * aw + ax
+        oy = p[:, 1] * v1 * ah + ay
+        hw = jnp.exp(p[:, 2] * v2) * aw / 2
+        hh = jnp.exp(p[:, 3] * v3) * ah / 2
+        boxes = jnp.stack([ox - hw, oy - hh, ox + hw, oy + hh], axis=-1)
+        if attrs.clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        bg = attrs.background_id
+        masked = probs.at[bg, :].set(-1.0)
+        best = jnp.argmax(masked, axis=0)
+        score = jnp.max(masked, axis=0)
+        cls_id = jnp.where(best > bg, best - 1, best).astype(jnp.float32)
+        valid = score > attrs.threshold
+        cls_id = jnp.where(valid, cls_id, -1.0)
+        score = jnp.where(valid, score, -1.0)
+        table = jnp.concatenate(
+            [cls_id[:, None], score[:, None], boxes], axis=-1)
+        return _nms_one(table, nms_attrs)
+
+    from .registry import AttrDict
+
+    nms_attrs = AttrDict(
+        overlap_thresh=attrs.nms_threshold, valid_thresh=0.0,
+        topk=attrs.nms_topk, coord_start=2, score_index=1, id_index=0,
+        force_suppress=attrs.force_suppress, in_format="corner",
+        out_format="corner")
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# ROIAlign
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_ROIAlign",
+          params={"pooled_size": (tuple, REQUIRED),
+                  "spatial_scale": (float, REQUIRED),
+                  "sample_ratio": (int, -1)},
+          inputs=("data", "rois"), aliases=("ROIAlign",))
+def _roi_align(attrs, data, rois):
+    """RoI Align with bilinear sampling (reference roi_align.cc, Mask R-CNN
+    semantics: no coordinate rounding). rois (R, 5) = [batch_idx, x1, y1,
+    x2, y2]; output (R, C, PH, PW). Differentiable through XLA gather —
+    the reference needs a hand-written backward kernel.
+
+    Deviation: with sample_ratio<=0 the reference adapts the tap grid per
+    RoI (ceil(roi_size/pooled_size)); XLA needs static shapes, so a fixed
+    2x2 grid per bin is used instead. Large RoIs pool slightly differently
+    than the reference — pass an explicit sample_ratio for exact-grid
+    parity when porting fine-tuned weights."""
+    ph, pw = attrs.pooled_size
+    sr = attrs.sample_ratio if attrs.sample_ratio > 0 else 2
+    b, c, h, w = data.shape
+
+    def one(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = (roi[i] * attrs.spatial_scale for i in range(1, 5))
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid: (PH*sr, PW*sr) bilinear taps, averaged per bin
+        gy = y1 + (jnp.arange(ph * sr, dtype=jnp.float32) + 0.5) * (bin_h / sr)
+        gx = x1 + (jnp.arange(pw * sr, dtype=jnp.float32) + 0.5) * (bin_w / sr)
+
+        def bilinear(img, ys, xs):
+            y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+            y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+            y0i = y0.astype(jnp.int32)
+            x0i = x0.astype(jnp.int32)
+            ly = jnp.clip(ys - y0, 0.0, 1.0)[:, None]
+            lx = jnp.clip(xs - x0, 0.0, 1.0)[None, :]
+            v00 = img[:, y0i][:, :, x0i]
+            v01 = img[:, y0i][:, :, x1i]
+            v10 = img[:, y1i][:, :, x0i]
+            v11 = img[:, y1i][:, :, x1i]
+            top = v00 * (1 - lx) + v01 * lx
+            bot = v10 * (1 - lx) + v11 * lx
+            return top * (1 - ly) + bot * ly  # (C, PH*sr, PW*sr)
+
+        samp = bilinear(data[bi], gy, gx)
+        samp = samp.reshape(c, ph, sr, pw, sr)
+        return samp.mean(axis=(2, 4))
+
+    return jax.vmap(one)(rois)

@@ -1,0 +1,108 @@
+"""First-party Pallas TPU kernels.
+
+The detection tail is where XLA's stock ops stop being enough: NMS is a
+sequential, data-dependent suppression loop the reference implements as a
+custom CUDA kernel (``src/operator/contrib/bounding_box.cu``). Here it is a
+Pallas TPU kernel: boxes live in VMEM as (8, N) lane-major rows, the
+suppression loop is a ``fori_loop`` whose body is pure VPU work (8x128
+vector compare/select — no scalar gather), and N is padded to the 128-lane
+boundary. On non-TPU backends (the CPU test mesh) the same kernel runs in
+Pallas interpret mode, so correctness is tested everywhere while the TPU
+path compiles to a real kernel.
+
+Layout notes (see /opt/skills/guides/pallas_guide.md):
+- float32 min tile is (8, 128): inputs are packed into an (8, Np) matrix —
+  rows x1,y1,x2,y2,class,keep and two zero rows of padding.
+- iota must be >=2D on TPU: all row vectors are kept (1, Np).
+- scalar extraction from a lane vector uses a masked sum instead of a
+  dynamic gather (VPU-friendly, no SMEM round-trip).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+LANES = 128
+_ROW_X1, _ROW_Y1, _ROW_X2, _ROW_Y2, _ROW_CLS, _ROW_KEEP = range(6)
+_PACK_ROWS = 8  # float32 sublane tile
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pad_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _nms_kernel(packed_ref, out_ref, *, n_boxes, overlap_thresh,
+                force_suppress):
+    """Greedy NMS over score-sorted boxes.
+
+    packed_ref: (8, Np) f32 — rows x1,y1,x2,y2,class,keep(1/0 valid).
+    out_ref:    (8, Np) f32 — row 0 is the final keep mask.
+    """
+    x1 = packed_ref[_ROW_X1:_ROW_X1 + 1, :]
+    y1 = packed_ref[_ROW_Y1:_ROW_Y1 + 1, :]
+    x2 = packed_ref[_ROW_X2:_ROW_X2 + 1, :]
+    y2 = packed_ref[_ROW_Y2:_ROW_Y2 + 1, :]
+    cls = packed_ref[_ROW_CLS:_ROW_CLS + 1, :]
+    keep0 = packed_ref[_ROW_KEEP:_ROW_KEEP + 1, :]
+    np_ = x1.shape[1]
+    lane = lax.broadcasted_iota(jnp.int32, (1, np_), 1)
+    area = jnp.maximum(x2 - x1, 0.0) * jnp.maximum(y2 - y1, 0.0)
+
+    def sel(vec, i):
+        # masked-sum scalar extraction: one VPU pass, no dynamic gather
+        return jnp.sum(jnp.where(lane == i, vec, 0.0))
+
+    def body(i, keep):
+        keep_i = sel(keep, i)
+        xi1, yi1 = sel(x1, i), sel(y1, i)
+        xi2, yi2 = sel(x2, i), sel(y2, i)
+        ci = sel(cls, i)
+        ai = jnp.maximum(xi2 - xi1, 0.0) * jnp.maximum(yi2 - yi1, 0.0)
+        iw = jnp.maximum(jnp.minimum(x2, xi2) - jnp.maximum(x1, xi1), 0.0)
+        ih = jnp.maximum(jnp.minimum(y2, yi2) - jnp.maximum(y1, yi1), 0.0)
+        inter = iw * ih
+        iou = inter / jnp.maximum(area + ai - inter, 1e-12)
+        same = jnp.logical_or(bool(force_suppress), cls == ci)
+        suppress = jnp.logical_and(
+            jnp.logical_and(keep_i > 0.5, lane > i),
+            jnp.logical_and(same, iou > overlap_thresh))
+        return jnp.where(suppress, 0.0, keep)
+
+    keep = lax.fori_loop(0, n_boxes, body, keep0)
+    out_ref[:, :] = jnp.broadcast_to(keep, out_ref.shape)
+
+
+def nms_keep(boxes, cls_ids, valid, overlap_thresh, force_suppress):
+    """Keep mask for greedy NMS over boxes ALREADY sorted by score desc.
+
+    boxes: (N, 4) corner-format f32; cls_ids: (N,) f32 (-1 = no class);
+    valid: (N,) bool. Returns (N,) bool.
+    """
+    n = boxes.shape[0]
+    np_ = _pad_up(max(n, LANES), LANES)
+    pad = np_ - n
+
+    packed = jnp.zeros((_PACK_ROWS, np_), jnp.float32)
+    for row, col in ((_ROW_X1, 0), (_ROW_Y1, 1), (_ROW_X2, 2), (_ROW_Y2, 3)):
+        packed = packed.at[row, :n].set(boxes[:, col].astype(jnp.float32))
+    packed = packed.at[_ROW_CLS, :n].set(cls_ids.astype(jnp.float32))
+    packed = packed.at[_ROW_CLS, n:].set(-2.0)  # padding matches no class
+    packed = packed.at[_ROW_KEEP, :n].set(valid.astype(jnp.float32))
+
+    kernel = functools.partial(
+        _nms_kernel, n_boxes=n, overlap_thresh=float(overlap_thresh),
+        force_suppress=bool(force_suppress))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((_PACK_ROWS, np_), jnp.float32),
+        interpret=_interpret(),
+    )(packed)
+    return out[0, :n] > 0.5

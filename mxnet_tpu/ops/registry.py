@@ -126,8 +126,11 @@ class OpDef:
                 if v and all(isinstance(x, str) for x in v):
                     out[k] = ",".join(v)  # name lists (control-flow ops)
                 else:
-                    # () serializes as "()" so empty shapes/axes round-trip
-                    out[k] = "(" + ", ".join(str(int(x)) for x in v) + ")"
+                    # ints print as ints (shape compat); floats keep their
+                    # value (detection sizes/ratios/variances). () round-trips
+                    out[k] = "(" + ", ".join(
+                        str(int(x)) if float(x).is_integer() else repr(float(x))
+                        for x in v) + ")"
             else:
                 out[k] = str(v)
         return out

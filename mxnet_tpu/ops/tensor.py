@@ -121,10 +121,41 @@ for _n, _f in _UNARY.items():
     _def_unary(_n, _f)
 
 _reg("BlockGrad", lambda attrs, x: lax.stop_gradient(x), aliases=("stop_gradient",))
+def _make_loss(attrs, x):
+    """Identity forward; backward emits grad_scale (optionally normalized)
+    like the reference MakeLossOp (make_loss.cc: grad = grad_scale, divided
+    by batch size for normalization='batch' or by the count of entries
+    above valid_thresh for 'valid')."""
+    scale = attrs.get("grad_scale", 1.0)
+    norm = attrs.get("normalization", "null")
+    valid_thresh = attrs.get("valid_thresh", 0.0)
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, x
+
+    def bwd(res, g):
+        s = jnp.asarray(scale, g.dtype)
+        if norm == "batch":
+            s = s / res.shape[0]
+        elif norm == "valid":
+            s = s / jnp.maximum(
+                jnp.sum((res > valid_thresh).astype(g.dtype)), 1.0)
+        return (g * s,)
+
+    f.defvjp(fwd, bwd)
+    return f(x)
+
+
 _reg(
     "make_loss",
-    lambda attrs, x: x,
-    aliases=("MakeLoss_",),
+    _make_loss,
+    params={"grad_scale": (float, 1.0), "valid_thresh": (float, 0.0),
+            "normalization": (str, "null")},
+    aliases=("MakeLoss_", "MakeLoss"),
 )
 _reg(
     "smooth_l1",

@@ -171,3 +171,17 @@ def cond(pred, then_func, else_func, name=None):
     outs = [res[i] for i in range(n)] if n > 1 else [res]
     outputs, _ = _regroup(outs, out_fmt)
     return outputs
+
+
+def _export_contrib_ops():
+    """Expose every registered _contrib_* symbol op under its short name
+    (reference mx.sym.contrib.MultiBoxPrior etc.)."""
+    from . import symbol as sym_mod
+
+    for flat in dir(sym_mod):
+        if flat.startswith("_contrib_"):
+            globals().setdefault(flat[len("_contrib_"):],
+                                 getattr(sym_mod, flat))
+
+
+_export_contrib_ops()

@@ -1,0 +1,224 @@
+"""Detection op tests (reference tests/python/unittest/test_operator.py
+box_nms cases + example/ssd symbol construction). box_nms runs the
+first-party Pallas suppression kernel (interpret mode on the CPU mesh)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_box_iou():
+    a = nd.array(np.array([[0, 0, 10, 10]], np.float32))
+    b = nd.array(np.array([[5, 5, 15, 15], [0, 0, 10, 10],
+                           [20, 20, 30, 30]], np.float32))
+    iou = mx.nd.contrib.box_iou(a, b)
+    np.testing.assert_allclose(iou.asnumpy(), [[25 / 175, 1.0, 0.0]],
+                               rtol=1e-5)
+
+
+def test_box_iou_center_format():
+    a = nd.array(np.array([[5, 5, 10, 10]], np.float32))  # center 5,5 w10 h10
+    b = nd.array(np.array([[0, 0, 10, 10]], np.float32))
+    iou = mx.nd.contrib.box_iou(a, b, format="center")
+    np.testing.assert_allclose(iou.asnumpy(), [[25 / 175]], rtol=1e-5)
+
+
+def test_box_nms_reference_docstring_example():
+    """The exact example from reference bounding_box.cc:60-75."""
+    data = nd.array(np.array([
+        [0, 0.5, 0.1, 0.1, 0.2, 0.2],
+        [1, 0.4, 0.1, 0.1, 0.2, 0.2],
+        [0, 0.3, 0.1, 0.1, 0.14, 0.14],
+        [2, 0.6, 0.5, 0.5, 0.7, 0.8]], np.float32))
+    out = mx.nd.contrib.box_nms(
+        data, overlap_thresh=0.1, coord_start=2, score_index=1, id_index=0,
+        force_suppress=True)
+    expect = np.array([
+        [2, 0.6, 0.5, 0.5, 0.7, 0.8],
+        [0, 0.5, 0.1, 0.1, 0.2, 0.2],
+        [-1, -1, -1, -1, -1, -1],
+        [-1, -1, -1, -1, -1, -1]], np.float32)
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5)
+
+
+def test_box_nms_per_class():
+    # same boxes, different classes: no cross-class suppression
+    data = nd.array(np.array([
+        [0, 0.9, 0, 0, 1, 1],
+        [1, 0.8, 0, 0, 1, 1],
+        [0, 0.7, 0, 0, 1, 1]], np.float32))
+    out = mx.nd.contrib.box_nms(data, overlap_thresh=0.5, coord_start=2,
+                                score_index=1, id_index=0,
+                                force_suppress=False)
+    o = out.asnumpy()
+    np.testing.assert_allclose(o[0, :2], [0, 0.9])
+    np.testing.assert_allclose(o[1, :2], [1, 0.8])
+    np.testing.assert_allclose(o[2], -1.0)
+
+
+def test_box_nms_batched_and_valid_thresh():
+    d = np.array([[1, 0.6, 0, 0, 1, 1],
+                  [1, 0.05, 2, 2, 3, 3]], np.float32)
+    data = nd.array(np.stack([d, d]))  # (2, N, 6)
+    out = mx.nd.contrib.box_nms(data, overlap_thresh=0.5, valid_thresh=0.1,
+                                coord_start=2, score_index=1, id_index=0)
+    o = out.asnumpy()
+    assert o.shape == (2, 2, 6)
+    for b in range(2):
+        np.testing.assert_allclose(o[b, 0, 1], 0.6)
+        np.testing.assert_allclose(o[b, 1], -1.0)  # below valid_thresh
+
+
+def test_multibox_prior_layout():
+    x = nd.zeros((1, 3, 2, 2))
+    anchors = mx.nd.contrib.MultiBoxPrior(x, sizes=(0.5,), ratios=(1, 2))
+    # anchors per location = num_sizes - 1 + num_ratios = 2
+    assert anchors.shape == (1, 8, 4)
+    a = anchors.asnumpy()[0]
+    np.testing.assert_allclose(a[0], [0, 0, 0.5, 0.5], atol=1e-6)
+    # ratio-2 anchor at the same center is wider than tall
+    w1 = a[1, 2] - a[1, 0]
+    h1 = a[1, 3] - a[1, 1]
+    assert w1 > h1
+    clipped = mx.nd.contrib.MultiBoxPrior(x, sizes=(1.5,), clip=True)
+    assert clipped.asnumpy().min() >= 0 and clipped.asnumpy().max() <= 1
+
+
+def test_multibox_target_matching_and_encoding():
+    anc = mx.nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 4, 4)), sizes=(0.4,))
+    n = anc.shape[1]
+    label = nd.array(np.array([[[1, 0.1, 0.1, 0.5, 0.5],
+                                [-1, 0, 0, 0, 0]]], np.float32))
+    cls_pred = nd.zeros((1, 3, n))
+    loc_t, loc_m, cls_t = mx.nd.contrib.MultiBoxTarget(anc, label, cls_pred)
+    assert loc_t.shape == (1, n * 4)
+    assert loc_m.shape == (1, n * 4)
+    assert cls_t.shape == (1, n)
+    ct = cls_t.asnumpy()[0]
+    assert (ct == 2).sum() >= 1          # class 1 -> target 2
+    assert (ct == 0).sum() > 0           # background anchors
+    lm = loc_m.asnumpy().reshape(n, 4)
+    pos = ct == 2
+    assert np.all(lm[pos] == 1.0) and np.all(lm[~pos] == 0.0)
+    # encoded loc target is finite and zero where unmatched
+    lt = loc_t.asnumpy().reshape(n, 4)
+    assert np.all(np.isfinite(lt))
+    assert np.all(lt[~pos] == 0.0)
+
+
+def test_multibox_target_negative_mining():
+    anc = mx.nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 4, 4)), sizes=(0.4,))
+    n = anc.shape[1]
+    label = nd.array(np.array([[[0, 0.1, 0.1, 0.5, 0.5]]], np.float32))
+    cls_pred = nd.array(np.random.RandomState(0)
+                        .rand(1, 3, n).astype(np.float32))
+    _, _, cls_t = mx.nd.contrib.MultiBoxTarget(
+        anc, label, cls_pred, negative_mining_ratio=3.0,
+        negative_mining_thresh=0.5, ignore_label=-1.0)
+    ct = cls_t.asnumpy()[0]
+    num_pos = (ct == 1).sum()
+    num_neg = (ct == 0).sum()
+    assert num_pos >= 1
+    assert num_neg <= 3 * num_pos        # mined ratio respected
+    assert (ct == -1).sum() > 0          # rest ignored
+
+
+def test_multibox_detection_decode_and_nms():
+    anc = mx.nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 2, 2)), sizes=(0.4,))
+    n = anc.shape[1]
+    probs = np.full((1, 3, n), 0.01, np.float32)
+    probs[0, 1, 0] = 0.9   # class 1 at anchor 0
+    probs[0, 2, 3] = 0.8   # class 2 at anchor 3
+    det = mx.nd.contrib.MultiBoxDetection(
+        nd.array(probs), nd.zeros((1, n * 4)), anc, threshold=0.1)
+    o = det.asnumpy()[0]
+    assert o.shape == (n, 6)
+    np.testing.assert_allclose(o[0, :2], [0, 0.9], rtol=1e-5)   # id 1 -> 0
+    np.testing.assert_allclose(o[1, :2], [1, 0.8], rtol=1e-5)   # id 2 -> 1
+    assert np.all(o[2:] == -1.0)
+    # zero loc_pred decodes to the anchor itself
+    np.testing.assert_allclose(o[0, 2:], anc.asnumpy()[0, 0], rtol=1e-5)
+
+
+def test_roi_align_values_and_gradient():
+    data_np = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    data = nd.array(data_np)
+    rois = nd.array(np.array([[0, 0, 0, 4, 4]], np.float32))
+    out = mx.nd.contrib.ROIAlign(data, rois, pooled_size=(2, 2),
+                                 spatial_scale=1.0, sample_ratio=2)
+    assert out.shape == (1, 1, 2, 2)
+    o = out.asnumpy().reshape(4)
+    assert o[0] < o[1] < o[3]  # monotone in the ramp image
+    # differentiable end-to-end (the reference needs a custom backward)
+    data.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.contrib.ROIAlign(data, rois, pooled_size=(2, 2),
+                                   spatial_scale=1.0, sample_ratio=2)
+        s = mx.nd.sum(y)
+    s.backward()
+    assert float(mx.nd.sum(data.grad).asnumpy()) == pytest.approx(4.0, rel=1e-4)
+
+
+def test_bipartite_matching():
+    dat = nd.array(np.array([[0.5, 0.6], [0.1, 0.2], [0.3, 0.4]], np.float32))
+    row, col = mx.nd.contrib.bipartite_matching(dat, threshold=1e-12)
+    np.testing.assert_allclose(row.asnumpy(), [1, -1, 0])
+    np.testing.assert_allclose(col.asnumpy(), [2, 0])
+    row, col = mx.nd.contrib.bipartite_matching(dat, threshold=0.4)
+    np.testing.assert_allclose(row.asnumpy(), [1, -1, -1])
+    np.testing.assert_allclose(col.asnumpy(), [-1, 0])
+
+
+def test_ssd_multiloss_symbol_one_training_step():
+    """SSD-style multi-loss graph (reference example/ssd
+    symbol/symbol_builder.py:90-112): conv body -> loc + cls heads ->
+    MultiBoxTarget -> smooth_l1 MakeLoss + SoftmaxOutput; builds, binds,
+    runs one forward+backward+update on synthetic data."""
+    num_classes = 3
+    data = mx.sym.var("data")
+    label = mx.sym.var("label")
+    body = mx.sym.Activation(
+        mx.sym.Convolution(data=data, num_filter=8, kernel=(3, 3),
+                           pad=(1, 1), name="body"), act_type="relu")
+    anchors = mx.sym.contrib.MultiBoxPrior(body, sizes=(0.4,), ratios=(1, 2),
+                                           name="anchors")
+    num_anchors_per_loc = 2
+    loc_pred = mx.sym.Flatten(mx.sym.transpose(mx.sym.Convolution(
+        data=body, num_filter=4 * num_anchors_per_loc, kernel=(3, 3),
+        pad=(1, 1), name="loc"), axes=(0, 2, 3, 1)))
+    cls_pred = mx.sym.Reshape(mx.sym.transpose(mx.sym.Convolution(
+        data=body, num_filter=(num_classes + 1) * num_anchors_per_loc,
+        kernel=(3, 3), pad=(1, 1), name="cls"), axes=(0, 2, 3, 1)),
+        shape=(0, -1, num_classes + 1))
+    cls_pred = mx.sym.transpose(cls_pred, axes=(0, 2, 1))
+    loc_t, loc_m, cls_t = mx.sym.contrib.MultiBoxTarget(
+        anchors, label, cls_pred, name="target")
+    loc_loss = mx.sym.MakeLoss(
+        mx.sym.smooth_l1(loc_m * (loc_pred - loc_t), scalar=1.0),
+        name="loc_loss")
+    cls_loss = mx.sym.SoftmaxOutput(data=cls_pred, label=cls_t,
+                                    ignore_label=-1, use_ignore=True,
+                                    multi_output=True, name="cls_prob")
+    net = mx.sym.Group([cls_loss, loc_loss])
+
+    B, H = 2, 4
+    ex = net.simple_bind(mx.cpu(), data=(B, 3, H, H), label=(B, 1, 5))
+    rng = np.random.RandomState(0)
+    ex.arg_dict["data"][:] = rng.rand(B, 3, H, H).astype(np.float32)
+    ex.arg_dict["label"][:] = np.array(
+        [[[1, 0.1, 0.1, 0.6, 0.6]], [[2, 0.3, 0.3, 0.9, 0.9]]], np.float32)
+    for name, arr in ex.arg_dict.items():
+        if name.endswith(("weight",)):
+            arr[:] = (rng.rand(*arr.shape).astype(np.float32) - 0.5) * 0.1
+    outs = ex.forward(is_train=True)
+    assert outs[0].shape[1] == num_classes + 1
+    ex.backward()
+    g = ex.grad_dict["body_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+    # one SGD step on every weight using the gradients
+    for name, arr in ex.arg_dict.items():
+        if name in ex.grad_dict:
+            arr[:] = nd.array(arr.asnumpy()
+                              - 0.01 * ex.grad_dict[name].asnumpy())
+    ex.forward(is_train=True)  # still runs after the update
