@@ -41,14 +41,20 @@ class _TapeNode:
     must not retroactively change this node's producers, otherwise the node
     becomes its own ancestor and gradients are silently dropped."""
 
-    __slots__ = ("vjp_fn", "inputs", "out_shapes", "single", "op_name")
+    __slots__ = ("vjp_fn", "inputs", "out_shapes", "single", "op_name",
+                 "fwd_fn")
 
-    def __init__(self, vjp_fn, inputs, out_shapes, single, op_name=""):
+    def __init__(self, vjp_fn, inputs, out_shapes, single, op_name="",
+                 fwd_fn=None):
         self.vjp_fn = vjp_fn
         self.inputs = [(nd, nd._entry) for nd in inputs]
         self.out_shapes = out_shapes  # [(shape, dtype), ...]
         self.single = single
         self.op_name = op_name
+        # pure jax function over this node's differentiable input datas,
+        # returning the output datas; enables tape REPLAY for higher-order
+        # grad (create_graph=True). None = node not replayable.
+        self.fwd_fn = fwd_fn
 
 
 # ---------------------------------------------------------------------------
@@ -223,14 +229,66 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             leaf._grad._data = g
 
 
+def _build_replay(heads, variables):
+    """Rebuild the recorded subgraph reaching ``heads`` as ONE pure jax
+    function of the variables' datas — the substrate for higher-order
+    autograd (reference autograd.py:270 create_graph; where the reference
+    re-runs its nnvm Gradient pass on the gradient graph, here the replayed
+    forward is differentiated again by jax)."""
+    fwd_order = list(reversed(_topo_nodes(heads)))
+    for node in fwd_order:
+        if node.fwd_fn is None:
+            raise MXNetError(
+                "create_graph=True: node %r is not replayable (custom "
+                "Function / CachedOp nodes do not support higher-order "
+                "grad yet)" % node.op_name)
+    var_ids = {id(v): k for k, v in enumerate(variables)}
+
+    def replay(var_datas):
+        env = {}
+        for node in fwd_order:
+            in_datas = []
+            for inp, entry in node.inputs:
+                # a differentiation VARIABLE cuts the graph even when it has
+                # a producer (grad w.r.t. a recorded intermediate): its
+                # value must come from var_datas, or the vjp would see the
+                # recomputed — variable-independent — value and silently
+                # return zeros
+                if id(inp) in var_ids:
+                    in_datas.append(var_datas[var_ids[id(inp)]])
+                elif entry is not None:
+                    in_datas.append(env[(id(entry[0]), entry[1])])
+                else:
+                    in_datas.append(inp._data)
+            outs = node.fwd_fn(*in_datas)
+            outs_t = (outs,) if node.single else tuple(outs)
+            for i, o in enumerate(outs_t):
+                env[(id(node), i)] = o
+        head_vals = []
+        for h in heads:
+            if id(h) in var_ids:
+                head_vals.append(var_datas[var_ids[id(h)]])
+            elif h._entry is not None:
+                n, i = h._entry
+                head_vals.append(env[(id(n), i)])
+            else:
+                head_vals.append(h._data)
+        return head_vals
+
+    return replay
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
     """Functional gradient API (reference autograd.py:270). Returns grads of
     `heads` w.r.t. `variables` without touching .grad buffers.
 
-    create_graph (higher-order) is supported by replaying vjp closures, which
-    are themselves differentiable jax functions — not yet wired; round 2.
-    """
+    With ``create_graph=True`` the recorded subgraph is replayed as a pure
+    jax function, its vjp evaluated to produce the grads, and that whole
+    gradient computation is taped as one node — so the returned grads are
+    themselves differentiable (higher-order autograd)."""
+    import jax
+
     from .ndarray.ndarray import NDArray
 
     if isinstance(heads, NDArray):
@@ -238,7 +296,39 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     if isinstance(variables, NDArray):
         variables = [variables]
     if create_graph:
-        raise MXNetError("create_graph=True not supported yet")
+        replay = _build_replay(heads, variables)
+        if head_grads is None:
+            hgs = [jnp.ones_like(h._data) for h in heads]
+        else:
+            hgs = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                   for g in (head_grads if isinstance(head_grads, (list, tuple))
+                             else [head_grads])]
+
+        def grad_fn(var_datas):
+            outs, vjp_fn = jax.vjp(replay, var_datas)
+            (gvars,) = vjp_fn(hgs)
+            return tuple(gvars)
+
+        var_datas = [v._data for v in variables]
+        g_vals, g_vjp = jax.vjp(grad_fn, var_datas)
+        node = _TapeNode(
+            vjp_fn=lambda cts: g_vjp(cts if isinstance(cts, tuple)
+                                     else (cts,))[0],
+            inputs=list(variables),
+            out_shapes=[(g.shape, g.dtype) for g in g_vals],
+            single=False,
+            op_name="_grad_graph",
+            # grad_fn is itself pure jax, so this node replays — grads of
+            # grads of grads compose to arbitrary order
+            fwd_fn=lambda *vd: grad_fn(list(vd)),
+        )
+        outs = []
+        for idx, g in enumerate(g_vals):
+            o = NDArray(g, variables[idx % len(variables)]._ctx)
+            if is_recording():
+                o._entry = (node, idx)
+            outs.append(o)
+        return outs
 
     # temporarily swap out grad buffers, run backward in 'add' mode
     saved = [(v._grad, v._grad_req, v._marked) for v in variables]

@@ -16,6 +16,7 @@ import jax
 import numpy as np
 
 from . import _fused, _global
+from . import profiler as _profiler
 from .base import MXNetError
 from .context import Context, current_context
 from .ndarray.ndarray import NDArray
@@ -31,9 +32,15 @@ class Executor(object):
     """
 
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None):
+                 aux_states=None, group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else current_context()
+        # group2ctx: manual model-parallel placement — ctx_group attrs map
+        # onto jax devices as in-graph placement constraints (the reference
+        # partitions the graph with _CrossDeviceCopy nodes,
+        # graph_executor.cc:1577; XLA inserts the transfers here)
+        self._group2dev = {g: (c.jax_device() if isinstance(c, Context) else c)
+                           for g, c in group2ctx.items()} if group2ctx else None
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
         self.output_names = symbol.list_outputs()
@@ -90,7 +97,8 @@ class Executor(object):
                 vm = dict(arg_vals)
                 vm.update(aux_vals)
                 aux_updates = {}
-                outs = sym.eval_jax(vm, aux_updates=aux_updates)
+                outs = sym.eval_jax(vm, aux_updates=aux_updates,
+                                    group2dev=self._group2dev)
             finally:
                 _global.pop_rng_key()
                 _global.set_train(prev)
@@ -120,7 +128,8 @@ class Executor(object):
                 vm = dict(arg_vals)
                 vm.update(aux_vals)
                 aux_updates = {}
-                outs = sym.eval_jax(vm, aux_updates=aux_updates)
+                outs = sym.eval_jax(vm, aux_updates=aux_updates,
+                                    group2dev=self._group2dev)
             finally:
                 _global.pop_rng_key()
                 _global.set_train(prev)
@@ -153,6 +162,8 @@ class Executor(object):
         self._fwd_cache[key] = pair
         return pair
 
+    @_profiler.profiled(
+        "executor", lambda self, *a, **kw: "forward(%s)" % self._symbol.name)
     def forward(self, is_train=False, **kwargs):
         """Run forward (reference executor.py:114). kwargs update arg data."""
         for name, val in kwargs.items():
@@ -195,6 +206,8 @@ class Executor(object):
                 self._monitor_callback(name, out)
         return self.outputs
 
+    @_profiler.profiled(
+        "executor", lambda self, *a, **kw: "backward(%s)" % self._symbol.name)
     def backward(self, out_grads=None, is_train=True):
         """Run backward (reference executor.py:155); accumulates into
         grad_arrays honoring per-arg grad_req write/add."""

@@ -73,9 +73,19 @@ class SyncBatchNorm(BatchNorm):
     """Cross-device synchronized BatchNorm (reference
     basic_layers.py:SyncBatchNorm → src/operator/contrib/sync_batch_norm-inl.h).
 
-    On this stack, cross-device statistics come for free when the batch axis
-    is sharded over a mesh: jnp.mean under shard_map/pjit emits an ICI psum.
-    Single-device behavior equals BatchNorm.
+    On this stack cross-device statistics come from SHARDING, not from an
+    explicit communicator: inside a ``parallel.TrainStep`` the batch axis is
+    sharded over the mesh, so the batch-mean/var reductions are global and
+    XLA emits the ICI psum — verified against hand-computed global-batch
+    statistics by ``tests/test_parallel.py::
+    test_trainstep_batchnorm_is_sync_across_devices``. Single-device
+    behavior equals BatchNorm.
+
+    Limitation (documented semantics, not a silent claim): in the EAGER
+    per-device data-parallel pattern (``split_and_load`` + one forward per
+    context) each forward sees only its slice, so statistics are per-device
+    like plain BatchNorm — the reference's eager communicator has no eager
+    counterpart here; use the sharded TrainStep path for synced BN.
     """
 
     def __init__(self, in_channels=0, num_devices=None, momentum=0.9,

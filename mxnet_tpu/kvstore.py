@@ -231,6 +231,35 @@ class KVStoreTPU(KVStore):
         self.type = kv_type
         self._is_async = "async" in kv_type
 
+    def push(self, key, value, priority=0):
+        """dist_async semantics (reference kvstore_dist_server.h:346-351
+        else-branch): with a server-side updater, each gradient copy is
+        applied IMMEDIATELY and independently — no aggregation barrier —
+        so N per-device copies produce N sequential optimizer steps like N
+        async workers hitting the PS. Single-process scope only: true
+        multi-host async needs a parameter-server service the jax runtime
+        does not provide (weights here live per-process, not on servers),
+        so multi-process async is rejected rather than silently diverging.
+        Sync mode (and the no-updater path) reduces first like the base
+        store."""
+        if not (self._is_async and self._updater is not None):
+            return super().push(key, value, priority)
+        if jax.process_count() > 1:
+            raise MXNetError(
+                "dist_async with a server-side updater is single-process "
+                "only on this runtime; use dist_sync for multi-host "
+                "training (fused allreduce over ICI/DCN)")
+        for k, v in _key_value_pairs(key, value):
+            if k not in self._store:
+                raise MXNetError("key %s has not been initialized" % k)
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            for x in vals:
+                g = self._to_store_sharding(x._data, self._store[k]._data)
+                if self._compression is not None:
+                    g = self._compression.compress(k, g)
+                self._updater(int(k) if k.isdigit() else k,
+                              NDArray(g, x.context), self._store[k])
+
     @property
     def rank(self):
         return jax.process_index()

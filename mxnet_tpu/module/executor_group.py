@@ -39,8 +39,13 @@ class DataParallelExecutorGroup(object):
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad, shared_group=None,
                  logger=None, fixed_param_names=None, grad_req="write",
-                 state_names=None):
+                 state_names=None, group2ctxs=None):
         self.symbol = symbol
+        # per-device model-parallel placement maps (reference
+        # executor_group.py group2ctxs -> graph_executor.cc:1577)
+        if isinstance(group2ctxs, dict) or group2ctxs is None:
+            group2ctxs = [group2ctxs] * len(contexts)
+        self.group2ctxs = group2ctxs
         self.contexts = contexts
         self.workload = workload if workload else [1] * len(contexts)
         self.param_names = param_names
@@ -80,7 +85,8 @@ class DataParallelExecutorGroup(object):
             dev_shapes = {
                 n: (dev_n,) + tuple(s[1:]) for n, s in all_shapes.items()}
             exec_ = self.symbol.simple_bind(
-                ctx, grad_req=self._grad_req, **dev_shapes)
+                ctx, grad_req=self._grad_req,
+                group2ctx=self.group2ctxs[i], **dev_shapes)
             self.execs.append(exec_)
         self.data_arrays = [
             [(self.slices[i], e.arg_dict[name]) for i, e in enumerate(self.execs)]

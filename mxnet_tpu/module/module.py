@@ -33,6 +33,7 @@ class Module(BaseModule):
             context = [context]
         self._context = context
         self._work_load_list = work_load_list
+        self._group2ctxs = group2ctxs
 
         self._symbol = symbol
         data_names = list(data_names) if data_names is not None else []
@@ -208,7 +209,7 @@ class Module(BaseModule):
             self._symbol, self._context, self._work_load_list, data_shapes,
             label_shapes, self._param_names, for_training, inputs_need_grad,
             fixed_param_names=self._fixed_param_names, grad_req=grad_req,
-            state_names=self._state_names)
+            state_names=self._state_names, group2ctxs=self._group2ctxs)
         self.binded = True
 
         if self.params_initialized:

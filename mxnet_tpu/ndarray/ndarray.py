@@ -476,6 +476,10 @@ class NDArray:
 # ---------------------------------------------------------------------------
 
 
+from .. import profiler as _profiler
+
+
+@_profiler.profiled("operator", lambda op_name, *i, **kw: op_name)
 def invoke(op_name: str, *inputs, out=None, **kwargs):
     """Eager op invocation — counterpart of the reference's
     `MXImperativeInvokeEx` → `Imperative::Invoke` path
@@ -537,6 +541,7 @@ def invoke(op_name: str, *inputs, out=None, **kwargs):
             out_shapes=[(o.shape, o.dtype) for o in outs_t],
             single=single,
             op_name=op_name,
+            fwd_fn=fn,
         )
         for idx, nd in enumerate(nd_outs):
             nd._entry = (node, idx)

@@ -1,0 +1,317 @@
+"""Profiler: chrome-trace events + aggregate per-op tables + XLA traces.
+
+Reference ``src/profiler/profiler.{h,cc}`` (chrome://tracing JSON emitter,
+profiler.h:87,437; aggregate tables aggregate_stats.cc) and the Python API
+``python/mxnet/profiler.py:33-198`` (set_config/set_state/dump/dumps,
+pause/resume, Domain/Task/Frame/Counter/Marker).
+
+TPU-native design: the engine-level per-op hooks of the reference map onto
+two sources here —
+* framework events (eager op invocations, executor forward/backward,
+  user Tasks/Frames/Counters/Markers) are timestamped into an in-process
+  buffer and emitted as chrome://tracing JSON by :func:`dump`, with
+  aggregate min/max/avg tables from :func:`dumps`;
+* the XLA device timeline comes from ``jax.profiler`` — when
+  ``profile_all``/``profile_symbolic`` is set, ``set_state('run')`` also
+  starts a jax trace into ``<filename>.jaxtrace/`` viewable in
+  TensorBoard/XProf (the XPlane counterpart of the reference's per-device
+  engine lanes).
+
+Eager per-op timing wraps dispatch only (XLA execution is async); the
+compiled-path device truth lives in the jax trace. That split mirrors the
+reference, where engine op events measure scheduling while kernel lanes
+come from the device tracer.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .base import MXNetError
+
+__all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
+           "profiler_set_config", "profiler_set_state",
+           "Domain", "Task", "Frame", "Counter", "Marker"]
+
+_lock = threading.Lock()
+_config: Dict[str, Any] = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": True,
+    "aggregate_stats": False,
+}
+_events: List[Dict[str, Any]] = []
+_agg: Dict[str, List[float]] = {}
+_state = "stop"
+_paused = False
+_jax_trace_active = False
+
+# fast-path flag read by the eager dispatch hook; avoids any work when off
+ENABLED = False
+
+
+def _now_us() -> float:
+    return time.perf_counter() * 1e6
+
+
+def set_config(**kwargs):
+    """Configure the profiler (reference profiler.py:33 set_config /
+    MXSetProcessProfilerConfig). Unknown keys are rejected."""
+    for k, v in kwargs.items():
+        if k not in _config and k not in ("profile_process",):
+            raise MXNetError("profiler.set_config: unknown option %r" % k)
+        if k != "profile_process":
+            _config[k] = v
+
+
+def set_state(state="stop", profile_process="worker"):
+    """Start/stop profiling (reference profiler.py set_state)."""
+    global _state, ENABLED, _jax_trace_active
+    if state not in ("run", "stop"):
+        raise MXNetError("profiler state must be 'run' or 'stop'")
+    with _lock:
+        if state == "run" and _state != "run":
+            _state = "run"
+            ENABLED = not _paused
+            if _config["profile_all"] or _config["profile_symbolic"]:
+                try:
+                    import jax
+
+                    jax.profiler.start_trace(_config["filename"] + ".jaxtrace")
+                    _jax_trace_active = True
+                except Exception:
+                    _jax_trace_active = False  # backend without profiler
+        elif state == "stop" and _state == "run":
+            _state = "stop"
+            ENABLED = False
+            _stop_jax_trace()
+
+
+def _stop_jax_trace():
+    global _jax_trace_active
+    if _jax_trace_active:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _jax_trace_active = False
+
+
+def pause(profile_process="worker"):
+    """Suspend event collection without ending the session (reference
+    profiler.py pause)."""
+    global _paused, ENABLED
+    _paused = True
+    ENABLED = False
+
+
+def resume(profile_process="worker"):
+    global _paused, ENABLED
+    _paused = False
+    ENABLED = _state == "run"
+
+
+def record_event(name: str, category: str, start_us: float, dur_us: float):
+    """Append one complete ('ph: X') event; aggregates ride along."""
+    if not ENABLED:
+        return
+    with _lock:
+        _events.append({"name": name, "cat": category, "ph": "X",
+                        "ts": start_us, "dur": dur_us, "pid": os.getpid(),
+                        "tid": threading.get_ident() % 100000})
+        _agg.setdefault("%s::%s" % (category, name), []).append(dur_us)
+
+
+class _timed:
+    """Context manager timing a region into the event buffer."""
+
+    def __init__(self, name, category):
+        self.name, self.category = name, category
+
+    def __enter__(self):
+        self.t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        record_event(self.name, self.category, self.t0, _now_us() - self.t0)
+
+
+def timed_op(name):
+    """Hook used by the eager dispatch path (category 'operator')."""
+    return _timed(name, "operator")
+
+
+def timed_exec(name):
+    """Hook used by executor forward/backward (category 'executor')."""
+    return _timed(name, "executor")
+
+
+def profiled(category, label):
+    """Decorator instrumenting a function as a profiler region. ``label``
+    is either a string or a callable over the wrapped function's arguments
+    (e.g. the op name). Zero work when profiling is off."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not ENABLED:
+                return fn(*args, **kwargs)
+            lbl = label(*args, **kwargs) if callable(label) else label
+            with _timed(lbl, category):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write collected events as chrome://tracing JSON to the configured
+    filename (reference profiler.py dump → profiler.h:437 emitter)."""
+    if finished:
+        set_state("stop")
+    with _lock:
+        doc = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+        with open(_config["filename"], "w") as f:
+            json.dump(doc, f)
+
+
+def dumps(reset=False):
+    """Aggregate per-op summary table string (reference profiler.py dumps →
+    aggregate_stats.cc), sorted by total time."""
+    with _lock:
+        rows = []
+        for key, durs in _agg.items():
+            rows.append((sum(durs), key, len(durs), min(durs), max(durs)))
+        rows.sort(reverse=True)
+        lines = ["%-40s %8s %12s %12s %12s %12s" %
+                 ("Name", "Calls", "Total(ms)", "Min(ms)", "Max(ms)",
+                  "Avg(ms)")]
+        for total, key, n, mn, mx in rows:
+            lines.append("%-40s %8d %12.3f %12.3f %12.3f %12.3f" %
+                         (key[:40], n, total / 1e3, mn / 1e3, mx / 1e3,
+                          total / n / 1e3))
+        if reset:
+            _agg.clear()
+        return "\n".join(lines)
+
+
+# legacy aliases kept by the reference module
+profiler_set_config = set_config
+profiler_set_state = set_state
+
+
+# ---------------------------------------------------------------------------
+# user-defined profiling objects (reference profiler.py:198-)
+# ---------------------------------------------------------------------------
+
+
+class Domain:
+    """Grouping namespace for user events (reference profiler.py Domain)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+    def __str__(self):
+        return self.name
+
+
+class _Span:
+    """start()/stop() duration event (Task and Frame semantics)."""
+
+    _category = "task"
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._t0: Optional[float] = None
+
+    def start(self):
+        self._t0 = _now_us()
+
+    def stop(self):
+        if self._t0 is None:
+            raise MXNetError("%s %r stopped before start"
+                             % (type(self).__name__, self.name))
+        record_event("%s::%s" % (self.domain, self.name), self._category,
+                     self._t0, _now_us() - self._t0)
+        self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Task(_Span):
+    _category = "task"
+
+
+class Frame(_Span):
+    _category = "frame"
+
+
+class Counter:
+    """Numeric counter emitting 'C' events (reference profiler.py Counter)."""
+
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        if ENABLED:
+            with _lock:
+                _events.append({
+                    "name": "%s::%s" % (self.domain, self.name),
+                    "cat": "counter", "ph": "C", "ts": _now_us(),
+                    "pid": os.getpid(),
+                    "args": {self.name: value}})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+
+class Marker:
+    """Instant event (reference profiler.py Marker)."""
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        if ENABLED:
+            with _lock:
+                _events.append({
+                    "name": "%s::%s" % (self.domain, self.name),
+                    "cat": "marker", "ph": "i", "ts": _now_us(),
+                    "pid": os.getpid(), "s": scope[0]})

@@ -403,19 +403,40 @@ class Symbol(object):
     # evaluation / binding
     # ------------------------------------------------------------------
     def eval_jax(self, value_map: Dict[str, Any], is_train=False,
-                 aux_updates: Optional[Dict[str, Any]] = None):
+                 aux_updates: Optional[Dict[str, Any]] = None,
+                 group2dev: Optional[Dict[str, Any]] = None):
         """Evaluate outputs as jax arrays given name→jax value bindings.
         Traced under jit by the Executor. When ``aux_updates`` is a dict, BN
         moving-stat updates (reference FMutateInputs semantics) are recorded
-        into it keyed by the aux variable name."""
+        into it keyed by the aux variable name. ``group2dev`` maps
+        ``ctx_group`` attribute values to jax devices: node outputs in a
+        mapped group get a device-placement constraint, the XLA counterpart
+        of the reference's group2ctx graph partitioning with automatic
+        _CrossDeviceCopy nodes (graph_executor.cc:1577)."""
+        import jax as _jax
+
         from . import _global
+
+        def _place(node, value, is_var):
+            if not group2dev:
+                return value
+            if is_var:
+                grp = node._extra_attrs.get("ctx_group")
+            else:
+                # op nodes carry ctx_group either as an op kwarg (attrs) or
+                # via Symbol._set_attr (_extra_attrs) — honor both, like
+                # attr_dict()
+                grp = node.attrs.get("ctx_group") or \
+                    getattr(node, "_extra_attrs", {}).get("ctx_group")
+            dev = group2dev.get(grp) if grp else None
+            return _jax.device_put(value, dev) if dev is not None else value
 
         vals: Dict[Tuple[int, int], Any] = {}
         for node in self._topo_nodes():
             if node.is_var():
                 if node.name not in value_map:
                     raise MXNetError("eval: missing binding for %r" % node.name)
-                vals[(id(node), 0)] = value_map[node.name]
+                vals[(id(node), 0)] = _place(node, value_map[node.name], True)
                 continue
             opdef = get_op(node.op)
             attrs = opdef.parse_attrs(node.attrs)
@@ -423,7 +444,7 @@ class Symbol(object):
             out = opdef.fcompute(attrs, *inputs)
             outs = out if isinstance(out, (tuple, list)) else (out,)
             for i, o in enumerate(outs):
-                vals[(id(node), i)] = o
+                vals[(id(node), i)] = _place(node, o, False)
             if (aux_updates is not None and node.op == "BatchNorm"
                     and _global.is_train() and not attrs.get("use_global_stats")):
                 m = attrs.get("momentum", 0.9)
@@ -456,7 +477,8 @@ class Symbol(object):
              group2ctx=None, shared_exec=None):
         from .executor import Executor
 
-        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx)
 
     def simple_bind(self, ctx, grad_req="write", type_dict=None, stype_dict=None,
                     group2ctx=None, shared_arg_names=None, shared_exec=None,
@@ -484,7 +506,7 @@ class Symbol(object):
         for name, shape in zip(aux_names, aux_shapes):
             aux_states[name] = nd_mod.zeros(shape, ctx=ctx)
         return Executor(self, ctx, args, args_grad if grad_req != "null" else None,
-                        grad_req, aux_states)
+                        grad_req, aux_states, group2ctx=group2ctx)
 
     # -- gradient graph (reference nnvm Gradient pass) ----------------------
     def grad(self, wrt):

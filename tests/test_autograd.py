@@ -3,7 +3,7 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu import autograd
+from mxnet_tpu import autograd, nd
 from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
 
 
@@ -176,3 +176,41 @@ def test_inplace_on_leaf_inside_record():
         y = (x * 2).sum()
     y.backward()
     assert_almost_equal(x.grad, [2.0, 2.0])
+
+
+def test_grad_create_graph_second_order():
+    """Higher-order autograd (reference autograd.py:270 create_graph):
+    d2/dx2 sum(x^3) = 6x via grad-then-backward."""
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        dy = autograd.grad(y, [x], create_graph=True, retain_graph=True)[0]
+        np.testing.assert_allclose(dy.asnumpy(), 3 * np.array([1, 4, 9]),
+                                   rtol=1e-5)
+        z = nd.sum(dy)
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6 * np.array([1, 2, 3]),
+                               rtol=1e-5)
+
+
+def test_grad_of_grad_functional():
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sum(x * x * x * x)
+        g1 = autograd.grad(y, [x], create_graph=True, retain_graph=True)[0]
+        g1s = nd.sum(g1)
+    g2 = autograd.grad(g1s, [x])[0]
+    np.testing.assert_allclose(g2.asnumpy(), 12 * np.array([1, 4, 9]),
+                               rtol=1e-5)
+
+
+def test_grad_create_graph_with_head_grads():
+    x = nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        g = autograd.grad(y, [x], head_grads=nd.array(np.array([3.0])),
+                          create_graph=True, retain_graph=True)[0]
+    np.testing.assert_allclose(g.asnumpy(), [12.0], rtol=1e-5)  # 3 * 2x

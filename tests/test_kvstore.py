@@ -195,3 +195,32 @@ def test_optimizer_state_save_load(tmp_path):
     kv.pull("w", out=o1)
     kv2.pull("w", out=o2)
     np.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(), rtol=1e-6)
+
+
+def test_dist_async_applies_updates_per_copy():
+    """dist_async: with a server-side updater each gradient copy applies
+    immediately and independently (reference kvstore_dist_server.h:346-351
+    else-branch) — N copies = N sequential optimizer steps, unlike sync
+    mode's single aggregated step."""
+    def build(kv_type):
+        kv = mx.kv.create(kv_type)
+        kv.init("w", nd.ones(SHAPE))
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+        return kv
+
+    grads = _per_device_copies([np.ones(SHAPE)] * N)
+    sync, async_ = build("dist_sync"), build("dist_async")
+    sync.push("w", grads)
+    async_.push("w", grads)
+    o_sync, o_async = nd.zeros(SHAPE), nd.zeros(SHAPE)
+    sync.pull("w", out=o_sync)
+    async_.pull("w", out=o_async)
+    # sync: one step with summed grad: m=-0.1*N, w=1-0.1*N
+    np.testing.assert_allclose(o_sync.asnumpy(), 1.0 - 0.1 * N, rtol=1e-5)
+    # async: N momentum steps with grad 1 each
+    w, m = 1.0, 0.0
+    for _ in range(N):
+        m = 0.9 * m - 0.1 * 1.0
+        w = w + m
+    np.testing.assert_allclose(o_async.asnumpy(), w, rtol=1e-5)
+    assert not np.allclose(o_sync.asnumpy(), o_async.asnumpy())

@@ -1,0 +1,117 @@
+"""Profiler tests (reference tests/python/unittest/test_profiler.py):
+chrome-trace dump, aggregate tables, pause/resume, user objects."""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+@pytest.fixture(autouse=True)
+def _reset_profiler(tmp_path):
+    mx.profiler.set_config(filename=str(tmp_path / "profile.json"),
+                           aggregate_stats=True, profile_symbolic=False,
+                           profile_all=False)
+    yield
+    mx.profiler.set_state("stop")
+    mx.profiler._events.clear()
+    mx.profiler._agg.clear()
+
+
+def test_eager_ops_recorded_and_dumped(tmp_path):
+    mx.profiler.set_state("run")
+    a = nd.ones((8, 8))
+    b = nd.dot(a, a)
+    (b + 1).asnumpy()
+    mx.profiler.set_state("stop")
+    fname = str(tmp_path / "profile.json")
+    mx.profiler.set_config(filename=fname)
+    mx.profiler.dump()
+    doc = json.load(open(fname))
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "dot" in names
+    assert all(e["ph"] in ("X", "C", "i") for e in doc["traceEvents"])
+
+
+def test_executor_events_and_aggregate_table():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(2, 3))
+    ex.arg_dict["data"][:] = np.ones((2, 3), np.float32)
+    mx.profiler.set_state("run")
+    ex.forward(is_train=True)
+    ex.backward()
+    mx.profiler.set_state("stop")
+    table = mx.profiler.dumps()
+    assert "executor::forward" in table
+    assert "executor::backward" in table
+    assert "Calls" in table and "Avg(ms)" in table
+
+
+def test_pause_resume():
+    x = nd.ones((2, 2))
+    mx.profiler.set_state("run")
+    mx.profiler.pause()
+    nd.relu(x).asnumpy()
+    assert not any("relu" in k for k in mx.profiler._agg)
+    mx.profiler.resume()
+    nd.relu(x).asnumpy()
+    assert any("relu" in k for k in mx.profiler._agg)
+
+
+def test_profiler_off_means_no_events():
+    nd.ones((2, 2)).asnumpy()
+    assert not mx.profiler._events
+
+
+def test_user_objects():
+    mx.profiler.set_state("run")
+    dom = mx.profiler.Domain("app")
+    with dom.new_task("work"):
+        pass
+    frame = dom.new_frame("frame0")
+    frame.start()
+    frame.stop()
+    counter = dom.new_counter("ctr", 5)
+    counter.increment(2)
+    dom.new_marker("here").mark()
+    mx.profiler.set_state("stop")
+    cats = [e["cat"] for e in mx.profiler._events]
+    assert "task" in cats and "frame" in cats
+    assert "counter" in cats and "marker" in cats
+    with pytest.raises(mx.MXNetError):
+        dom.new_task("bad").stop()
+
+
+def test_set_config_rejects_unknown():
+    with pytest.raises(mx.MXNetError):
+        mx.profiler.set_config(bogus=True)
+
+
+def test_resnet_step_trace(tmp_path):
+    """Trace + summary from a (small) model-zoo ResNet step — the VERDICT
+    round-3 acceptance for the profiler MVP."""
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1(classes=10)
+    net.initialize()
+    step = parallel.TrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        parallel.device_mesh(1),
+        optimizer_params={"learning_rate": 0.1})
+    x = nd.array(np.random.rand(2, 3, 32, 32).astype(np.float32))
+    y = nd.array(np.zeros(2, np.float32))
+    step(x, y)  # compile outside the profiled region
+    mx.profiler.set_state("run")
+    with mx.profiler.Domain("train").new_task("step"):
+        step(x, y).wait_to_read()
+    mx.profiler.set_state("stop")
+    fname = str(tmp_path / "rn.json")
+    mx.profiler.set_config(filename=fname)
+    mx.profiler.dump()
+    doc = json.load(open(fname))
+    assert any(e["name"] == "train::step" for e in doc["traceEvents"])
+    assert "train::step" in mx.profiler.dumps()
