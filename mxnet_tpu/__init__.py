@@ -32,6 +32,7 @@ import importlib as _importlib
 
 for _m in (
     "engine",
+    "operator",
     "initializer",
     "optimizer",
     "lr_scheduler",
@@ -50,6 +51,7 @@ for _m in (
     "visualization",
     "image",
     "parallel",
+    "contrib",
     "test_utils",
     "util",
 ):

@@ -235,14 +235,36 @@ def _build_replay(heads, variables):
     autograd (reference autograd.py:270 create_graph; where the reference
     re-runs its nnvm Gradient pass on the gradient graph, here the replayed
     forward is differentiated again by jax)."""
-    fwd_order = list(reversed(_topo_nodes(heads)))
+    var_ids = {id(v): k for k, v in enumerate(variables)}
+
+    # topo order of the nodes BETWEEN variables and heads only: traversal
+    # cuts at differentiation variables, so producers upstream of a
+    # variable neither need to be replayable nor get re-executed inside
+    # every higher-order vjp
+    visited = set()
+    order = []
+    stack = [(h._entry[0], False) for h in heads
+             if h._entry is not None and id(h) not in var_ids]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for inp, entry in node.inputs:
+            if entry is not None and id(inp) not in var_ids \
+                    and id(entry[0]) not in visited:
+                stack.append((entry[0], False))
+    fwd_order = order  # post-order DFS = inputs before consumers
     for node in fwd_order:
         if node.fwd_fn is None:
             raise MXNetError(
-                "create_graph=True: node %r is not replayable (custom "
-                "Function / CachedOp nodes do not support higher-order "
-                "grad yet)" % node.op_name)
-    var_ids = {id(v): k for k, v in enumerate(variables)}
+                "create_graph=True: node %r between the variables and the "
+                "heads is not replayable (custom Function / CachedOp nodes "
+                "do not support higher-order grad yet)" % node.op_name)
 
     def replay(var_datas):
         env = {}

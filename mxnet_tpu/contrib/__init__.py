@@ -1,0 +1,2 @@
+"""Experimental / contrib packages (reference ``python/mxnet/contrib/``)."""
+from . import quantization  # noqa: F401

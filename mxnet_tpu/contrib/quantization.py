@@ -1,0 +1,202 @@
+"""INT8 model quantization driver (reference
+``python/mxnet/contrib/quantization.py`` + the graph pass
+``src/operator/quantization/quantize_graph_pass.cc``).
+
+``quantize_model`` rewrites a float Symbol so every quantizable layer
+(FullyConnected / Convolution) runs as int8 x int8 -> int32 on the MXU:
+
+    data -> quantize -> quantized_op -> requantize -> dequantize -> ...
+
+Weights/biases are quantized OFFLINE into the returned arg dict (their
+ranges embedded as constants); activations use either in-graph dynamic
+min/max (``calib_mode='none'``) or ranges collected from calibration
+batches (``calib_mode='naive'``, baked into quantize consts and the
+requantize calib attrs — the reference's entropy mode reduces to better
+thresholds for the same plumbing and is accepted as an alias of naive
+here).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["quantize_model"]
+
+QUANTIZABLE = {"FullyConnected", "Convolution"}
+INT8_RANGE = 127.0
+
+
+def _quantize_params_int8(arr):
+    """Offline symmetric int8 quantization of a weight/bias array; returns
+    (int8 ndarray, real_range)."""
+    from .. import ndarray as nd_mod
+
+    a = np.asarray(arr.asnumpy() if hasattr(arr, "asnumpy") else arr)
+    r = float(max(abs(a.min()), abs(a.max()), 1e-30))
+    q = np.clip(np.round(a * (INT8_RANGE / r)), -127, 127).astype(np.int8)
+    return nd_mod.array(q, dtype="int8"), r
+
+
+def _collect_thresholds(sym, arg_params, aux_params, calib_data,
+                        collect_names, num_calib_examples, ctx):
+    """Run calibration batches through the FLOAT graph and record min/max
+    of every tensor in ``collect_names`` (reference _LayerOutputCollector /
+    calib_mode='naive')."""
+    from .. import symbol as sym_mod
+
+    internals = sym.get_internals()
+    out_names = internals.list_outputs()
+    wanted = [n for n in collect_names if n in out_names]
+    group = sym_mod.Group([internals[n] for n in wanted])
+
+    stats: Dict[str, List[float]] = {n: [np.inf, -np.inf] for n in wanted}
+    seen = 0
+    executors = {}  # bind once per input shape (a rebind per batch would
+    #                 recompile the whole float graph every iteration)
+    calib_data.reset()
+    for batch in calib_data:
+        shape = tuple(batch.data[0].shape)
+        ex = executors.get(shape)
+        if ex is None:
+            ex = group.simple_bind(ctx, grad_req="null", data=shape)
+            for name, arr in ex.arg_dict.items():
+                if name in arg_params:
+                    arr._data = arg_params[name]._data
+            for name, arr in ex.aux_dict.items():
+                if name in aux_params:
+                    arr._data = aux_params[name]._data
+            executors[shape] = ex
+        ex.arg_dict["data"]._data = batch.data[0]._data
+        outs = ex.forward(is_train=False)
+        for name, o in zip(wanted, outs):
+            a = o.asnumpy()
+            stats[name][0] = min(stats[name][0], float(a.min()))
+            stats[name][1] = max(stats[name][1], float(a.max()))
+        seen += batch.data[0].shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    return {n: (mn, mx) for n, (mn, mx) in stats.items()}
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   excluded_sym_names=None, calib_mode="none",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", ctx=None, logger=None):
+    """Quantize a float model (reference contrib/quantization.py:
+    quantize_model). Returns (quantized Symbol, quantized arg_params,
+    aux_params)."""
+    from .. import symbol as sym_mod
+    from ..context import cpu
+    from ..symbol import Symbol, _invoke
+
+    if quantized_dtype != "int8":
+        raise MXNetError("only quantized_dtype='int8' is supported "
+                         "(symmetric int8 feeds the MXU)")
+    if calib_mode in ("naive", "entropy"):
+        if calib_data is None:
+            raise MXNetError("calib_mode=%r requires calib_data" % calib_mode)
+    elif calib_mode != "none":
+        raise MXNetError("unknown calib_mode %r" % calib_mode)
+    excluded = set(excluded_sym_names or [])
+    ctx = ctx or cpu()
+
+    nodes = sym._topo_nodes()
+    targets = [n for n in nodes
+               if n.op in QUANTIZABLE and n.name not in excluded]
+
+    thresholds = {}
+    if calib_mode in ("naive", "entropy") and targets:
+        collect = []
+        for node in targets:
+            src, idx = node.inputs[0]
+            collect.append(src.name if src.is_var()
+                           else "%s_output" % src.name)
+            collect.append("%s_output" % node.name)
+        thresholds = _collect_thresholds(
+            sym, arg_params, aux_params, calib_data, set(collect),
+            num_calib_examples, ctx)
+
+    qarg_params = dict(arg_params)
+    new_syms: Dict[int, Symbol] = {}
+
+    def mapped(entry):
+        node, idx = entry
+        s = new_syms[id(node)]
+        return s[idx] if len(s._outputs) > 1 else s
+
+    def const(value, name):
+        return _invoke("_full", [], {"shape": (1,), "value": float(value)},
+                       name=name)
+
+    for node in nodes:
+        if node.is_var():
+            v = sym_mod.var(node.name)
+            v._outputs[0][0]._extra_attrs.update(node._extra_attrs)
+            new_syms[id(node)] = v
+            continue
+        ins = [mapped(e) for e in node.inputs]
+        if node in targets:
+            name = node.name
+            data_s = ins[0]
+            weight_name = node.inputs[1][0].name
+            bias_name = node.inputs[2][0].name if len(node.inputs) > 2 \
+                else None
+
+            # offline weight/bias quantization
+            qw, w_r = _quantize_params_int8(arg_params[weight_name])
+            qarg_params[weight_name] = qw
+            w_min = const(-w_r, "%s_wmin" % name)
+            w_max = const(w_r, "%s_wmax" % name)
+
+            src, _ = node.inputs[0]
+            in_key = src.name if src.is_var() else "%s_output" % src.name
+            if in_key in thresholds:
+                mn, mx = thresholds[in_key]
+                d_min = const(mn, "%s_dmin" % name)
+                d_max = const(mx, "%s_dmax" % name)
+            else:  # dynamic: compute the range in-graph
+                d_min = _invoke("min", [data_s], {}, name="%s_dmin" % name)
+                d_max = _invoke("max", [data_s], {}, name="%s_dmax" % name)
+            q = _invoke("_contrib_quantize", [data_s, d_min, d_max],
+                        {"out_type": "int8"}, name="%s_qdata" % name)
+
+            attrs = dict(node.attrs)
+            w_var = sym_mod.var(weight_name)
+            q_ins = [q[0], w_var]
+            if bias_name is not None and not attrs.get("no_bias"):
+                qb, b_r = _quantize_params_int8(arg_params[bias_name])
+                qarg_params[bias_name] = qb
+                q_ins.append(sym_mod.var(bias_name))
+                q_ins += [q[1], q[2], w_min, w_max,
+                          const(-b_r, "%s_bmin" % name),
+                          const(b_r, "%s_bmax" % name)]
+            else:
+                attrs["no_bias"] = True
+                q_ins += [q[1], q[2], w_min, w_max]
+            qop = "_contrib_quantized_fully_connected" \
+                if node.op == "FullyConnected" else "_contrib_quantized_conv"
+            acc = _invoke(qop, q_ins, attrs, name="%s_quantized" % name)
+
+            rq_attrs = {}
+            out_key = "%s_output" % name
+            if out_key in thresholds:
+                mn, mx = thresholds[out_key]
+                rq_attrs = {"min_calib_range": mn, "max_calib_range": mx}
+            rq = _invoke("_contrib_requantize", [acc[0], acc[1], acc[2]],
+                         rq_attrs, name="%s_requantize" % name)
+            deq = _invoke("_contrib_dequantize", [rq[0], rq[1], rq[2]],
+                          {}, name="%s_dequantize" % name)
+            new_syms[id(node)] = deq
+        else:
+            new_syms[id(node)] = _invoke(node.op, ins, dict(node.attrs),
+                                         name=node.name)
+
+    outs = []
+    for node, idx in sym._outputs:
+        s = new_syms[id(node)]
+        outs.append(s[idx] if len(s._outputs) > 1 else s)
+    qsym = sym_mod.Group(outs) if len(outs) > 1 else outs[0]
+    return qsym, qarg_params, dict(aux_params)

@@ -260,7 +260,8 @@ class Executor(object):
             new_aux[name] = old if shape == old.shape else nd_mod.zeros(
                 shape, ctx=self._ctx)
         return Executor(self._symbol, self._ctx, new_args, new_grads,
-                        self.grad_req, new_aux)
+                        self.grad_req, new_aux,
+                        group2ctx=self._group2dev)  # devices pass through
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):

@@ -1,0 +1,209 @@
+"""Custom operators written in Python (reference ``python/mxnet/operator.py``:
+CustomOp :426, CustomOpProp :472, register :692, backed by the C++ bridge
+``src/operator/custom/custom-inl.h:50`` with its dedicated callback thread
+pool).
+
+TPU-native design: the eager path calls the Python forward/backward
+directly on NDArrays, taping the backward like any op. The compiled
+(Symbol / hybridized) path registers a ``Custom`` op whose fcompute escapes
+the XLA trace through ``jax.pure_callback`` — the host runs the Python
+code while the surrounding graph stays one compiled module (the role the
+reference's custom-op worker threads play for its engine), with a
+``jax.custom_vjp`` bridging the Python backward into whole-graph autograd.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+from .ops.registry import register as _register_op
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+_CUSTOM_REGISTRY: Dict[str, type] = {}
+
+
+class CustomOp(object):
+    """Base for custom op implementations (reference operator.py:426)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write src into dst honoring the write/add/null request
+        (reference operator.py CustomOp.assign)."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst._data = src._data if hasattr(src, "_data") else jnp.asarray(src)
+        elif req == "add":
+            dst._data = dst._data + (src._data if hasattr(src, "_data")
+                                     else jnp.asarray(src))
+        else:
+            raise MXNetError("unknown req %r" % req)
+
+
+class CustomOpProp(object):
+    """Describes a custom op: interface names, shapes, and instantiation
+    (reference operator.py:472)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp under ``op_type``
+    (reference operator.py:692); usable afterwards as
+    ``mx.nd.Custom(..., op_type=reg_name)`` and ``mx.sym.Custom``."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered():
+    return dict(_CUSTOM_REGISTRY)
+
+
+def _make_prop(attrs):
+    op_type = attrs.get("op_type")
+    if not op_type or op_type not in _CUSTOM_REGISTRY:
+        raise MXNetError(
+            "Custom: op_type %r is not registered (use "
+            "mx.operator.register)" % (op_type,))
+    kwargs = {k: v for k, v in attrs.items()
+              if k not in ("op_type",) and not k.startswith("__")}
+    return _CUSTOM_REGISTRY[op_type](**kwargs)
+
+
+class _EagerShim:
+    """Minimal NDArray-like carrier for pure_callback numpy buffers."""
+
+    def __init__(self, arr):
+        self._data = jnp.asarray(arr)
+
+
+def _run_forward(prop, op, arg_datas, is_train, out_dtypes):
+    from .ndarray.ndarray import NDArray
+    from .context import cpu
+
+    in_nd = [NDArray(jnp.asarray(a), cpu()) for a in arg_datas]
+    _, out_shapes, _ = prop.infer_shape([list(a.shape) for a in arg_datas])
+    out_nd = [NDArray(jnp.zeros(tuple(s), dt), cpu())
+              for s, dt in zip(out_shapes, out_dtypes)]
+    op.forward(is_train, ["write"] * len(out_nd), in_nd, out_nd, [])
+    return [np.asarray(o._data).astype(dt)
+            for o, dt in zip(out_nd, out_dtypes)]
+
+
+def _custom_inputs(attrs):
+    return list(_make_prop(attrs).list_arguments())
+
+
+def _custom_num_outputs(attrs):
+    return len(_make_prop(attrs).list_outputs())
+
+
+def _obj(v):
+    return v
+
+
+@_register_op("Custom",
+              params={"op_type": (_obj, None)},
+              inputs=_custom_inputs, num_outputs=_custom_num_outputs)
+def _custom_fcompute(attrs, *inputs):
+    """Symbol/compiled-path Custom: host callback inside the XLA module
+    (reference custom-inl.h worker-thread bridge → jax.pure_callback), with
+    the Python backward wired in via jax.custom_vjp."""
+    from . import _global
+
+    prop = _make_prop(attrs)
+    is_train = _global.is_train()
+    in_shapes = [list(x.shape) for x in inputs]
+    in_dtypes = [x.dtype for x in inputs]
+    _, out_shapes, _ = prop.infer_shape(in_shapes)
+    _, out_dtypes, _ = prop.infer_type(in_dtypes)
+    out_specs = [jax.ShapeDtypeStruct(tuple(s), dt)
+                 for s, dt in zip(out_shapes, out_dtypes)]
+    n_out = len(out_specs)
+
+    def host_fwd(*arg_datas):
+        op = prop.create_operator(None, in_shapes,
+                                  [a.dtype for a in arg_datas])
+        return tuple(_run_forward(prop, op, arg_datas, is_train, out_dtypes))
+
+    def host_bwd(*datas):
+        from .ndarray.ndarray import NDArray
+        from .context import cpu
+
+        n_in = len(in_shapes)
+        ins = [NDArray(jnp.asarray(a), cpu()) for a in datas[:n_in]]
+        outs = [NDArray(jnp.asarray(a), cpu())
+                for a in datas[n_in:n_in + n_out]]
+        cts = [NDArray(jnp.asarray(a), cpu()) for a in datas[n_in + n_out:]]
+        op = prop.create_operator(None, in_shapes,
+                                  [a.dtype for a in datas[:n_in]])
+        igrads = [NDArray(jnp.zeros_like(i._data), cpu()) for i in ins]
+        op.backward(["write"] * len(ins), cts, ins, outs, igrads, [])
+        return tuple(np.asarray(g._data).astype(dt)
+                     for g, dt in zip(igrads, in_dtypes))
+
+    @jax.custom_vjp
+    def f(*xs):
+        outs = jax.pure_callback(host_fwd, tuple(out_specs), *xs)
+        return outs if n_out > 1 else outs[0]
+
+    def f_fwd(*xs):
+        outs = jax.pure_callback(host_fwd, tuple(out_specs), *xs)
+        res = (xs, outs)
+        return (outs if n_out > 1 else outs[0]), res
+
+    def f_bwd(res, cts):
+        xs, outs = res
+        cts_t = cts if isinstance(cts, tuple) else (cts,)
+        in_specs = tuple(jax.ShapeDtypeStruct(tuple(s), dt)
+                         for s, dt in zip(in_shapes, in_dtypes))
+        grads = jax.pure_callback(host_bwd, in_specs,
+                                  *(tuple(xs) + tuple(outs) + tuple(cts_t)))
+        return tuple(grads)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(*inputs)

@@ -9,5 +9,11 @@ from . import random_ops  # noqa: F401
 from . import linalg  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import detection  # noqa: F401
+from . import quantization  # noqa: F401
+from . import vision  # noqa: F401
 
 __all__ = ["OP_REGISTRY", "OpDef", "AttrDict", "get_op", "list_ops", "register", "REQUIRED"]
+
+# the Custom-op bridge registers the "Custom" op; import it here so the
+# nd/sym wrapper generation (which runs right after `ops`) picks it up
+from .. import operator as _custom_bridge  # noqa: E402,F401

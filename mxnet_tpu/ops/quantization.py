@@ -1,0 +1,214 @@
+"""INT8 quantization operators.
+
+Reference ``src/operator/quantization/`` (quantize/dequantize/requantize,
+quantized_conv, quantized_fully_connected, quantized_pooling,
+quantized_flatten; 21 files). TPU-native design: int8 matmuls/convs feed
+the MXU directly via ``lax.dot_general``/``conv_general_dilated`` with
+``preferred_element_type=int32`` — the int8 tile shape (32, 128) doubles
+MXU throughput versus bf16, which is the whole point of the exercise.
+
+Quantization scheme matches the reference: int8 is SYMMETRIC
+(quantized_range=127, real range max(|min|,|max|)), uint8 is affine over
+[min, max]; quantized compute ops take int8 data + the float min/max pair
+per input and return int32 + the output's float range (int32 extremes map
+onto the product of input scales — quantize-inl.h GetQuantizedRange /
+quantized_fully_connected.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import REQUIRED, register
+
+INT8_RANGE = 127.0
+UINT8_RANGE = 255.0
+INT32_RANGE = float(2 ** 31 - 1)
+
+
+def _real_range(mn, mx):
+    return jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+
+
+@register("_contrib_quantize",
+          params={"out_type": (str, "uint8")},
+          inputs=("data", "min_range", "max_range"), num_outputs=3)
+def _quantize(attrs, data, min_range, max_range):
+    """float -> int8/uint8 (reference quantize-inl.h QuantizeCompute)."""
+    mn = jnp.reshape(min_range, ()).astype(jnp.float32)
+    mx = jnp.reshape(max_range, ()).astype(jnp.float32)
+    if attrs.out_type == "int8":
+        r = _real_range(mn, mx)
+        scale = INT8_RANGE / jnp.maximum(r, 1e-30)
+        q = jnp.clip(jnp.round(data * scale), -INT8_RANGE, INT8_RANGE)
+        return q.astype(jnp.int8), -r, r
+    if attrs.out_type == "uint8":
+        scale = UINT8_RANGE / jnp.maximum(mx - mn, 1e-30)
+        q = jnp.clip(jnp.round((data - mn) * scale), 0.0, UINT8_RANGE)
+        return q.astype(jnp.uint8), mn, mx
+    raise ValueError("unsupported out_type %r" % attrs.out_type)
+
+
+@register("_contrib_dequantize",
+          params={"out_type": (str, "float32")},
+          inputs=("data", "min_range", "max_range"))
+def _dequantize(attrs, data, min_range, max_range):
+    """int8/uint8/int32 -> float (reference dequantize-inl.h)."""
+    mn = jnp.reshape(min_range, ()).astype(jnp.float32)
+    mx = jnp.reshape(max_range, ()).astype(jnp.float32)
+    if data.dtype == jnp.uint8:
+        scale = (mx - mn) / UINT8_RANGE
+        return data.astype(jnp.float32) * scale + mn
+    quant_range = INT8_RANGE if data.dtype == jnp.int8 else INT32_RANGE
+    scale = _real_range(mn, mx) / quant_range
+    return data.astype(jnp.float32) * scale
+
+
+@register("_contrib_requantize",
+          params={"min_calib_range": (float, None),
+                  "max_calib_range": (float, None)},
+          inputs=("data", "min_range", "max_range"), num_outputs=3)
+def _requantize(attrs, data, min_range, max_range):
+    """int32 accumulator -> int8 with a (calibrated) narrower range
+    (reference requantize-inl.h)."""
+    mn = jnp.reshape(min_range, ()).astype(jnp.float32)
+    mx = jnp.reshape(max_range, ()).astype(jnp.float32)
+    in_scale = _real_range(mn, mx) / INT32_RANGE
+    real = data.astype(jnp.float32) * in_scale
+    if attrs.min_calib_range is not None and attrs.max_calib_range is not None:
+        out_r = max(abs(attrs.min_calib_range), abs(attrs.max_calib_range))
+        out_r = jnp.float32(out_r)
+    else:
+        out_r = jnp.maximum(jnp.max(jnp.abs(real)), 1e-30)
+    q = jnp.clip(jnp.round(real * (INT8_RANGE / out_r)),
+                 -INT8_RANGE, INT8_RANGE)
+    return q.astype(jnp.int8), -out_r, out_r
+
+
+def _i8(x):
+    return x.astype(jnp.int8) if x.dtype != jnp.int8 else x
+
+
+def _qfc_inputs(attrs):
+    if attrs.get("no_bias"):
+        return ["data", "weight", "min_data", "max_data",
+                "min_weight", "max_weight"]
+    return ["data", "weight", "bias", "min_data", "max_data",
+            "min_weight", "max_weight", "min_bias", "max_bias"]
+
+
+@register("_contrib_quantized_fully_connected",
+          params={"num_hidden": (int, REQUIRED), "no_bias": (bool, False),
+                  "flatten": (bool, True)},
+          inputs=_qfc_inputs, num_outputs=3)
+def _quantized_fc(attrs, data, weight, *rest):
+    """int8 x int8 -> int32 FC on the MXU (reference
+    quantized_fully_connected.cc). Output range: int32 extremes map to the
+    product of the input scales."""
+    if attrs.no_bias:
+        min_d, max_d, min_w, max_w = rest
+        bias = None
+    else:
+        bias, min_d, max_d, min_w, max_w, min_b, max_b = rest
+    x = data.reshape(data.shape[0], -1) if attrs.flatten else data
+    acc = lax.dot_general(
+        _i8(x), _i8(weight),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    d_scale = _real_range(jnp.reshape(min_d, ()), jnp.reshape(max_d, ())) \
+        / INT8_RANGE
+    w_scale = _real_range(jnp.reshape(min_w, ()), jnp.reshape(max_w, ())) \
+        / INT8_RANGE
+    out_scale = d_scale * w_scale
+    if bias is not None:
+        b_scale = _real_range(jnp.reshape(min_b, ()),
+                              jnp.reshape(max_b, ())) / INT8_RANGE
+        # rescale bias quanta into the accumulator's scale
+        b32 = jnp.round(bias.astype(jnp.float32) * b_scale
+                        / jnp.maximum(out_scale, 1e-30)).astype(jnp.int32)
+        acc = acc + b32
+    return acc, -INT32_RANGE * out_scale, INT32_RANGE * out_scale
+
+
+def _qconv_inputs(attrs):
+    if attrs.get("no_bias"):
+        return ["data", "weight", "min_data", "max_data",
+                "min_weight", "max_weight"]
+    return ["data", "weight", "bias", "min_data", "max_data",
+            "min_weight", "max_weight", "min_bias", "max_bias"]
+
+
+@register("_contrib_quantized_conv",
+          params={"kernel": (tuple, REQUIRED), "stride": (tuple, None),
+                  "pad": (tuple, None), "dilate": (tuple, None),
+                  "num_filter": (int, REQUIRED), "num_group": (int, 1),
+                  "no_bias": (bool, False), "layout": (str, "NCHW")},
+          inputs=_qconv_inputs, num_outputs=3)
+def _quantized_conv(attrs, data, weight, *rest):
+    """int8 convolution with int32 accumulation (reference
+    quantized_conv.cc)."""
+    if attrs.no_bias:
+        min_d, max_d, min_w, max_w = rest
+        bias = None
+    else:
+        bias, min_d, max_d, min_w, max_w, min_b, max_b = rest
+    k = len(attrs.kernel)
+    stride = attrs.stride or (1,) * k
+    pad = attrs.pad or (0,) * k
+    dilate = attrs.dilate or (1,) * k
+    if k != 2:
+        raise ValueError("quantized_conv supports 2D kernels only")
+    acc = lax.conv_general_dilated(
+        _i8(data), _i8(weight), window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        feature_group_count=attrs.num_group,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.int32)
+    d_scale = _real_range(jnp.reshape(min_d, ()), jnp.reshape(max_d, ())) \
+        / INT8_RANGE
+    w_scale = _real_range(jnp.reshape(min_w, ()), jnp.reshape(max_w, ())) \
+        / INT8_RANGE
+    out_scale = d_scale * w_scale
+    if bias is not None:
+        b_scale = _real_range(jnp.reshape(min_b, ()),
+                              jnp.reshape(max_b, ())) / INT8_RANGE
+        b32 = jnp.round(bias.astype(jnp.float32) * b_scale
+                        / jnp.maximum(out_scale, 1e-30)).astype(jnp.int32)
+        acc = acc + b32.reshape(1, -1, *([1] * (acc.ndim - 2)))
+    return acc, -INT32_RANGE * out_scale, INT32_RANGE * out_scale
+
+
+@register("_contrib_quantized_pooling",
+          params={"kernel": (tuple, None), "pool_type": (str, "max"),
+                  "stride": (tuple, None), "pad": (tuple, None),
+                  "global_pool": (bool, False),
+                  "pooling_convention": (str, "valid")},
+          inputs=("data", "min_data", "max_data"), num_outputs=3)
+def _quantized_pooling(attrs, data, min_data, max_data):
+    """int8 pooling; ranges pass through (reference quantized_pooling.cc
+    — max/avg pooling is scale-invariant)."""
+    from .registry import OP_REGISTRY
+
+    pool = OP_REGISTRY["Pooling"]
+    p_attrs = pool.parse_attrs({
+        "kernel": attrs.kernel, "pool_type": attrs.pool_type,
+        "stride": attrs.stride, "pad": attrs.pad,
+        "global_pool": attrs.global_pool,
+        "pooling_convention": attrs.pooling_convention})
+    out = pool.fcompute(p_attrs, data.astype(jnp.float32))
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    if attrs.pool_type == "max":
+        out = out.astype(data.dtype)
+    else:
+        out = jnp.round(out).astype(data.dtype)
+    return out, jnp.reshape(min_data, ()), jnp.reshape(max_data, ())
+
+
+@register("_contrib_quantized_flatten",
+          inputs=("data", "min_data", "max_data"), num_outputs=3)
+def _quantized_flatten(attrs, data, min_data, max_data):
+    return (data.reshape(data.shape[0], -1),
+            jnp.reshape(min_data, ()), jnp.reshape(max_data, ()))

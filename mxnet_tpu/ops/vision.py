@@ -1,0 +1,442 @@
+"""Vision / legacy operator tail.
+
+Reference: ``src/operator/spatial_transformer.cc``, ``grid_generator.cc``,
+``bilinear_sampler.cc``, ``correlation.cc``, ``roi_pooling.cc``,
+``crop.cc``, ``src/operator/contrib/{fft,ifft,adaptive_avg_pooling,
+bilinear_resize,proposal}``. All implemented as vectorized XLA (gathers,
+einsum pooling matrices, static displacement loops) — differentiable where
+the reference registers a backward; NMS inside Proposal rides the Pallas
+suppression kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import REQUIRED, register
+from . import pallas_kernels
+
+
+def _floats(v):
+    if isinstance(v, str):
+        s = v.strip().lstrip("([").rstrip(")]")
+        return tuple(float(x) for x in s.split(",") if x.strip())
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+# ---------------------------------------------------------------------------
+# bilinear sampling family (SpatialTransformer / GridGenerator /
+# BilinearSampler — the STN trio, reference spatial_transformer-inl.h)
+# ---------------------------------------------------------------------------
+
+
+def _bilinear_sample_2d(img, xs, ys):
+    """Sample img (C, H, W) at float pixel coords xs/ys (...,) with zero
+    padding outside (reference BilinearSamplerForward)."""
+    c, h, w = img.shape
+    x0 = jnp.floor(xs)
+    y0 = jnp.floor(ys)
+    lx = xs - x0
+    ly = ys - y0
+
+    def tap(yi, xi):
+        inside = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        v = img[:, yc, xc]  # (C, ...)
+        return jnp.where(inside, v, 0.0)
+
+    v00 = tap(y0, x0)
+    v01 = tap(y0, x0 + 1)
+    v10 = tap(y0 + 1, x0)
+    v11 = tap(y0 + 1, x0 + 1)
+    top = v00 * (1 - lx) + v01 * lx
+    bot = v10 * (1 - lx) + v11 * lx
+    return top * (1 - ly) + bot * ly
+
+
+def _affine_grid(theta, h, w):
+    """(6,) affine params -> (2, H, W) normalized target coords
+    (reference grid_generator.cc affine branch)."""
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    yg, xg = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(xg)
+    src = jnp.stack([xg, yg, ones], axis=0).reshape(3, -1)  # (3, H*W)
+    out = theta.reshape(2, 3) @ src                         # (2, H*W)
+    return out.reshape(2, h, w)
+
+
+@register("GridGenerator",
+          params={"transform_type": (str, REQUIRED),
+                  "target_shape": (tuple, (0, 0))})
+def _grid_generator(attrs, data):
+    """Generate sampling grids (reference grid_generator.cc): 'affine'
+    takes (B, 6) params; 'warp' takes (B, 2, H, W) flow added to the
+    identity grid, normalized to [-1, 1]."""
+    if attrs.transform_type == "affine":
+        h, w = attrs.target_shape
+        return jax.vmap(lambda t: _affine_grid(t, h, w))(data)
+    if attrs.transform_type == "warp":
+        b, _, h, w = data.shape
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        yg, xg = jnp.meshgrid(ys, xs, indexing="ij")
+        x_new = (data[:, 0] + xg) * (2.0 / max(w - 1, 1)) - 1.0
+        y_new = (data[:, 1] + yg) * (2.0 / max(h - 1, 1)) - 1.0
+        return jnp.stack([x_new, y_new], axis=1)
+    raise ValueError("unknown transform_type %r" % attrs.transform_type)
+
+
+@register("BilinearSampler", inputs=("data", "grid"))
+def _bilinear_sampler(attrs, data, grid):
+    """Sample data (B,C,H,W) at grid (B,2,Ho,Wo) in [-1,1] coords
+    (reference bilinear_sampler.cc; zero padding outside)."""
+    _, _, h, w = data.shape
+
+    def one(img, g):
+        xs = (g[0] + 1.0) * (w - 1) / 2.0
+        ys = (g[1] + 1.0) * (h - 1) / 2.0
+        return _bilinear_sample_2d(img, xs, ys)
+
+    return jax.vmap(one)(data, grid)
+
+
+@register("SpatialTransformer",
+          params={"target_shape": (tuple, (0, 0)),
+                  "transform_type": (str, REQUIRED),
+                  "sampler_type": (str, REQUIRED)},
+          inputs=("data", "loc"))
+def _spatial_transformer(attrs, data, loc):
+    """STN: affine grid from loc + bilinear sampling (reference
+    spatial_transformer.cc; only affine/bilinear exist there too)."""
+    h, w = attrs.target_shape
+    _, _, ih, iw = data.shape
+
+    def one(img, theta):
+        g = _affine_grid(theta, h, w)
+        xs = (g[0] + 1.0) * (iw - 1) / 2.0
+        ys = (g[1] + 1.0) * (ih - 1) / 2.0
+        return _bilinear_sample_2d(img, xs, ys)
+
+    return jax.vmap(one)(data, loc)
+
+
+# ---------------------------------------------------------------------------
+# Correlation (FlowNet, reference correlation.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("Correlation",
+          params={"kernel_size": (int, 1), "max_displacement": (int, 1),
+                  "stride1": (int, 1), "stride2": (int, 1),
+                  "pad_size": (int, 0), "is_multiply": (bool, True)},
+          inputs=("data1", "data2"))
+def _correlation(attrs, data1, data2):
+    """Correlation volume between two feature maps: for each displacement
+    in a (2d/s2+1)^2 grid, the kernel-window mean of the per-channel
+    product (or absolute difference). Static loop over displacements,
+    vectorized spatial math (reference correlation.cc CorrelationForward)."""
+    b, c, h, w = data1.shape
+    k, md = attrs.kernel_size, attrs.max_displacement
+    s1, s2, pad = attrs.stride1, attrs.stride2, attrs.pad_size
+    d = 2 * md // s2 + 1
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = h + 2 * pad, w + 2 * pad
+    kr = k // 2
+    out_h = (ph - 2 * (kr + md) + s1 - 1) // s1
+    out_w = (pw - 2 * (kr + md) + s1 - 1) // s1
+    base = kr + md  # first window center
+    ys = base + s1 * jnp.arange(out_h)
+    xs = base + s1 * jnp.arange(out_w)
+    norm = float(k * k * c)
+    planes = []
+    for dy in range(-md, md + 1, s2):
+        for dx in range(-md, md + 1, s2):
+            if attrs.is_multiply:
+                prod = p1 * jnp.roll(p2, (-dy, -dx), axis=(2, 3))
+            else:
+                prod = jnp.abs(p1 - jnp.roll(p2, (-dy, -dx), axis=(2, 3)))
+            # kernel-window sum via cumulative window reduce
+            win = lax.reduce_window(
+                prod, 0.0, lax.add, (1, 1, k, k), (1, 1, 1, 1), "SAME")
+            plane = win.sum(axis=1) / norm      # (B, PH, PW)
+            planes.append(plane[:, ys][:, :, xs])
+    return jnp.stack(planes, axis=1)  # (B, D*D, out_h, out_w)
+
+
+# ---------------------------------------------------------------------------
+# ROIPooling / Crop
+# ---------------------------------------------------------------------------
+
+
+@register("ROIPooling",
+          params={"pooled_size": (tuple, REQUIRED),
+                  "spatial_scale": (float, REQUIRED)},
+          inputs=("data", "rois"))
+def _roi_pooling(attrs, data, rois):
+    """Max-pool RoIs into a fixed grid with rounded bin edges (reference
+    roi_pooling.cc — the Fast R-CNN op; ROIAlign is the non-rounded
+    variant)."""
+    ph, pw = attrs.pooled_size
+    b, c, h, w = data.shape
+    ycoord = jnp.arange(h)
+    xcoord = jnp.arange(w)
+
+    def one(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * attrs.spatial_scale)
+        y1 = jnp.round(roi[2] * attrs.spatial_scale)
+        x2 = jnp.round(roi[3] * attrs.spatial_scale)
+        y2 = jnp.round(roi[4] * attrs.spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = data[bi]
+
+        def bin_val(py, px):
+            hs = jnp.floor(py * bin_h) + y1
+            he = jnp.ceil((py + 1) * bin_h) + y1
+            ws = jnp.floor(px * bin_w) + x1
+            we = jnp.ceil((px + 1) * bin_w) + x1
+            mask = ((ycoord >= hs) & (ycoord < he))[:, None] & \
+                   ((xcoord >= ws) & (xcoord < we))[None, :]
+            sel = jnp.where(mask[None], img, -jnp.inf)
+            v = sel.max(axis=(1, 2))
+            return jnp.where(jnp.isfinite(v), v, 0.0)
+
+        grid = [[bin_val(py, px) for px in range(pw)] for py in range(ph)]
+        return jnp.stack([jnp.stack(r, axis=-1) for r in grid], axis=-2)
+
+    return jax.vmap(one)(rois)
+
+
+@register("Crop",
+          params={"offset": (tuple, (0, 0)), "h_w": (tuple, (0, 0)),
+                  "num_args": (int, REQUIRED), "center_crop": (bool, False)},
+          inputs=lambda a: ["data", "crop_like"][:a["num_args"]])
+def _crop(attrs, data, *rest):
+    """Crop H/W to h_w (or to crop_like's shape), at offset or centered
+    (reference crop.cc)."""
+    if rest:
+        th, tw = rest[0].shape[2], rest[0].shape[3]
+    else:
+        th, tw = attrs.h_w
+    h, w = data.shape[2], data.shape[3]
+    if attrs.center_crop:
+        oy, ox = (h - th) // 2, (w - tw) // 2
+    else:
+        oy, ox = attrs.offset
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+# ---------------------------------------------------------------------------
+# adaptive pooling / bilinear resize (reference contrib)
+# ---------------------------------------------------------------------------
+
+
+def _adaptive_matrix(in_size, out_size):
+    """(out, in) averaging matrix with floor/ceil bin edges (reference
+    adaptive_avg_pooling.cc bin convention)."""
+    m = np.zeros((out_size, in_size), np.float32)
+    for i in range(out_size):
+        start = int(np.floor(i * in_size / out_size))
+        end = int(np.ceil((i + 1) * in_size / out_size))
+        m[i, start:end] = 1.0 / (end - start)
+    return jnp.asarray(m)
+
+
+@register("_contrib_AdaptiveAvgPooling2D",
+          params={"output_size": (tuple, None)})
+def _adaptive_avg_pool(attrs, data):
+    """Pool to a fixed (Ho, Wo) regardless of input size; bins follow the
+    reference floor/ceil convention. Expressed as two matmuls so the MXU
+    does the averaging."""
+    h, w = data.shape[2], data.shape[3]
+    if not attrs.output_size:
+        oh, ow = 1, 1
+    elif len(attrs.output_size) == 1:
+        oh = ow = attrs.output_size[0]
+    else:
+        oh, ow = attrs.output_size
+    mh = _adaptive_matrix(h, oh)
+    mw = _adaptive_matrix(w, ow)
+    return jnp.einsum("oh,bchw,pw->bcop", mh, data, mw)
+
+
+@register("_contrib_BilinearResize2D",
+          params={"height": (int, REQUIRED), "width": (int, REQUIRED)})
+def _bilinear_resize(attrs, data):
+    """Bilinear resize with align_corners=True (reference
+    bilinear_resize.cc uses the caffe/align-corners convention)."""
+    b, c, h, w = data.shape
+    oh, ow = attrs.height, attrs.width
+    ys = jnp.linspace(0.0, h - 1, oh)
+    xs = jnp.linspace(0.0, w - 1, ow)
+    yg, xg = jnp.meshgrid(ys, xs, indexing="ij")
+
+    def one(img):
+        return _bilinear_sample_2d(img, xg, yg)
+
+    return jax.vmap(one)(data)
+
+
+# ---------------------------------------------------------------------------
+# fft / ifft (reference contrib/fft.cc — interleaved real/imag packing)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_fft", params={"compute_size": (int, 128)})
+def _fft(attrs, data):
+    """FFT over the last axis; complex packed as interleaved [re, im]
+    doubling the last dim (reference fft-inl.h)."""
+    spec = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    out = jnp.stack([spec.real, spec.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(jnp.float32)
+
+
+@register("_contrib_ifft", params={"compute_size": (int, 128)})
+def _ifft(attrs, data):
+    """Inverse of _contrib_fft: interleaved complex -> UNNORMALIZED real
+    inverse FFT (reference ifft-inl.h: out = ifft(in) * size)."""
+    n = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (n, 2))
+    spec = pairs[..., 0] + 1j * pairs[..., 1]
+    return (jnp.fft.ifft(spec, axis=-1).real * n).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Proposal (RPN, reference contrib/proposal.cc)
+# ---------------------------------------------------------------------------
+
+
+def _gen_base_anchors(stride, scales, ratios):
+    """(A, 4) base anchors centered on one stride cell (reference
+    proposal.cc GenerateAnchors convention)."""
+    base = np.array([0, 0, stride - 1, stride - 1], np.float32)
+    cw = (base[0] + base[2]) / 2
+    ch = (base[1] + base[3]) / 2
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    anchors = []
+    size = w * h
+    for r in ratios:
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            anchors.append([cw - (wss - 1) / 2, ch - (hss - 1) / 2,
+                            cw + (wss - 1) / 2, ch + (hss - 1) / 2])
+    return np.asarray(anchors, np.float32)
+
+
+@register("Proposal",
+          params={"rpn_pre_nms_top_n": (int, 6000),
+                  "rpn_post_nms_top_n": (int, 300),
+                  "threshold": (float, 0.7),
+                  "rpn_min_size": (int, 16),
+                  "scales": (_floats, (4.0, 8.0, 16.0, 32.0)),
+                  "ratios": (_floats, (0.5, 1.0, 2.0)),
+                  "feature_stride": (int, 16),
+                  "output_score": (bool, False),
+                  "iou_loss": (bool, False)},
+          inputs=("cls_prob", "bbox_pred", "im_info"),
+          num_outputs=lambda a: 2 if a["output_score"] else 1,
+          aliases=("_contrib_Proposal", "_contrib_MultiProposal",
+                   "MultiProposal"))
+def _proposal(attrs, cls_prob, bbox_pred, im_info):
+    """RPN proposal generation: anchor decode + clip + min-size filter +
+    pre-NMS topk + NMS (Pallas kernel) + post-NMS pad (reference
+    proposal.cc / multi_proposal.cc). Output (B*post, 5) rois
+    [batch_idx, x1, y1, x2, y2]."""
+    b, twice_a, h, w = cls_prob.shape
+    a = twice_a // 2
+    stride = attrs.feature_stride
+    base = jnp.asarray(_gen_base_anchors(stride, attrs.scales, attrs.ratios))
+    shift_x = jnp.arange(w, dtype=jnp.float32) * stride
+    shift_y = jnp.arange(h, dtype=jnp.float32) * stride
+    sy, sx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 4)
+    anchors = (base[None, :, :] + shifts[:, None, :]).reshape(-1, 4)  # (HWA,4)
+    n = anchors.shape[0]
+    pre = min(attrs.rpn_pre_nms_top_n, n)
+    post = attrs.rpn_post_nms_top_n
+
+    def one(probs, deltas, info):
+        score = probs[a:].transpose(1, 2, 0).reshape(-1)     # fg scores
+        d = deltas.transpose(1, 2, 0).reshape(-1, 4)
+        aw = anchors[:, 2] - anchors[:, 0] + 1
+        ah = anchors[:, 3] - anchors[:, 1] + 1
+        ax = anchors[:, 0] + aw * 0.5
+        ay = anchors[:, 1] + ah * 0.5
+        cx = d[:, 0] * aw + ax
+        cy = d[:, 1] * ah + ay
+        nw = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * aw
+        nh = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * ah
+        x1 = jnp.clip(cx - nw * 0.5, 0, info[1] - 1)
+        y1 = jnp.clip(cy - nh * 0.5, 0, info[0] - 1)
+        x2 = jnp.clip(cx + nw * 0.5, 0, info[1] - 1)
+        y2 = jnp.clip(cy + nh * 0.5, 0, info[0] - 1)
+        min_size = attrs.rpn_min_size * info[2]
+        keep_sz = ((x2 - x1 + 1) >= min_size) & ((y2 - y1 + 1) >= min_size)
+        score = jnp.where(keep_sz, score, -jnp.inf)
+        order = jnp.argsort(-score)[:pre]
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)[order]
+        s_sorted = score[order]
+        keep = pallas_kernels.nms_keep(
+            boxes, jnp.full((pre,), -1.0), jnp.isfinite(s_sorted),
+            attrs.threshold, True)
+        # compact kept boxes to the front (stable), take `post`, pad with
+        # the top box (the reference pads by repeating)
+        kept_first = jnp.argsort(~keep, stable=True)[:post]
+        rows = boxes[kept_first]
+        scores_out = s_sorted[kept_first]
+        n_kept = jnp.minimum(jnp.sum(keep), post)
+        live = jnp.arange(post) < n_kept
+        rows = jnp.where(live[:, None], rows, boxes[0])
+        scores_out = jnp.where(live, scores_out, s_sorted[0])
+        return rows, scores_out
+
+    rois_list, score_list = [], []
+    for i in range(b):
+        rows, scores = one(cls_prob[i], bbox_pred[i], im_info[i])
+        idx = jnp.full((post, 1), float(i))
+        rois_list.append(jnp.concatenate([idx, rows], axis=-1))
+        score_list.append(scores.reshape(-1, 1))
+    rois = jnp.concatenate(rois_list, axis=0)
+    if attrs.output_score:
+        return rois, jnp.concatenate(score_list, axis=0)
+    return rois
+
+
+@register("IdentityAttachKLSparseReg",
+          params={"sparseness_target": (float, 0.1),
+                  "penalty": (float, 0.001), "momentum": (float, 0.9)})
+def _identity_kl_sparse(attrs, data):
+    """Identity forward; backward adds a KL-sparsity penalty gradient
+    toward the target mean activation (reference
+    identity_attach_KL_sparse_reg.cc)."""
+    rho = attrs.sparseness_target
+    penalty = attrs.penalty
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, x
+
+    def bwd(x, g):
+        rho_hat = jnp.clip(jnp.mean(jax.nn.sigmoid(x)), 1e-6, 1 - 1e-6)
+        reg = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+        return (g + reg * jnp.ones_like(x),)
+
+    f.defvjp(fwd, bwd)
+    return f(data)

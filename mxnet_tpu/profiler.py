@@ -79,6 +79,11 @@ def set_state(state="stop", profile_process="worker"):
         if state == "run" and _state != "run":
             _state = "run"
             ENABLED = not _paused
+            # each run starts a fresh session: without this, periodic
+            # dump() calls re-emit every event since process start and the
+            # buffer grows unboundedly
+            _events.clear()
+            _agg.clear()
             if _config["profile_all"] or _config["profile_symbolic"]:
                 try:
                     import jax

@@ -571,10 +571,22 @@ def _fill_param_shapes(node: _Node, in_shapes):
         if slot in in_names and named.get(slot) is None and shape is not None:
             out[in_names.index(slot)] = tuple(int(s) for s in shape)
 
-    if op == "FullyConnected" and data is not None:
+    if op in ("FullyConnected", "_contrib_quantized_fully_connected") \
+            and data is not None:
         in_units = int(np.prod(data[1:])) if attrs.flatten else data[-1]
         put("weight", (attrs.num_hidden, in_units))
         put("bias", (attrs.num_hidden,))
+        for slot in ("min_data", "max_data", "min_weight", "max_weight",
+                     "min_bias", "max_bias"):
+            put(slot, (1,))
+    elif op == "_contrib_quantized_conv" and data is not None:
+        c = data[1]
+        put("weight", (attrs.num_filter, c // attrs.num_group)
+            + tuple(attrs.kernel))
+        put("bias", (attrs.num_filter,))
+        for slot in ("min_data", "max_data", "min_weight", "max_weight",
+                     "min_bias", "max_bias"):
+            put(slot, (1,))
     elif op in ("Convolution",) and data is not None:
         layout = attrs.layout or ""
         c = data[1] if not layout or layout.startswith("NC") else data[-1]

@@ -1,0 +1,131 @@
+"""INT8 quantization tests (reference
+tests/python/quantization/test_quantization.py): op-level semantics +
+the quantize_model graph pass with naive calibration and dynamic ranges."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_quantize_dequantize_int8_roundtrip():
+    x = nd.array(np.array([[-2.0, 0.5, 1.0, 3.0]], np.float32))
+    q, mn, mx_ = mx.nd.contrib.quantize(
+        x, nd.array([-2.0]), nd.array([3.0]), out_type="int8")
+    assert q.asnumpy().dtype == np.int8
+    # symmetric: range is max(|min|, |max|)
+    np.testing.assert_allclose(mn.asnumpy(), -3.0)
+    np.testing.assert_allclose(mx_.asnumpy(), 3.0)
+    back = mx.nd.contrib.dequantize(q, mn, mx_)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy(),
+                               atol=3 / 127 + 1e-6)
+
+
+def test_quantize_uint8():
+    x = nd.array(np.array([0.0, 0.5, 1.0], np.float32))
+    q, mn, mx_ = mx.nd.contrib.quantize(
+        x, nd.array([0.0]), nd.array([1.0]), out_type="uint8")
+    assert q.asnumpy().dtype == np.uint8
+    np.testing.assert_allclose(q.asnumpy(), [0, 128, 255])
+    back = mx.nd.contrib.dequantize(q, mn, mx_)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=1 / 255)
+
+
+def test_quantized_fc_matches_float():
+    rng = np.random.RandomState(0)
+    d = rng.randn(4, 8).astype(np.float32)
+    w = (rng.randn(16, 8) * 0.2).astype(np.float32)
+    qd, dmin, dmax = mx.nd.contrib.quantize(
+        nd.array(d), nd.array([d.min()]), nd.array([d.max()]),
+        out_type="int8")
+    qw, wmin, wmax = mx.nd.contrib.quantize(
+        nd.array(w), nd.array([w.min()]), nd.array([w.max()]),
+        out_type="int8")
+    acc, amin, amax = mx.nd.contrib.quantized_fully_connected(
+        qd, qw, dmin, dmax, wmin, wmax, num_hidden=16, no_bias=True)
+    assert acc.asnumpy().dtype == np.int32
+    out = mx.nd.contrib.dequantize(acc, amin, amax)
+    np.testing.assert_allclose(out.asnumpy(), d @ w.T, rtol=0.1, atol=0.05)
+
+
+def test_quantized_conv_matches_float():
+    rng = np.random.RandomState(1)
+    d = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = (rng.randn(4, 3, 3, 3) * 0.2).astype(np.float32)
+    qd, dmin, dmax = mx.nd.contrib.quantize(
+        nd.array(d), nd.array([d.min()]), nd.array([d.max()]),
+        out_type="int8")
+    qw, wmin, wmax = mx.nd.contrib.quantize(
+        nd.array(w), nd.array([w.min()]), nd.array([w.max()]),
+        out_type="int8")
+    acc, amin, amax = mx.nd.contrib.quantized_conv(
+        qd, qw, dmin, dmax, wmin, wmax, kernel=(3, 3), num_filter=4,
+        pad=(1, 1), no_bias=True)
+    out = mx.nd.contrib.dequantize(acc, amin, amax).asnumpy()
+    ref = mx.nd.Convolution(nd.array(d), nd.array(w), kernel=(3, 3),
+                            num_filter=4, pad=(1, 1), no_bias=True).asnumpy()
+    assert np.abs(out - ref).max() < 0.25
+    assert np.corrcoef(out.ravel(), ref.ravel())[0, 1] > 0.999
+
+
+def test_requantize_with_calibration():
+    acc = nd.array(np.array([1000000, -500000, 0], np.int32))
+    mn, mx_ = nd.array([-1.0]), nd.array([1.0])
+    q, qmn, qmx = mx.nd.contrib.requantize(
+        acc, mn, mx_, min_calib_range=-0.001, max_calib_range=0.001)
+    assert q.asnumpy().dtype == np.int8
+    np.testing.assert_allclose(qmx.asnumpy(), 0.001, rtol=1e-5)
+
+
+def _mlp_and_params(rng):
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    arg_shapes, _, _ = net.infer_shape(data=(32, 8))
+    args = {n: nd.array(rng.rand(*s).astype(np.float32) - 0.5)
+            for n, s in zip(net.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    return net, args
+
+
+@pytest.mark.parametrize("calib_mode", ["none", "naive"])
+def test_quantize_model(calib_mode):
+    rng = np.random.RandomState(0)
+    net, args = _mlp_and_params(rng)
+    calib = None
+    if calib_mode == "naive":
+        calib = mx.io.NDArrayIter(rng.rand(32, 8).astype(np.float32),
+                                  np.zeros(32, np.float32), batch_size=16)
+    qsym, qargs, _ = mx.contrib.quantization.quantize_model(
+        net, args, {}, calib_mode=calib_mode, calib_data=calib,
+        num_calib_examples=32)
+    # quantized weights became int8
+    assert qargs["fc1_weight"].asnumpy().dtype == np.int8
+    xt = rng.rand(8, 8).astype(np.float32)
+    outs = []
+    for sym, params in ((net, args), (qsym, qargs)):
+        ex = sym.simple_bind(mx.cpu(), data=(8, 8), grad_req="null")
+        ex.arg_dict["data"][:] = xt
+        for n, arr in ex.arg_dict.items():
+            if n in params:
+                arr._data = params[n]._data
+        outs.append(ex.forward(is_train=False)[0].asnumpy())
+    assert np.abs(outs[0] - outs[1]).max() < 0.05
+    assert (np.argmax(outs[0], 1) == np.argmax(outs[1], 1)).mean() >= 0.75
+
+
+def test_quantize_model_excluded_and_errors():
+    rng = np.random.RandomState(2)
+    net, args = _mlp_and_params(rng)
+    qsym, qargs, _ = mx.contrib.quantization.quantize_model(
+        net, args, {}, excluded_sym_names=["fc1", "fc2"], calib_mode="none")
+    # nothing quantized: weights stay float
+    assert qargs["fc1_weight"].asnumpy().dtype == np.float32
+    with pytest.raises(mx.MXNetError):
+        mx.contrib.quantization.quantize_model(net, args, {},
+                                               calib_mode="naive")
+    with pytest.raises(mx.MXNetError):
+        mx.contrib.quantization.quantize_model(net, args, {},
+                                               quantized_dtype="uint4")

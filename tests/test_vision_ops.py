@@ -1,0 +1,190 @@
+"""Vision/legacy op tail tests (reference test_operator.py coverage for
+SpatialTransformer, BilinearSampler, GridGenerator, Correlation,
+ROIPooling, Crop, fft/ifft, adaptive pooling, Proposal) + the Custom-op
+bridge (reference tests/python/unittest/test_operator.py:test_custom_op)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_grid_generator_affine_identity_and_sampler():
+    theta = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    grid = mx.nd.GridGenerator(theta, transform_type="affine",
+                               target_shape=(4, 4))
+    assert grid.shape == (1, 2, 4, 4)
+    img = nd.array(np.random.RandomState(0)
+                   .rand(1, 2, 4, 4).astype(np.float32))
+    out = mx.nd.BilinearSampler(img, grid)
+    np.testing.assert_allclose(out.asnumpy(), img.asnumpy(), atol=1e-5)
+
+
+def test_grid_generator_warp_zero_flow():
+    flow = nd.zeros((1, 2, 3, 5))
+    grid = mx.nd.GridGenerator(flow, transform_type="warp")
+    g = grid.asnumpy()
+    np.testing.assert_allclose(g[0, 0, 0], np.linspace(-1, 1, 5), atol=1e-6)
+    np.testing.assert_allclose(g[0, 1, :, 0], np.linspace(-1, 1, 3),
+                               atol=1e-6)
+
+
+def test_spatial_transformer_identity_and_gradient():
+    img_np = np.random.RandomState(1).rand(2, 3, 5, 5).astype(np.float32)
+    img = nd.array(img_np)
+    theta = nd.array(np.tile([1, 0, 0, 0, 1, 0], (2, 1)).astype(np.float32))
+    theta.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.SpatialTransformer(
+            img, theta, target_shape=(5, 5), transform_type="affine",
+            sampler_type="bilinear")
+        s = nd.sum(out)
+    np.testing.assert_allclose(out.asnumpy(), img_np, atol=1e-5)
+    s.backward()
+    assert np.isfinite(theta.grad.asnumpy()).all()
+
+
+def test_bilinear_sampler_shift():
+    # shifting the grid by one pixel in x samples the neighbor column
+    img = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    ys = np.linspace(-1, 1, 4)
+    xs = np.linspace(-1, 1, 4) + 2.0 / 3.0  # +1 pixel
+    gx, gy = np.meshgrid(xs, ys)
+    grid = nd.array(np.stack([gx, gy])[None].astype(np.float32))
+    out = mx.nd.BilinearSampler(img, grid).asnumpy()
+    ref = img.asnumpy()
+    np.testing.assert_allclose(out[0, 0, :, :3], ref[0, 0, :, 1:], atol=1e-4)
+    np.testing.assert_allclose(out[0, 0, :, 3], 0.0, atol=1e-5)  # zero pad
+
+
+def test_correlation_zero_displacement_is_mean_square():
+    rng = np.random.RandomState(2)
+    d = rng.rand(1, 3, 6, 6).astype(np.float32)
+    corr = mx.nd.Correlation(nd.array(d), nd.array(d), kernel_size=1,
+                             max_displacement=1, stride1=1, stride2=1,
+                             pad_size=1, is_multiply=True)
+    assert corr.shape == (1, 9, 6, 6)
+    center = corr.asnumpy()[0, 4]
+    np.testing.assert_allclose(center, (d * d).mean(axis=1)[0], rtol=1e-5)
+
+
+def test_roi_pooling():
+    data = nd.array(np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8))
+    rois = nd.array(np.array([[0, 0, 0, 7, 7]], np.float32))
+    rp = mx.nd.ROIPooling(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    np.testing.assert_allclose(rp.asnumpy().reshape(-1), [27, 31, 59, 63])
+
+
+def test_crop():
+    a = nd.array(np.random.RandomState(3).rand(1, 2, 6, 6)
+                 .astype(np.float32))
+    c = mx.nd.Crop(a, offset=(1, 2), h_w=(3, 3), num_args=1)
+    np.testing.assert_allclose(c.asnumpy(), a.asnumpy()[:, :, 1:4, 2:5])
+    like = nd.zeros((1, 2, 4, 4))
+    c2 = mx.nd.Crop(a, like, num_args=2, center_crop=True)
+    np.testing.assert_allclose(c2.asnumpy(), a.asnumpy()[:, :, 1:5, 1:5])
+
+
+def test_fft_ifft_roundtrip():
+    x = nd.array(np.random.RandomState(4).rand(3, 8).astype(np.float32))
+    f = mx.nd.contrib.fft(x)
+    assert f.shape == (3, 16)
+    # DC term interleaved at position 0 equals the row sum
+    np.testing.assert_allclose(f.asnumpy()[:, 0], x.asnumpy().sum(axis=1),
+                               rtol=1e-5)
+    back = mx.nd.contrib.ifft(f) / 8.0  # reference ifft is unnormalized
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=1e-5)
+
+
+def test_adaptive_avg_pooling():
+    a = nd.array(np.random.RandomState(5).rand(1, 2, 6, 6)
+                 .astype(np.float32))
+    ap = mx.nd.contrib.AdaptiveAvgPooling2D(a, output_size=(3, 3))
+    assert ap.shape == (1, 2, 3, 3)
+    np.testing.assert_allclose(ap.asnumpy()[0, 0, 0, 0],
+                               a.asnumpy()[0, 0, :2, :2].mean(), rtol=1e-5)
+    # uneven division: 5 -> 2 uses floor/ceil bins
+    b = nd.array(np.random.RandomState(6).rand(1, 1, 5, 5)
+                 .astype(np.float32))
+    ap2 = mx.nd.contrib.AdaptiveAvgPooling2D(b, output_size=(2, 2))
+    np.testing.assert_allclose(ap2.asnumpy()[0, 0, 0, 0],
+                               b.asnumpy()[0, 0, :3, :3].mean(), rtol=1e-5)
+
+
+def test_bilinear_resize():
+    a = nd.array(np.random.RandomState(7).rand(1, 2, 4, 4)
+                 .astype(np.float32))
+    br = mx.nd.contrib.BilinearResize2D(a, height=8, width=8)
+    assert br.shape == (1, 2, 8, 8)
+    # align_corners: corners map exactly
+    np.testing.assert_allclose(br.asnumpy()[..., 0, 0],
+                               a.asnumpy()[..., 0, 0], rtol=1e-5)
+    np.testing.assert_allclose(br.asnumpy()[..., -1, -1],
+                               a.asnumpy()[..., -1, -1], rtol=1e-5)
+
+
+def test_proposal_shapes_and_validity():
+    rng = np.random.RandomState(8)
+    B, A, H, W = 1, 3, 4, 4
+    cls_prob = nd.array(rng.rand(B, 2 * A, H, W).astype(np.float32))
+    bbox = nd.array((rng.rand(B, 4 * A, H, W).astype(np.float32) - 0.5) * 0.1)
+    im_info = nd.array(np.array([[64, 64, 1.0]], np.float32))
+    rois = mx.nd.Proposal(cls_prob, bbox, im_info, feature_stride=16,
+                          scales=(2.0,), ratios=(0.5, 1, 2),
+                          rpn_pre_nms_top_n=12, rpn_post_nms_top_n=5,
+                          rpn_min_size=1)
+    assert rois.shape == (5, 5)
+    r = rois.asnumpy()
+    assert (r[:, 0] == 0).all()
+    assert (r[:, 1] <= r[:, 3]).all() and (r[:, 2] <= r[:, 4]).all()
+    assert r[:, 1:].min() >= 0 and r[:, 3].max() <= 63
+
+
+# ---------------------------------------------------------------------------
+# Custom op bridge
+# ---------------------------------------------------------------------------
+
+
+@mx.operator.register("testsquare")
+class _SquareProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Square()
+
+
+class _Square(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], 2.0 * in_data[0] * out_grad[0])
+
+
+def test_custom_op_eager_forward_backward():
+    x = nd.array(np.array([1.0, -2.0, 3.0], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(x, op_type="testsquare")
+        loss = nd.sum(y)
+    np.testing.assert_allclose(y.asnumpy(), [1, 4, 9])
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2, -4, 6])
+
+
+def test_custom_op_symbolic_pure_callback():
+    d = mx.sym.var("d")
+    s = mx.sym.Custom(d, op_type="testsquare")
+    ex = s.simple_bind(mx.cpu(), d=(2, 2))
+    ex.arg_dict["d"][:] = np.array([[1, 2], [3, 4]], np.float32)
+    out = ex.forward(is_train=True)[0]
+    np.testing.assert_allclose(out.asnumpy(), [[1, 4], [9, 16]])
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["d"].asnumpy(),
+                               [[2, 4], [6, 8]])
+
+
+def test_custom_op_unregistered_raises():
+    with pytest.raises(mx.MXNetError):
+        nd.Custom(nd.ones((2,)), op_type="nope")
