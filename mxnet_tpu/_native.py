@@ -1,0 +1,142 @@
+"""ctypes bridge to the native C++ runtime (``src/*.cc`` → ``libmxtpu.so``).
+
+TPU-native re-design of the reference's C-ABI plumbing
+(``python/mxnet/base.py`` ``_load_lib``/``check_call`` over
+``include/mxnet/c_api.h``): a small flat C surface (storage pool, host
+dependency engine, RecordIO) loaded with ctypes. Unlike the reference —
+where the C library IS the framework — the compute path here is JAX/XLA and
+the native layer only owns host-side work, so everything degrades to pure
+Python when no C++ toolchain is available: every caller must handle
+``get_lib() is None``.
+
+The library is compiled on demand from the committed sources with g++ and
+cached next to them (``src/build/libmxtpu.so``), rebuilt when any source is
+newer than the binary.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import warnings
+from pathlib import Path
+from typing import Optional
+
+from .base import MXNetError
+
+__all__ = ["get_lib", "check_call", "native_available", "build_lib"]
+
+_SRC_DIR = Path(__file__).resolve().parent.parent / "src"
+_LIB_PATH = _SRC_DIR / "build" / "libmxtpu.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def build_lib(force: bool = False) -> Optional[Path]:
+    """Compile ``src/*.cc`` into ``libmxtpu.so`` if missing or stale."""
+    sources = sorted(_SRC_DIR.glob("*.cc"))
+    if not sources:
+        return None
+    if not force and _LIB_PATH.exists():
+        lib_mtime = _LIB_PATH.stat().st_mtime
+        if all(s.stat().st_mtime <= lib_mtime for s in sources + [_SRC_DIR / "mxtpu.h"]):
+            return _LIB_PATH
+    _LIB_PATH.parent.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        os.environ.get("CXX", "g++"), "-std=c++17", "-O2", "-fPIC", "-shared",
+        "-pthread", "-Wall", "-fvisibility=hidden",
+        "-I", str(_SRC_DIR),
+    ] + [str(s) for s in sources] + ["-o", str(_LIB_PATH)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True, timeout=300)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError) as exc:
+        detail = getattr(exc, "stderr", "") or str(exc)
+        warnings.warn("mxnet_tpu: native library build failed, falling back to "
+                      "pure Python: %s" % detail.strip()[:500])
+        return None
+    return _LIB_PATH
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.MXTPUGetLastError.restype = ctypes.c_char_p
+    lib.MXTPUGetLastError.argtypes = []
+    lib.MXTPUGetVersion.argtypes = [ctypes.POINTER(ctypes.c_int)]
+    # storage
+    lib.MXTPUStorageAlloc.argtypes = [ctypes.c_size_t, ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXTPUStorageFree.argtypes = [ctypes.c_void_p]
+    lib.MXTPUStorageDirectFree.argtypes = [ctypes.c_void_p]
+    lib.MXTPUStorageReleaseAll.argtypes = []
+    lib.MXTPUStorageStats.argtypes = [u64p] * 5
+    # engine
+    lib.MXTPUEngineNewVar.argtypes = [u64p]
+    lib.MXTPUEngineDeleteVar.argtypes = [ctypes.c_uint64]
+    lib.MXTPUEnginePushAsync.argtypes = [
+        ENGINE_FN_TYPE, ctypes.c_void_p, u64p, ctypes.c_int, u64p, ctypes.c_int,
+        ctypes.c_int, u64p,
+    ]
+    lib.MXTPUEngineWaitForVar.argtypes = [ctypes.c_uint64]
+    lib.MXTPUEngineWaitForAll.argtypes = []
+    lib.MXTPUEngineNumWorkers.argtypes = [ctypes.POINTER(ctypes.c_int)]
+    lib.MXTPUEngineIsNaive.argtypes = [ctypes.POINTER(ctypes.c_int)]
+    # recordio
+    vpp = ctypes.POINTER(ctypes.c_void_p)
+    lib.MXTPURecordIOWriterCreate.argtypes = [ctypes.c_char_p, vpp]
+    lib.MXTPURecordIOWriterWrite.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                             ctypes.c_size_t, u64p]
+    lib.MXTPURecordIOWriterTell.argtypes = [ctypes.c_void_p, u64p]
+    lib.MXTPURecordIOWriterClose.argtypes = [ctypes.c_void_p]
+    lib.MXTPURecordIOReaderCreate.argtypes = [ctypes.c_char_p, vpp]
+    lib.MXTPURecordIOReaderSeek.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.MXTPURecordIOReaderNext.argtypes = [ctypes.c_void_p,
+                                            ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+                                            ctypes.POINTER(ctypes.c_size_t)]
+    lib.MXTPURecordIOReaderTell.argtypes = [ctypes.c_void_p, u64p]
+    lib.MXTPURecordIOReaderClose.argtypes = [ctypes.c_void_p]
+
+
+#: Signature of an engine callback: ``int fn(void *arg)`` — nonzero return
+#: taints the op's mutable vars (async exception propagation).
+ENGINE_FN_TYPE = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None when unavailable.
+
+    Disable explicitly with ``MXNET_USE_NATIVE=0``.
+    """
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    with _lock:
+        if _load_attempted:
+            return _lib
+        if os.environ.get("MXNET_USE_NATIVE", "1") == "0":
+            _load_attempted = True
+            return None
+        path = build_lib()
+        if path is not None:
+            try:
+                lib = ctypes.CDLL(str(path))
+                _configure(lib)
+                _lib = lib
+            except OSError as exc:
+                warnings.warn("mxnet_tpu: failed to load native library: %s" % exc)
+        _load_attempted = True
+        return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def check_call(rc: int) -> None:
+    """Raise MXNetError with the thread-local native message on failure
+    (reference: ``python/mxnet/base.py`` ``check_call`` / MXGetLastError)."""
+    if rc != 0:
+        lib = get_lib()
+        msg = lib.MXTPUGetLastError().decode("utf-8") if lib is not None else "native call failed"
+        raise MXNetError(msg)

@@ -1,0 +1,297 @@
+"""Engine control: naive/debug mode, bulk hints, and the host dependency engine.
+
+Re-designs the reference's engine-facing Python surface:
+
+- ``python/mxnet/engine.py`` — ``bulk``/``set_bulk_size`` context manager
+  (reference env knobs ``MXNET_EXEC_BULK_EXEC_TRAIN/INFERENCE``,
+  ``src/engine/threaded_engine.cc:289,357``);
+- ``MXNET_ENGINE_TYPE=NaiveEngine`` (``src/engine/engine.cc:33-41``,
+  ``naive_engine.cc:50``) — the synchronous debug mode used to bisect
+  scheduling/race bugs and surface async errors at the faulting op;
+- ``Engine::PushAsync``/``NewVariable``/``WaitForVar``/``WaitForAll``
+  (``include/mxnet/engine.h:154-261``) — exposed here over the native C++
+  host engine (``src/engine.cc``) for host-side work (IO, checkpointing,
+  prefetch), with a synchronous pure-Python fallback when the native
+  library is unavailable.
+
+TPU mapping: device-side ordering/fusion is XLA+PJRT's job — JAX's async
+dispatch already gives the reference's compute/comm overlap, and ``jit``
+regions are the true "bulk" — so naive mode here means "block after every
+eager op" (exactly the reference's debugging semantics), and ``bulk`` is a
+hint that controls how aggressively eager code synchronizes, not a fusion
+pass.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from contextlib import contextmanager
+from typing import Callable, Dict, Optional, Sequence
+
+from . import _native
+from .base import MXNetError, get_env
+
+__all__ = [
+    "is_naive_mode", "set_naive_mode", "bulk", "set_bulk_size",
+    "push", "new_var", "delete_var", "wait_for_var", "wait_for_all",
+    "num_workers",
+]
+
+# ---------------------------------------------------------------------------
+# naive (synchronous debug) mode for the eager JAX path
+# ---------------------------------------------------------------------------
+
+_naive_mode: Optional[bool] = None
+_naive_lock = threading.Lock()
+
+
+def is_naive_mode() -> bool:
+    """True when every eager op must complete before returning
+    (``MXNET_ENGINE_TYPE=NaiveEngine``)."""
+    global _naive_mode
+    if _naive_mode is None:
+        with _naive_lock:
+            if _naive_mode is None:
+                _naive_mode = get_env("MXNET_ENGINE_TYPE", "ThreadedEngine") == "NaiveEngine"
+    return _naive_mode
+
+
+def set_naive_mode(value: bool) -> bool:
+    """Toggle naive mode programmatically; returns the previous value."""
+    global _naive_mode
+    prev = is_naive_mode()
+    _naive_mode = bool(value)
+    return prev
+
+
+def _sync_outputs(result) -> None:
+    """Block until `result` (NDArray or list thereof) is computed — the
+    NaiveEngine contract: errors surface at the op, not at a later wait."""
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(result, NDArray):
+        result._data.block_until_ready()
+    elif isinstance(result, (list, tuple)):
+        for r in result:
+            if isinstance(r, NDArray):
+                r._data.block_until_ready()
+
+
+# ---------------------------------------------------------------------------
+# bulk execution hints (reference python/mxnet/engine.py)
+# ---------------------------------------------------------------------------
+
+_bulk_size = threading.local()
+
+
+def set_bulk_size(size: int) -> int:
+    """Set the bulk-execution hint; returns the previous value.
+
+    The reference fuses up to `size` consecutive engine ops into one
+    scheduling unit. Under XLA the equivalent fusion happens inside ``jit``
+    compilation; eager JAX is already asynchronous, so the hint's observable
+    effect here is limited to naive mode, where a bulk region suspends the
+    per-op sync until the region ends.
+    """
+    prev = getattr(_bulk_size, "value", 0)
+    _bulk_size.value = int(size)
+    return prev
+
+
+def _in_bulk() -> bool:
+    return getattr(_bulk_size, "value", 0) > 1
+
+
+@contextmanager
+def bulk(size: int):
+    """Context manager form (reference ``with mx.engine.bulk(30): ...``)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
+        if is_naive_mode():
+            # The region suspended per-op syncs; settle both the JAX device
+            # stream and the host engine now so deferred failures surface here.
+            import jax
+
+            (jax.device_put(0.0) + 0).block_until_ready()
+            wait_for_all()
+
+
+def maybe_sync_eager(result) -> None:
+    """Called by the eager dispatcher after every op."""
+    if is_naive_mode() and not _in_bulk():
+        _sync_outputs(result)
+
+
+# ---------------------------------------------------------------------------
+# host dependency engine (native src/engine.cc; sync fallback without it)
+# ---------------------------------------------------------------------------
+
+# Correlation bookkeeping. The native callback may run BEFORE PushAsync
+# returns the native opr id, so exceptions are keyed by a Python-side id
+# passed through the callback's `arg` pointer; the native→python id mapping
+# is recorded after the push and consulted when a wait reports a failure.
+#
+# A SINGLE static ctypes trampoline dispatches every op by that id. This is
+# load-bearing: a per-push CFUNCTYPE closure would have to be freed at some
+# point, and freeing it while the native call is still returning through the
+# ffi thunk is a use-after-free — a static trampoline can never be collected.
+_pending_fns: Dict[int, Callable[[], None]] = {}   # py_id -> python fn
+_exc_by_pyid: Dict[int, BaseException] = {}        # py_id -> raised exception
+_native_to_py: Dict[int, int] = {}                 # native opr id -> py_id
+_done_pyids: list = []                             # successes pending pruning
+_cb_lock = threading.Lock()
+_next_pyid = 1
+
+
+def _dispatch(arg):
+    pid = int(arg) if arg else 0
+    with _cb_lock:
+        fn = _pending_fns.pop(pid, None)
+    if fn is None:
+        return 1
+    try:
+        fn()
+        with _cb_lock:
+            _done_pyids.append(pid)
+        return 0
+    except BaseException as exc:  # noqa: BLE001 - stored, re-raised at wait
+        with _cb_lock:
+            _exc_by_pyid[pid] = exc
+        return 1
+
+
+_TRAMPOLINE = _native.ENGINE_FN_TYPE(_dispatch)
+
+
+class _FallbackVar:
+    """Var handle when the native engine is absent (synchronous execution)."""
+
+    __slots__ = ("failed_exc",)
+
+    def __init__(self):
+        self.failed_exc: Optional[BaseException] = None
+
+
+def new_var():
+    """Allocate an engine variable (reference ``Engine::NewVariable``)."""
+    lib = _native.get_lib()
+    if lib is None:
+        return _FallbackVar()
+    out = ctypes.c_uint64()
+    _native.check_call(lib.MXTPUEngineNewVar(ctypes.byref(out)))
+    return out.value
+
+def delete_var(var) -> None:
+    lib = _native.get_lib()
+    if lib is None or isinstance(var, _FallbackVar):
+        return
+    _native.check_call(lib.MXTPUEngineDeleteVar(ctypes.c_uint64(var)))
+
+
+def push(fn: Callable[[], None], const_vars: Sequence = (),
+         mutable_vars: Sequence = (), priority: int = 0) -> int:
+    """Schedule ``fn()`` on the host engine once all dependencies resolve
+    (reference ``Engine::PushAsync``, include/mxnet/engine.h:203).
+
+    Readers (``const_vars``) run concurrently; writers (``mutable_vars``)
+    exclusively, FIFO w.r.t. conflicting ops. An exception raised by ``fn``
+    taints its mutable vars and is re-raised at :func:`wait_for_var` /
+    :func:`wait_for_all` (async exception propagation,
+    reference src/engine/threaded_engine.h:441-444).
+    """
+    global _next_pyid
+    lib = _native.get_lib()
+    if lib is None:
+        try:
+            fn()
+        except BaseException as exc:  # noqa: BLE001 - stored, re-raised at wait
+            for v in mutable_vars:
+                if isinstance(v, _FallbackVar):
+                    v.failed_exc = exc
+            return -1
+        return 0
+
+    with _cb_lock:
+        py_id = _next_pyid
+        _next_pyid += 1
+        _pending_fns[py_id] = fn
+        _prune_locked()
+    cvars = (ctypes.c_uint64 * max(1, len(const_vars)))(*[int(v) for v in const_vars])
+    mvars = (ctypes.c_uint64 * max(1, len(mutable_vars)))(*[int(v) for v in mutable_vars])
+    opr_id = ctypes.c_uint64()
+    rc = lib.MXTPUEnginePushAsync(
+        _TRAMPOLINE, ctypes.c_void_p(py_id), cvars, len(const_vars), mvars,
+        len(mutable_vars), priority, ctypes.byref(opr_id))
+    if rc != 0:
+        with _cb_lock:
+            _pending_fns.pop(py_id, None)
+            exc = _exc_by_pyid.pop(py_id, None)
+        if exc is not None:  # naive mode runs inline: surface at the push
+            raise exc
+        _native.check_call(rc)
+    with _cb_lock:
+        _native_to_py[opr_id.value] = py_id
+    return opr_id.value
+
+
+def _prune_locked() -> None:
+    """Drop bookkeeping for completed-successfully ops (bounded memory for
+    long-running pipelines). Called with _cb_lock held."""
+    if len(_done_pyids) < 512:
+        return
+    done = set(_done_pyids)
+    _done_pyids.clear()
+    for nid in [n for n, p in _native_to_py.items() if p in done]:
+        del _native_to_py[nid]
+
+
+def _raise_stored(err_msg: str) -> None:
+    """Map 'async operator N failed' back to the original Python exception."""
+    opr_id = None
+    try:
+        opr_id = int(err_msg.strip().split()[2])
+    except (IndexError, ValueError):
+        pass
+    with _cb_lock:
+        py_id = _native_to_py.pop(opr_id, None)
+        exc = _exc_by_pyid.pop(py_id, None) if py_id is not None else None
+    if exc is not None:
+        raise exc
+    raise MXNetError(err_msg)
+
+
+def wait_for_var(var) -> None:
+    """Block until all ops touching ``var`` finished; re-raises the first
+    async failure that wrote it (reference ``Engine::WaitForVar``)."""
+    lib = _native.get_lib()
+    if lib is None or isinstance(var, _FallbackVar):
+        if isinstance(var, _FallbackVar) and var.failed_exc is not None:
+            exc, var.failed_exc = var.failed_exc, None
+            raise exc
+        return
+    rc = lib.MXTPUEngineWaitForVar(ctypes.c_uint64(var))
+    if rc != 0:
+        _raise_stored(lib.MXTPUGetLastError().decode("utf-8"))
+
+
+def wait_for_all() -> None:
+    """Block until the host engine drains (reference ``Engine::WaitForAll``)."""
+    lib = _native.get_lib()
+    if lib is None:
+        return
+    rc = lib.MXTPUEngineWaitForAll()
+    if rc != 0:
+        _raise_stored(lib.MXTPUGetLastError().decode("utf-8"))
+
+
+def num_workers() -> int:
+    lib = _native.get_lib()
+    if lib is None:
+        return 0
+    out = ctypes.c_int()
+    _native.check_call(lib.MXTPUEngineNumWorkers(ctypes.byref(out)))
+    return out.value

@@ -477,6 +477,7 @@ class NDArray:
 
 
 from .. import profiler as _profiler
+from .. import engine as _engine
 
 
 @_profiler.profiled("operator", lambda op_name, *i, **kw: op_name)
@@ -565,12 +566,16 @@ def invoke(op_name: str, *inputs, out=None, **kwargs):
         if isinstance(out, NDArray) and isinstance(result, NDArray):
             out._data = result._data
             out._entry = result._entry
-            return out
-        if isinstance(out, (list, tuple)):
+            result = out
+        elif isinstance(out, (list, tuple)):
             for o, r in zip(out, result):
                 o._data = r._data
                 o._entry = r._entry
-            return out
+            result = out
+    # NaiveEngine debug mode (MXNET_ENGINE_TYPE=NaiveEngine): block until the
+    # op completes so failures surface here, not at a later wait — reference
+    # src/engine/naive_engine.cc:50 semantics.
+    _engine.maybe_sync_eager(result)
     return result
 
 
@@ -643,8 +648,11 @@ stack = stack_arrays
 
 
 def waitall():
-    """Block until all async computation completes (reference mx.nd.waitall)."""
+    """Block until all async computation completes (reference mx.nd.waitall →
+    Engine::WaitForAll): drains the JAX dispatch stream and the native host
+    engine, re-raising any pending async failure from the latter."""
     (jax.device_put(0.0) + 0).block_until_ready()
+    _engine.wait_for_all()
 
 
 def onehot_encode(indices, out):

@@ -27,34 +27,70 @@ _LREC_FLAG_BITS = 29
 _LREC_MASK = (1 << _LREC_FLAG_BITS) - 1
 
 
+_MAGIC_BYTES = struct.pack("<I", _MAGIC)
+
+
 class MXRecordIO(object):
-    """Sequential .rec reader/writer (reference recordio.py:36)."""
+    """Sequential .rec reader/writer (reference recordio.py:36).
+
+    Uses the native C++ reader/writer (``src/recordio.cc`` via
+    :mod:`mxnet_tpu._native`) when available — the data path that feeds the
+    TPU input pipeline — and an equivalent pure-Python implementation
+    otherwise. Both speak full dmlc framing including continuation records:
+    payloads are split at embedded magic words on write (cflag 1/2/3) and
+    the magic is re-inserted between chunks on read, so a scanning reader
+    can always re-synchronize on the magic.
+    """
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.pid = None
         self.fid = None
+        self._nat = None  # native handle (writer or reader)
         self.open()
 
+    def _native_lib(self):
+        from . import _native
+
+        return _native.get_lib()
+
     def open(self):
+        import ctypes
+
+        lib = self._native_lib()
         if self.flag == "w":
-            self.fid = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.fid = open(self.uri, "rb")
             self.writable = False
         else:
             raise MXNetError("Invalid flag %s" % self.flag)
+        if lib is not None:
+            from . import _native
+
+            h = ctypes.c_void_p()
+            if self.writable:
+                _native.check_call(lib.MXTPURecordIOWriterCreate(
+                    self.uri.encode(), ctypes.byref(h)))
+            else:
+                _native.check_call(lib.MXTPURecordIOReaderCreate(
+                    self.uri.encode(), ctypes.byref(h)))
+            self._nat = h
+        else:
+            self.fid = open(self.uri, "wb" if self.writable else "rb")
         self.pid = os.getpid()
 
     def __del__(self):
-        self.close()
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - never raise from a destructor
+            pass
 
     def __getstate__(self):
         d = dict(self.__dict__)
         d["fid"] = None
         d["pid"] = None
+        d["_nat"] = None
         return d
 
     def __setstate__(self, d):
@@ -69,8 +105,20 @@ class MXRecordIO(object):
                 raise MXNetError("forked process must call reset() first")
 
     def close(self):
+        if self._nat is not None:
+            lib = self._native_lib()
+            nat, self._nat = self._nat, None
+            if lib is not None:
+                if self.writable:
+                    # a failed close means a failed flush — surface it
+                    from . import _native
+
+                    _native.check_call(lib.MXTPURecordIOWriterClose(nat))
+                else:
+                    lib.MXTPURecordIOReaderClose(nat)
         if self.fid is not None and not self.fid.closed:
             self.fid.close()
+        self.fid = None
         self.pid = None
 
     def reset(self):
@@ -78,20 +126,63 @@ class MXRecordIO(object):
         self.open()
 
     def write(self, buf):
-        """Write one record (dmlc framing, single chunk)."""
+        """Write one record (dmlc framing; multi-chunk when the payload
+        embeds the magic word)."""
         assert self.writable
         self._check_pid(allow_reset=False)
-        lrec = len(buf) & _LREC_MASK
-        self.fid.write(struct.pack("<II", _MAGIC, lrec))
-        self.fid.write(buf)
-        pad = (4 - (len(buf) % 4)) % 4
-        if pad:
-            self.fid.write(b"\x00" * pad)
+        if self._nat is not None:
+            import ctypes
+
+            from . import _native
+
+            lib = self._native_lib()
+            pos = ctypes.c_uint64()
+            _native.check_call(lib.MXTPURecordIOWriterWrite(
+                self._nat, bytes(buf), len(buf), ctypes.byref(pos)))
+            return
+        # split the payload at embedded magic words (dmlc recordio encode)
+        parts = []
+        start = 0
+        while True:
+            hit = buf.find(_MAGIC_BYTES, start)
+            if hit < 0:
+                parts.append(buf[start:])
+                break
+            parts.append(buf[start:hit])
+            start = hit + 4
+        for i, part in enumerate(parts):
+            if len(parts) == 1:
+                cflag = 0
+            elif i == 0:
+                cflag = 1
+            elif i == len(parts) - 1:
+                cflag = 3
+            else:
+                cflag = 2
+            lrec = (cflag << _LREC_FLAG_BITS) | (len(part) & _LREC_MASK)
+            self.fid.write(struct.pack("<II", _MAGIC, lrec))
+            self.fid.write(part)
+            pad = (4 - (len(part) % 4)) % 4
+            if pad:
+                self.fid.write(b"\x00" * pad)
 
     def read(self):
         """Read next record or None at EOF."""
         assert not self.writable
         self._check_pid(allow_reset=True)
+        if self._nat is not None:
+            import ctypes
+
+            from . import _native
+
+            lib = self._native_lib()
+            out = ctypes.POINTER(ctypes.c_char)()
+            size = ctypes.c_size_t()
+            _native.check_call(lib.MXTPURecordIOReaderNext(
+                self._nat, ctypes.byref(out), ctypes.byref(size)))
+            if not out:
+                return None
+            return ctypes.string_at(out, size.value)
         head = self.fid.read(8)
         if len(head) < 8:
             return None
@@ -106,7 +197,7 @@ class MXRecordIO(object):
             self.fid.read(pad)
         if cflag == 0:
             return payload
-        # multi-chunk record: continue until end flag (cflag 3)
+        # multi-chunk record: rejoin with the magic word the writer removed
         chunks = [payload]
         while cflag in (1, 2):
             head = self.fid.read(8)
@@ -115,6 +206,7 @@ class MXRecordIO(object):
                 raise MXNetError("Invalid RecordIO magic in continuation")
             cflag = lrec >> _LREC_FLAG_BITS
             length = lrec & _LREC_MASK
+            chunks.append(_MAGIC_BYTES)
             chunks.append(self.fid.read(length))
             pad = (4 - (length % 4)) % 4
             if pad:
@@ -122,6 +214,18 @@ class MXRecordIO(object):
         return b"".join(chunks)
 
     def tell(self):
+        if self._nat is not None:
+            import ctypes
+
+            from . import _native
+
+            lib = self._native_lib()
+            pos = ctypes.c_uint64()
+            if self.writable:
+                _native.check_call(lib.MXTPURecordIOWriterTell(self._nat, ctypes.byref(pos)))
+            else:
+                _native.check_call(lib.MXTPURecordIOReaderTell(self._nat, ctypes.byref(pos)))
+            return pos.value
         return self.fid.tell()
 
 
@@ -148,7 +252,8 @@ class MXIndexedRecordIO(MXRecordIO):
                     self.keys.append(key)
 
     def close(self):
-        if self.writable and self.fid is not None and not self.fid.closed:
+        is_open = self._nat is not None or (self.fid is not None and not self.fid.closed)
+        if self.writable and is_open:
             with open(self.idx_path, "w") as fout:
                 for k in self.keys:
                     fout.write("%s\t%d\n" % (str(k), self.idx[k]))
@@ -157,7 +262,15 @@ class MXIndexedRecordIO(MXRecordIO):
     def seek(self, idx):
         assert not self.writable
         self._check_pid(allow_reset=True)
-        self.fid.seek(self.idx[idx])
+        if self._nat is not None:
+            import ctypes
+
+            from . import _native
+
+            _native.check_call(self._native_lib().MXTPURecordIOReaderSeek(
+                self._nat, ctypes.c_uint64(self.idx[idx])))
+        else:
+            self.fid.seek(self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)

@@ -1,0 +1,71 @@
+/*
+ * Thread-local error stack + library init (signal/fork handlers).
+ *
+ * Re-designs the roles of the reference's src/c_api/c_api_error.cc
+ * (MXGetLastError thread-local string) and src/initialize.cc (segfault
+ * backtrace handler, fork handlers around the engine). Not a port; the
+ * TPU build only needs host-side handlers — device state is owned by PJRT.
+ */
+#include "mxtpu.h"
+
+#include <execinfo.h>
+#include <pthread.h>
+#include <signal.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+namespace mxtpu {
+
+static thread_local std::string g_last_error;
+
+void SetLastError(const std::string &msg) { g_last_error = msg; }
+
+// Engine hooks implemented in engine.cc; used by the fork handlers so a
+// fork() (DataLoader workers) never inherits a half-locked thread pool.
+void EngineStopWorkers();
+void EngineStartWorkers();
+void EngineAtForkChild();
+
+namespace {
+
+void SegfaultHandler(int sig) {
+  void *frames[32];
+  int n = backtrace(frames, 32);
+  fprintf(stderr, "\nmxtpu: caught fatal signal %d; backtrace (%d frames):\n", sig, n);
+  backtrace_symbols_fd(frames, n, STDERR_FILENO);
+  signal(sig, SIG_DFL);
+  raise(sig);
+}
+
+void PrepareFork() { EngineStopWorkers(); }
+void ParentAfterFork() { EngineStartWorkers(); }
+void ChildAfterFork() { EngineAtForkChild(); }
+
+struct LibraryInit {
+  LibraryInit() {
+    const char *env = getenv("MXNET_USE_SIGNAL_HANDLER");
+    if (env != nullptr && std::string(env) == "1") {
+      signal(SIGSEGV, SegfaultHandler);
+      signal(SIGBUS, SegfaultHandler);
+    }
+    pthread_atfork(PrepareFork, ParentAfterFork, ChildAfterFork);
+  }
+};
+static LibraryInit g_library_init;
+
+}  // namespace
+}  // namespace mxtpu
+
+extern "C" {
+
+const char *MXTPUGetLastError(void) { return mxtpu::g_last_error.c_str(); }
+
+int MXTPUGetVersion(int *out) {
+  *out = 10300;  // capability parity target: reference 1.3.0
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,91 @@
+/*
+ * mxtpu.h — C ABI for the mxnet_tpu native runtime.
+ *
+ * TPU-native re-design of the roles played in the reference by
+ * include/mxnet/c_api.h (flat C entry points, thread-local error string —
+ * reference src/c_api/c_api_error.cc), include/mxnet/storage.h +
+ * src/storage/pooled_storage_manager.h (size-bucketed pooled allocator),
+ * include/mxnet/engine.h:154-261 (PushAsync/NewVariable/WaitForVar/WaitForAll
+ * with per-variable read/write dependency resolution,
+ * src/engine/threaded_engine.h:115-206) and python/mxnet/recordio.py /
+ * dmlc-core RecordIO framing.
+ *
+ * On TPU the device-side scheduling and HBM allocation are owned by
+ * XLA/PJRT; this native layer owns what stays on the HOST: pinned staging
+ * buffers for the input pipeline, ordering of host-side ops (file IO,
+ * checkpoint writes, prefetch) and the .rec data path. No code is copied
+ * from the reference.
+ */
+#ifndef MXTPU_H_
+#define MXTPU_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MXTPU_API __attribute__((visibility("default")))
+
+/* ---- error handling (reference: src/c_api/c_api_error.cc) ---- */
+/* Every entry point returns 0 on success, -1 on failure; the message is
+ * retrievable (thread-local) via MXTPUGetLastError. */
+MXTPU_API const char *MXTPUGetLastError(void);
+MXTPU_API int MXTPUGetVersion(int *out);
+
+/* ---- storage manager (reference: src/storage/pooled_storage_manager.h) ---- */
+/* Size-bucketed (next-pow2) free-list pool for host staging memory.
+ * Env knobs: MXNET_HOST_MEM_POOL_TYPE=pooled|naive,
+ * MXNET_HOST_MEM_POOL_RESERVE (percent of pooled bytes kept on trim). */
+MXTPU_API int MXTPUStorageAlloc(size_t size, void **out);
+MXTPU_API int MXTPUStorageFree(void *ptr);        /* return to pool */
+MXTPU_API int MXTPUStorageDirectFree(void *ptr);  /* bypass pool */
+MXTPU_API int MXTPUStorageReleaseAll(void);       /* drop all pooled buffers */
+MXTPU_API int MXTPUStorageStats(uint64_t *bytes_in_use, uint64_t *bytes_pooled,
+                                uint64_t *peak_bytes, uint64_t *num_alloc,
+                                uint64_t *num_pool_hit);
+
+/* ---- dependency engine (reference: include/mxnet/engine.h:154-261) ---- */
+typedef uint64_t MXTPUVarHandle;
+/* Callback executed on a worker thread. Return 0 on success; nonzero marks
+ * the op's mutable vars as failed (async exception propagation — reference
+ * src/engine/threaded_engine.h:179-180,441-444) and the opr id is reported
+ * by the failing MXTPUEngineWaitForVar. */
+typedef int (*MXTPUEngineFn)(void *arg);
+
+MXTPU_API int MXTPUEngineNewVar(MXTPUVarHandle *out);
+MXTPU_API int MXTPUEngineDeleteVar(MXTPUVarHandle var);
+MXTPU_API int MXTPUEnginePushAsync(MXTPUEngineFn fn, void *arg,
+                                   const MXTPUVarHandle *const_vars, int num_const,
+                                   const MXTPUVarHandle *mutable_vars, int num_mutable,
+                                   int priority, uint64_t *out_opr_id);
+/* Blocks until all ops touching `var` completed. Returns -1 with error
+ * "async operator <id> failed" if a failed op wrote this var. */
+MXTPU_API int MXTPUEngineWaitForVar(MXTPUVarHandle var);
+MXTPU_API int MXTPUEngineWaitForAll(void);
+MXTPU_API int MXTPUEngineNumWorkers(int *out);
+/* 1 when MXNET_ENGINE_TYPE=NaiveEngine (synchronous debug mode — reference
+ * src/engine/naive_engine.cc:50). */
+MXTPU_API int MXTPUEngineIsNaive(int *out);
+
+/* ---- RecordIO (reference framing: python/mxnet/recordio.py:291-367 /
+ * dmlc-core recordio; magic 0xced7230a, lrec = cflag<<29 | len) ---- */
+MXTPU_API int MXTPURecordIOWriterCreate(const char *path, void **out);
+MXTPU_API int MXTPURecordIOWriterWrite(void *handle, const char *buf, size_t size,
+                                       uint64_t *out_pos);
+MXTPU_API int MXTPURecordIOWriterTell(void *handle, uint64_t *out_pos);
+MXTPU_API int MXTPURecordIOWriterClose(void *handle);
+MXTPU_API int MXTPURecordIOReaderCreate(const char *path, void **out);
+MXTPU_API int MXTPURecordIOReaderSeek(void *handle, uint64_t pos);
+/* Returns the next record. *out points into a handle-owned buffer valid
+ * until the next call on the same handle; *out==NULL at EOF. */
+MXTPU_API int MXTPURecordIOReaderNext(void *handle, const char **out, size_t *out_size);
+MXTPU_API int MXTPURecordIOReaderTell(void *handle, uint64_t *out_pos);
+MXTPU_API int MXTPURecordIOReaderClose(void *handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_H_ */

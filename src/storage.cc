@@ -1,0 +1,160 @@
+/*
+ * Pooled host storage manager.
+ *
+ * Re-designs the reference's src/storage/ layer (StorageImpl dispatch at
+ * storage.cc:52-137; GPUPooledStorageManager's size-bucketed free lists,
+ * pooled_storage_manager.h:52-59) for the TPU build: HBM is owned by
+ * XLA/PJRT, so the pool here serves HOST staging memory — input-pipeline
+ * batch buffers, RecordIO scratch, checkpoint serialization — where malloc
+ * churn is the reference's same problem. Buckets are next-power-of-two
+ * free lists; MXNET_HOST_MEM_POOL_TYPE=naive disables pooling;
+ * MXNET_HOST_MEM_POOL_RESERVE keeps only that percentage of pooled bytes
+ * on ReleaseAll (mirrors MXNET_GPU_MEM_POOL_RESERVE semantics,
+ * reference pooled_storage_manager.h:58).
+ */
+#include "mxtpu.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace mxtpu {
+
+void SetLastError(const std::string &msg);
+
+namespace {
+
+struct Pool {
+  std::mutex mu;
+  // bucket index (log2 of rounded size) -> free blocks
+  std::unordered_map<int, std::vector<void *>> free_lists;
+  std::unordered_map<void *, size_t> live;   // ptr -> rounded size
+  uint64_t bytes_in_use = 0;
+  uint64_t bytes_pooled = 0;
+  uint64_t peak_bytes = 0;
+  uint64_t num_alloc = 0;
+  uint64_t num_pool_hit = 0;
+  bool pooled;
+
+  Pool() {
+    const char *t = getenv("MXNET_HOST_MEM_POOL_TYPE");
+    pooled = (t == nullptr || std::string(t) != "naive");
+  }
+};
+
+Pool &pool() {
+  static Pool p;
+  return p;
+}
+
+int Bucket(size_t size) {
+  int b = 5;  // minimum bucket 32 bytes
+  while ((size_t{1} << b) < size) ++b;
+  return b;
+}
+
+}  // namespace
+}  // namespace mxtpu
+
+extern "C" {
+
+int MXTPUStorageAlloc(size_t size, void **out) {
+  using mxtpu::pool;
+  if (size == 0) size = 1;
+  auto &p = pool();
+  std::lock_guard<std::mutex> lock(p.mu);
+  int b = mxtpu::Bucket(size);
+  size_t rounded = size_t{1} << b;
+  void *ptr = nullptr;
+  auto it = p.free_lists.find(b);
+  if (p.pooled && it != p.free_lists.end() && !it->second.empty()) {
+    ptr = it->second.back();
+    it->second.pop_back();
+    p.bytes_pooled -= rounded;
+    ++p.num_pool_hit;
+  } else {
+    ptr = std::malloc(rounded);
+    if (ptr == nullptr) {
+      mxtpu::SetLastError("MXTPUStorageAlloc: out of host memory");
+      return -1;
+    }
+  }
+  p.live[ptr] = rounded;
+  p.bytes_in_use += rounded;
+  if (p.bytes_in_use > p.peak_bytes) p.peak_bytes = p.bytes_in_use;
+  ++p.num_alloc;
+  *out = ptr;
+  return 0;
+}
+
+int MXTPUStorageFree(void *ptr) {
+  using mxtpu::pool;
+  auto &p = pool();
+  std::lock_guard<std::mutex> lock(p.mu);
+  auto it = p.live.find(ptr);
+  if (it == p.live.end()) {
+    mxtpu::SetLastError("MXTPUStorageFree: unknown pointer");
+    return -1;
+  }
+  size_t rounded = it->second;
+  p.live.erase(it);
+  p.bytes_in_use -= rounded;
+  if (p.pooled) {
+    p.free_lists[mxtpu::Bucket(rounded)].push_back(ptr);
+    p.bytes_pooled += rounded;
+  } else {
+    std::free(ptr);
+  }
+  return 0;
+}
+
+int MXTPUStorageDirectFree(void *ptr) {
+  using mxtpu::pool;
+  auto &p = pool();
+  std::lock_guard<std::mutex> lock(p.mu);
+  auto it = p.live.find(ptr);
+  if (it == p.live.end()) {
+    mxtpu::SetLastError("MXTPUStorageDirectFree: unknown pointer");
+    return -1;
+  }
+  p.bytes_in_use -= it->second;
+  p.live.erase(it);
+  std::free(ptr);
+  return 0;
+}
+
+int MXTPUStorageReleaseAll(void) {
+  using mxtpu::pool;
+  auto &p = pool();
+  std::lock_guard<std::mutex> lock(p.mu);
+  int reserve = 0;
+  if (const char *r = getenv("MXNET_HOST_MEM_POOL_RESERVE")) reserve = atoi(r);
+  uint64_t keep = p.bytes_pooled * reserve / 100;
+  for (auto &kv : p.free_lists) {
+    size_t rounded = size_t{1} << kv.first;
+    while (!kv.second.empty() && p.bytes_pooled > keep) {
+      std::free(kv.second.back());
+      kv.second.pop_back();
+      p.bytes_pooled -= rounded;
+    }
+  }
+  return 0;
+}
+
+int MXTPUStorageStats(uint64_t *bytes_in_use, uint64_t *bytes_pooled, uint64_t *peak_bytes,
+                      uint64_t *num_alloc, uint64_t *num_pool_hit) {
+  using mxtpu::pool;
+  auto &p = pool();
+  std::lock_guard<std::mutex> lock(p.mu);
+  *bytes_in_use = p.bytes_in_use;
+  *bytes_pooled = p.bytes_pooled;
+  *peak_bytes = p.peak_bytes;
+  *num_alloc = p.num_alloc;
+  *num_pool_hit = p.num_pool_hit;
+  return 0;
+}
+
+}  // extern "C"
