@@ -91,20 +91,26 @@ class Context:
 
 
 def _devices_by_platform(platform: str):
+    """Addressable devices of a platform. Under ``jax.distributed`` a context
+    names a device of THIS process (the reference's ``mx.gpu(i)`` is likewise
+    worker-local); other processes' devices are only reachable through
+    collectives, so they never back an NDArray."""
     try:
-        return jax.devices(platform)
+        devs = jax.devices(platform)
     except RuntimeError:
         return []
+    local = [d for d in devs if d.process_index == jax.process_index()]
+    return local or devs
 
 
 _ACCEL_CACHE = None
 
 
 def _accelerator_devices():
-    """All non-CPU jax devices (TPU first), cached."""
+    """Process-local non-CPU jax devices (TPU first), cached."""
     global _ACCEL_CACHE
     if _ACCEL_CACHE is None:
-        devs = [d for d in jax.devices() if d.platform != "cpu"]
+        devs = [d for d in jax.local_devices() if d.platform != "cpu"]
         _ACCEL_CACHE = devs
     return _ACCEL_CACHE
 

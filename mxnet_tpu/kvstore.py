@@ -26,6 +26,7 @@ quantize/dequantize pair.
 """
 from __future__ import annotations
 
+import os
 import pickle
 from typing import Any, Dict, List, Optional
 
@@ -139,7 +140,15 @@ class KVStore(object):
                 dst._data = gathered
 
     def _reduce(self, datas: List[Any]):
-        """Sum per-device gradient copies (reference comm.h Reduce)."""
+        """Sum per-device gradient copies (reference comm.h Reduce: CommCPU
+        gathers to one place and tree-sums, CommDevice reduces on a root
+        GPU). Copies committed to different devices are first brought to the
+        first copy's device — XLA cannot add across committed placements."""
+        if len(datas) > 1:
+            devs = {d for a in datas for d in a.devices()}
+            if len(devs) > 1:
+                root = next(iter(datas[0].devices()))
+                datas = [jax.device_put(a, root) for a in datas]
         acc = datas[0]
         for d in datas[1:]:
             acc = acc + d
@@ -346,6 +355,40 @@ def _key_value_pairs(key, value):
             isinstance(value[0], (list, tuple)):
         raise MXNetError("nested value lists need a key list")
     return [(_key(key), value)]
+
+
+_DIST_INITIALIZED = False
+
+
+def init_distributed(coordinator=None, num_workers=None, rank=None):
+    """Join the multi-process jax runtime using the rendezvous info planted
+    by ``tools/launch.py`` (or given explicitly).
+
+    TPU-native replacement for the reference's ps-lite rendezvous
+    (``kvstore_dist.h:50-58``: ``ps::KVWorker`` ctor + scheduler barrier,
+    env ``DMLC_PS_ROOT_URI``/``DMLC_ROLE`` planted by ``tools/launch.py``).
+    There is no server role: after this call every process sees the global
+    device set, ``kv.rank``/``kv.num_workers`` reflect the job, and push
+    lowers to XLA collectives over ICI/DCN instead of ZPush RPCs.
+
+    No-op when no launcher environment is present and no arguments are
+    given (single-process mode).
+    """
+    global _DIST_INITIALIZED
+    if _DIST_INITIALIZED:
+        return True
+    coordinator = coordinator or os.environ.get("MXNET_COORDINATOR_ADDR")
+    num_workers = num_workers or os.environ.get("MXNET_NUM_WORKERS")
+    rank = rank if rank is not None else os.environ.get("MXNET_WORKER_RANK")
+    if coordinator is None or num_workers is None or rank is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(num_workers),
+        process_id=int(rank),
+    )
+    _DIST_INITIALIZED = True
+    return True
 
 
 def create(name="local"):

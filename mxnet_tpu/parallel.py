@@ -124,19 +124,38 @@ def all_reduce(arrays: List[Any], op: str = "sum"):
         return acc
     if jax.process_count() > 1:
         local = jax.local_devices()
-        if len(datas) != len(local):
-            raise MXNetError(
-                "multi-process all_reduce needs one gradient copy per local "
-                "device (%d devices, got %d arrays); use split_and_load over "
-                "all local devices" % (len(local), len(datas)))
-        mesh = Mesh(np.asarray(jax.devices()), ("dev",))
+        if len(datas) == len(local):
+            mesh = Mesh(np.asarray(jax.devices()), ("dev",))
+        else:
+            # arbitrary number of local copies: pre-reduce them on-device,
+            # then reduce the partials across processes on a one-device-per-
+            # process mesh (every process computes the same global ordering)
+            acc = datas[0]
+            for d in datas[1:]:
+                acc = acc + d
+            if op == "mean":
+                raise MXNetError("multi-process all_reduce(mean) needs one "
+                                 "copy per local device")
+            by_proc: Dict[int, Any] = {}
+            for d in jax.devices():
+                if d.process_index not in by_proc or d.id < by_proc[d.process_index].id:
+                    by_proc[d.process_index] = d
+            datas = [jax.device_put(acc, by_proc[jax.process_index()])]
+            mesh_devs = [by_proc[p] for p in sorted(by_proc)]
+            mesh = Mesh(np.asarray(mesh_devs), ("dev",))
     else:
         mesh = Mesh(np.asarray(devs), ("dev",))
     shape = (len(mesh.devices.flat),) + datas[0].shape
     sharding = NamedSharding(mesh, P("dev"))
     shards = [d.reshape((1,) + d.shape) for d in datas]  # leading shard axis
     stacked = jax.make_array_from_single_device_arrays(shape, sharding, shards)
-    return _reduce_fn(mesh, op)(stacked)
+    reduced = _reduce_fn(mesh, op)(stacked)
+    if jax.process_count() > 1:
+        # The jit output is replicated over the GLOBAL mesh; a global jax.Array
+        # is not addressable (asnumpy would raise) outside collectives, so hand
+        # back this process's fully-replicated local shard as a plain array.
+        return reduced.addressable_shards[0].data
+    return reduced
 
 
 _MULTI_REDUCE_JITS: Dict[Any, Any] = {}

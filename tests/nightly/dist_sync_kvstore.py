@@ -1,0 +1,90 @@
+"""Multi-process kvstore=dist_sync exact-value assertions.
+
+The TPU-native analogue of the reference's tests/nightly/dist_sync_kvstore.py
+(check_diff exact-value discipline, :30), launched as
+``python tools/launch.py -n 2 -- python tests/nightly/dist_sync_kvstore.py``.
+Each process contributes its host devices to one global jax runtime; pushes
+from every worker must aggregate identically on all of them.
+"""
+import sys
+import os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore
+
+
+def check_diff(arr, expected):
+    np.testing.assert_allclose(arr.asnumpy(), expected, rtol=1e-5, atol=1e-6)
+
+
+def main():
+    assert kvstore.init_distributed(), "launcher env missing"
+    import jax
+    kv = mx.kvstore.create("dist_sync")
+    nw = kv.num_workers
+    rank = kv.rank
+    assert nw == int(os.environ["MXNET_NUM_WORKERS"])
+    print("rank %d/%d global devices: %d" % (rank, nw, jax.device_count()))
+
+    shape = (3, 4)
+    kv.init("w0", mx.nd.ones(shape))
+    out = mx.nd.zeros(shape)
+    kv.pull("w0", out=out)
+    check_diff(out, np.ones(shape))
+
+    # every worker pushes rank+1; sync push must sum across workers
+    kv.push("w0", mx.nd.full(shape, rank + 1))
+    kv.pull("w0", out=out)
+    expected = np.full(shape, sum(r + 1 for r in range(nw)), np.float32)
+    check_diff(out, expected)
+
+    # second round on multiple keys
+    keys = ["a", "b"]
+    for k in keys:
+        kv.init(k, mx.nd.zeros(shape))
+    for i, k in enumerate(keys):
+        kv.push(k, mx.nd.full(shape, (rank + 1) * (i + 1)))
+        kv.pull(k, out=out)
+        check_diff(out, np.full(shape, sum((r + 1) * (i + 1) for r in range(nw)), np.float32))
+    print("rank %d: DIST_KVSTORE_OK" % rank)
+
+    # distributed Trainer: same init on every worker, different data shards;
+    # after training, parameters must be bit-identical across workers
+    # (reference example/distributed_training/cifar10_dist.py pattern)
+    from mxnet_tpu.gluon import nn, Trainer
+    from mxnet_tpu.gluon.loss import L2Loss
+
+    mx.random.seed(7)
+    net = nn.HybridSequential(prefix="dist_")
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(1))
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05},
+                      kvstore=kv)
+    loss_fn = L2Loss()
+    rs = np.random.RandomState(1234)
+    X = rs.randn(64, 4).astype(np.float32)   # same on all ranks
+    Y = (X.sum(axis=1, keepdims=True) > 0).astype(np.float32)
+    shard = slice(rank * (64 // nw), (rank + 1) * (64 // nw))
+    xs, ys = mx.nd.array(X[shard]), mx.nd.array(Y[shard])
+    for _ in range(3):
+        with mx.autograd.record():
+            loss = loss_fn(net(xs), ys)
+        loss.backward()
+        trainer.step(xs.shape[0] * nw)
+    # prove all workers hold identical params: allreduce(param) == nw * local
+    for j, (name, p) in enumerate(net.collect_params().items()):
+        local = p.data().asnumpy()
+        kv.init("chk%d" % j, mx.nd.zeros(local.shape))
+        kv.push("chk%d" % j, mx.nd.array(local))
+        got = mx.nd.zeros(local.shape)
+        kv.pull("chk%d" % j, out=got)
+        np.testing.assert_allclose(got.asnumpy(), nw * local, rtol=1e-5, atol=1e-6,
+                                   err_msg="param %s diverged across workers" % name)
+    print("rank %d: DIST_TRAINER_OK" % rank)
+
+
+if __name__ == "__main__":
+    main()

