@@ -38,6 +38,7 @@ for _m in (
     "lr_scheduler",
     "metric",
     "symbol",
+    "subgraph",
     "executor",
     "io",
     "recordio",

@@ -395,6 +395,16 @@ class Symbol(object):
         }
         return json.dumps(js, indent=2)
 
+    def get_backend_symbol(self, backend):
+        """Partition this graph with a registered subgraph backend
+        (reference ``Symbol.get_backend_symbol`` →
+        ``MXGenBackendSubgraph``, used by MKLDNN/TensorRT/quantization;
+        here backends are registered via
+        ``mxnet_tpu.subgraph.register_subgraph_property``)."""
+        from . import subgraph as _subgraph
+
+        return _subgraph.partition_graph(self, backend)
+
     def save(self, fname):
         with open(fname, "w") as f:
             f.write(self.tojson())

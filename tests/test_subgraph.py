@@ -1,0 +1,125 @@
+"""Subgraph framework tests.
+
+Mirrors the reference's tests/python/unittest/test_subgraph_op.py:
+partition a graph with a whitelist property, verify the fused graph
+computes identical outputs/gradients, survives JSON round-trip, and that
+non-convex groups are split instead of creating cycles.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import subgraph
+from mxnet_tpu import symbol as sym_mod
+
+
+def _mlp():
+    data = mx.symbol.var("data")
+    w1 = mx.symbol.var("w1")
+    w2 = mx.symbol.var("w2")
+    h = mx.symbol.FullyConnected(data, weight=w1, no_bias=True, num_hidden=8,
+                                 name="fc1")
+    a = mx.symbol.Activation(h, act_type="relu", name="act1")
+    out = mx.symbol.FullyConnected(a, weight=w2, no_bias=True, num_hidden=3,
+                                   name="fc2")
+    return out
+
+
+def _bindings(seed=0):
+    rs = np.random.RandomState(seed)
+    return {
+        "data": mx.nd.array(rs.randn(4, 5).astype(np.float32)),
+        "w1": mx.nd.array(rs.randn(8, 5).astype(np.float32)),
+        "w2": mx.nd.array(rs.randn(3, 8).astype(np.float32)),
+    }
+
+
+def _forward(s, binds):
+    ex = s.simple_bind(mx.cpu(), **{k: v.shape for k, v in binds.items()})
+    ex.copy_params_from({k: v for k, v in binds.items()})
+    return ex.forward(is_train=False)[0].asnumpy()
+
+
+def test_partition_fuses_whitelisted_ops():
+    s = _mlp()
+    part = subgraph.partition_graph(s, ["FullyConnected", "Activation"])
+    ops = [n.op for n in part._topo_nodes() if not n.is_var()]
+    assert ops == ["_subgraph_op"], ops
+    binds = _bindings()
+    np.testing.assert_allclose(_forward(s, binds), _forward(part, binds),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_partition_partial_whitelist():
+    s = _mlp()
+    part = subgraph.partition_graph(s, ["FullyConnected"])
+    ops = [n.op for n in part._topo_nodes() if not n.is_var()]
+    # two separate FC groups split by the unselected Activation
+    assert ops.count("_subgraph_op") == 2 and "Activation" in ops
+    binds = _bindings(1)
+    np.testing.assert_allclose(_forward(s, binds), _forward(part, binds),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_partition_nonconvex_split():
+    # x --> exp(sel) --> u = negative(unsel, consumes exp) --> add(sel: exp+u)
+    # fusing {exp, add} would swallow the path through negative: must split
+    x = mx.symbol.var("x")
+    e = mx.symbol.exp(x, name="e")
+    u = mx.symbol.negative(e, name="u")
+    out = mx.symbol.elemwise_add(e, u, name="add")
+    part = subgraph.partition_graph(out, ["exp", "elemwise_add"])
+    ops = [n.op for n in part._topo_nodes() if not n.is_var()]
+    assert ops.count("_subgraph_op") == 2 and "negative" in ops
+    xv = mx.nd.array(np.random.RandomState(2).randn(3, 3).astype(np.float32))
+    ex = part.simple_bind(mx.cpu(), x=xv.shape)
+    ex.copy_params_from({"x": xv})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                               np.zeros((3, 3), np.float32), atol=1e-5)
+
+
+def test_partitioned_json_roundtrip():
+    s = _mlp()
+    part = subgraph.partition_graph(s, ["FullyConnected", "Activation"])
+    js = part.tojson()
+    loaded = sym_mod.load_json(js)
+    binds = _bindings(3)
+    np.testing.assert_allclose(_forward(part, binds), _forward(loaded, binds),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_partitioned_backward_matches():
+    s = _mlp()
+    part = subgraph.partition_graph(s, ["FullyConnected", "Activation"])
+    binds = _bindings(4)
+    grads = {}
+    for name, graph in (("orig", s), ("part", part)):
+        ex = graph.simple_bind(mx.cpu(), grad_req="write",
+                               **{k: v.shape for k, v in binds.items()})
+        ex.copy_params_from(binds)
+        ex.forward(is_train=True)
+        ex.backward(out_grads=mx.nd.ones((4, 3)))
+        grads[name] = {k: g.asnumpy() for k, g in ex.grad_dict.items()}
+    for k in grads["orig"]:
+        np.testing.assert_allclose(grads["orig"][k], grads["part"][k],
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_get_backend_symbol():
+    subgraph.register_subgraph_property(
+        "fuse_fc", subgraph.DefaultSubgraphProperty(["FullyConnected",
+                                                     "Activation"]))
+    part = _mlp().get_backend_symbol("fuse_fc")
+    ops = [n.op for n in part._topo_nodes() if not n.is_var()]
+    assert ops == ["_subgraph_op"]
+
+
+def test_property_registry():
+    prop = subgraph.DefaultSubgraphProperty(["exp"])
+    subgraph.register_subgraph_property("test_backend", prop)
+    assert subgraph.get_subgraph_property("test_backend") is prop
+    x = mx.symbol.var("x")
+    part = subgraph.partition_graph(mx.symbol.exp(x), "test_backend")
+    assert any(n.op == "_subgraph_op" for n in part._topo_nodes() if not n.is_var())
+    with pytest.raises(mx.MXNetError):
+        subgraph.get_subgraph_property("nope")
