@@ -1,2 +1,7 @@
 """Experimental / contrib packages (reference ``python/mxnet/contrib/``)."""
+from . import autograd  # noqa: F401
+from . import io  # noqa: F401
+from . import onnx  # noqa: F401
 from . import quantization  # noqa: F401
+from . import tensorboard  # noqa: F401
+from . import text  # noqa: F401

@@ -1,0 +1,79 @@
+"""Legacy contrib autograd API (reference python/mxnet/contrib/autograd.py).
+
+The pre-``mx.autograd`` experimental surface: ``train_section``/
+``test_section`` context managers, ``mark_variables``, ``backward``,
+``compute_gradient`` and the ``grad_and_loss``/``grad`` function
+transformers. Thin shims over :mod:`mxnet_tpu.autograd`, kept so code
+written against the old API runs unchanged.
+"""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+from .. import ndarray as nd
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """Toggle train mode recording; returns the previous state."""
+    prev = _ag.is_recording()
+    if is_train and not prev:
+        _ag.set_recording(True)
+        _ag.set_training(True)
+    elif not is_train and prev:
+        _ag.set_recording(False)
+        _ag.set_training(False)
+    return prev
+
+
+train_section = _ag.record
+test_section = _ag.pause
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    _ag.backward(outputs, head_grads=out_grads, retain_graph=retain_graph)
+
+
+compute_gradient = backward
+
+
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradient of ``func`` and its output
+    (reference contrib/autograd.py:grad_and_loss)."""
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            argnums = [argnum] if isinstance(argnum, int) else list(argnum)
+            variables = [args[i] for i in argnums]
+        for x in variables:
+            assert isinstance(x, nd.NDArray), "type of autograd input should NDArray."
+        grads = [nd.zeros_like(x) for x in variables]
+        mark_variables(variables, grads)
+        with train_section():
+            outputs = func(*args)
+        backward([outputs] if isinstance(outputs, nd.NDArray) else outputs)
+        return grads, outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Return a function computing only the gradient (reference
+    contrib/autograd.py:grad)."""
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+
+    return wrapped

@@ -1,0 +1,61 @@
+"""Contrib IO: bridge Gluon DataLoaders into the DataIter world.
+
+API parity with the reference ``python/mxnet/contrib/io.py``
+(DataLoaderIter :25-94): wraps a ``gluon.data.DataLoader`` as a classic
+``mx.io.DataIter`` so Module-based code can train from Gluon datasets.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..io import DataBatch, DataDesc, DataIter
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Iterate a DataLoader as (data, label) DataBatches."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label",
+                 dtype="float32"):
+        super().__init__()
+        self._loader = loader
+        self._iter = iter(loader)
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self._current = None
+        self._next()
+        if self._current is None:
+            raise MXNetError("DataLoaderIter: empty DataLoader")
+        data, label = self._current
+        self.batch_size = data.shape[0]
+        self.provide_data = [DataDesc(data_name, data.shape, dtype)]
+        self.provide_label = [DataDesc(label_name, label.shape, label.dtype)]
+
+    def _next(self):
+        try:
+            batch = next(self._iter)
+            if isinstance(batch, (list, tuple)) and len(batch) == 2:
+                self._current = (batch[0], batch[1])
+            else:
+                raise MXNetError("DataLoaderIter needs (data, label) batches")
+        except StopIteration:
+            self._current = None
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._current = None
+        self._next()
+
+    def iter_next(self):
+        return self._current is not None
+
+    def next(self):
+        if self._current is None:
+            raise StopIteration
+        data, label = self._current
+        batch = DataBatch(data=[data], label=[label], pad=0, index=None,
+                          provide_data=self.provide_data,
+                          provide_label=self.provide_label)
+        self._next()
+        return batch

@@ -1,0 +1,9 @@
+"""ONNX interop (reference ``python/mxnet/contrib/onnx/``): export via
+:func:`mx2onnx.export_model`, import via :func:`onnx2mx.import_model`.
+Self-contained wire-format codec — no onnx pip dependency."""
+from . import mx2onnx, onnx2mx
+from .mx2onnx import export_model
+from .onnx2mx import get_model_metadata, import_model
+
+__all__ = ["export_model", "import_model", "get_model_metadata",
+           "mx2onnx", "onnx2mx"]

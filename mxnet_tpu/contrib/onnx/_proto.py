@@ -1,0 +1,315 @@
+"""Minimal protobuf wire-format codec for the ONNX message subset.
+
+The reference's onnx contrib (``python/mxnet/contrib/onnx/``) depends on the
+``onnx`` pip package for ModelProto plumbing; that package is not a baked-in
+dependency here, so this module speaks the protobuf wire format directly
+(varint / length-delimited / 32-bit fields — the stable, documented
+encoding) for exactly the ONNX messages the exporter/importer need:
+ModelProto, GraphProto, NodeProto, AttributeProto, TensorProto,
+ValueInfoProto. Files written here load in stock onnxruntime/netron, and
+stock ``.onnx`` files (within the supported op subset) load here.
+
+Field numbers follow onnx.proto3 (onnx repo, Apache-2.0).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, Iterable, List, Tuple
+
+import numpy as np
+
+# wire types
+_VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
+
+# TensorProto.DataType
+FLOAT, UINT8, INT8, INT32, INT64 = 1, 2, 3, 6, 7
+_DT_NP = {FLOAT: np.float32, UINT8: np.uint8, INT8: np.int8,
+          INT32: np.int32, INT64: np.int64}
+_NP_DT = {np.dtype(v): k for k, v in _DT_NP.items()}
+
+# AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR = 1, 2, 3, 4
+A_FLOATS, A_INTS, A_STRINGS = 6, 7, 8
+
+
+# ---------------------------------------------------------------------------
+# encoding
+# ---------------------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def f_varint(field: int, value: int) -> bytes:
+    return _tag(field, _VARINT) + _varint(int(value))
+
+
+def f_bytes(field: int, value) -> bytes:
+    if isinstance(value, str):
+        value = value.encode("utf-8")
+    return _tag(field, _LEN) + _varint(len(value)) + bytes(value)
+
+
+def f_float(field: int, value: float) -> bytes:
+    return _tag(field, _I32) + struct.pack("<f", float(value))
+
+
+def f_packed_varints(field: int, values: Iterable[int]) -> bytes:
+    payload = b"".join(_varint(int(v)) for v in values)
+    return _tag(field, _LEN) + _varint(len(payload)) + payload
+
+
+def f_packed_floats(field: int, values: Iterable[float]) -> bytes:
+    payload = struct.pack("<%df" % len(list(values)), *values) \
+        if not isinstance(values, (bytes, bytearray)) else bytes(values)
+    return _tag(field, _LEN) + _varint(len(payload)) + payload
+
+
+def tensor(name: str, array: np.ndarray) -> bytes:
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    array = np.ascontiguousarray(array)
+    dt = _NP_DT.get(array.dtype)
+    if dt is None:
+        array = array.astype(np.float32)
+        dt = FLOAT
+    out = b"".join(f_varint(1, d) for d in array.shape)
+    out += f_varint(2, dt)
+    out += f_bytes(8, name)
+    out += f_bytes(9, array.tobytes())
+    return out
+
+
+def attribute(name: str, value) -> bytes:
+    """AttributeProto with the type field set (name=1 f=2 i=3 s=4 t=5
+    floats=7 ints=8 strings=9 type=20)."""
+    out = f_bytes(1, name)
+    if isinstance(value, bool):
+        out += f_varint(3, int(value)) + f_varint(20, A_INT)
+    elif isinstance(value, (int, np.integer)):
+        out += f_varint(3, int(value)) + f_varint(20, A_INT)
+    elif isinstance(value, (float, np.floating)):
+        out += f_float(2, value) + f_varint(20, A_FLOAT)
+    elif isinstance(value, str):
+        out += f_bytes(4, value) + f_varint(20, A_STRING)
+    elif isinstance(value, np.ndarray):
+        out += f_bytes(5, tensor(name + "_t", value)) + f_varint(20, A_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if all(isinstance(v, (int, np.integer)) for v in value):
+            out += b"".join(f_varint(8, v) for v in value) + f_varint(20, A_INTS)
+        elif all(isinstance(v, (int, float, np.floating, np.integer)) for v in value):
+            out += b"".join(f_float(7, v) for v in value) + f_varint(20, A_FLOATS)
+        else:
+            out += b"".join(f_bytes(9, str(v)) for v in value) + f_varint(20, A_STRINGS)
+    else:
+        raise TypeError("unsupported attribute value %r" % (value,))
+    return out
+
+
+def node(op_type: str, inputs: List[str], outputs: List[str], name: str = "",
+         attrs: Dict[str, Any] = None) -> bytes:
+    """NodeProto: input=1 output=2 name=3 op_type=4 attribute=5."""
+    out = b"".join(f_bytes(1, i) for i in inputs)
+    out += b"".join(f_bytes(2, o) for o in outputs)
+    if name:
+        out += f_bytes(3, name)
+    out += f_bytes(4, op_type)
+    for k, v in (attrs or {}).items():
+        out += f_bytes(5, attribute(k, v))
+    return out
+
+
+def value_info(name: str, shape: Tuple[int, ...], elem_type: int = FLOAT) -> bytes:
+    """ValueInfoProto: name=1, type=2{tensor_type=1{elem_type=1, shape=2}}."""
+    dims = b"".join(f_bytes(1, f_varint(1, d)) for d in shape)  # Dimension.dim_value
+    shape_proto = dims
+    tensor_type = f_varint(1, elem_type) + f_bytes(2, shape_proto)
+    type_proto = f_bytes(1, tensor_type)
+    return f_bytes(1, name) + f_bytes(2, type_proto)
+
+
+def graph(nodes: List[bytes], name: str, initializers: List[bytes],
+          inputs: List[bytes], outputs: List[bytes]) -> bytes:
+    """GraphProto: node=1 name=2 initializer=5 input=11 output=12."""
+    out = b"".join(f_bytes(1, n) for n in nodes)
+    out += f_bytes(2, name)
+    out += b"".join(f_bytes(5, t) for t in initializers)
+    out += b"".join(f_bytes(11, v) for v in inputs)
+    out += b"".join(f_bytes(12, v) for v in outputs)
+    return out
+
+
+def model(graph_bytes: bytes, opset: int = 12, producer: str = "mxnet_tpu") -> bytes:
+    """ModelProto: ir_version=1 producer_name=2 graph=7 opset_import=8."""
+    opset_id = f_bytes(1, "") + f_varint(2, opset)  # domain, version
+    return (f_varint(1, 7)  # IR version 7
+            + f_bytes(2, producer)
+            + f_bytes(7, graph_bytes)
+            + f_bytes(8, opset_id))
+
+
+# ---------------------------------------------------------------------------
+# decoding
+# ---------------------------------------------------------------------------
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def parse(buf: bytes) -> Dict[int, List]:
+    """Generic decode: field number -> list of raw values (ints for varint,
+    bytes for length-delimited, floats for 32-bit)."""
+    out: Dict[int, List] = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == _VARINT:
+            v, pos = _read_varint(buf, pos)
+        elif wire == _LEN:
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wire == _I32:
+            v = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == _I64:
+            v = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError("unsupported wire type %d" % wire)
+        out.setdefault(field, []).append(v)
+    return out
+
+
+def _signed64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def parse_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
+    f = parse(buf)
+    dims = tuple(_signed64(d) for d in f.get(1, []))
+    dt = f.get(2, [FLOAT])[0]
+    name = f.get(8, [b""])[0].decode("utf-8")
+    np_dt = _DT_NP.get(dt, np.float32)
+    if 9 in f:  # raw_data
+        arr = np.frombuffer(f[9][0], dtype=np_dt).reshape(dims)
+    elif dt == FLOAT and 4 in f:
+        arr = np.array([x if isinstance(x, float) else
+                        struct.unpack("<f", x)[0] for x in f[4]],
+                       dtype=np.float32).reshape(dims)
+    elif 7 in f:  # int64_data
+        arr = np.array([_signed64(v) for v in f[7]], dtype=np.int64).reshape(dims)
+    elif 5 in f:  # int32_data
+        arr = np.array(f[5], dtype=np.int32).reshape(dims)
+    else:
+        arr = np.zeros(dims, dtype=np_dt)
+    return name, arr
+
+
+def parse_attribute(buf: bytes):
+    f = parse(buf)
+    name = f.get(1, [b""])[0].decode("utf-8")
+    atype = f.get(20, [0])[0]
+    if atype == A_INT or (atype == 0 and 3 in f):
+        return name, _signed64(f[3][0])
+    if atype == A_FLOAT or (atype == 0 and 2 in f):
+        return name, f[2][0]
+    if atype == A_STRING or (atype == 0 and 4 in f):
+        return name, f[4][0].decode("utf-8")
+    if atype == A_TENSOR or (atype == 0 and 5 in f):
+        return name, parse_tensor(f[5][0])[1]
+    if atype == A_INTS or (atype == 0 and 8 in f):
+        vals = []
+        for v in f.get(8, []):
+            if isinstance(v, bytes):  # packed
+                pos = 0
+                while pos < len(v):
+                    x, pos = _read_varint(v, pos)
+                    vals.append(_signed64(x))
+            else:
+                vals.append(_signed64(v))
+        return name, vals
+    if atype == A_FLOATS:
+        vals = []
+        for v in f.get(7, []):
+            if isinstance(v, bytes):
+                vals.extend(struct.unpack("<%df" % (len(v) // 4), v))
+            else:
+                vals.append(v)
+        return name, vals
+    if atype == A_STRINGS:
+        return name, [v.decode("utf-8") for v in f.get(9, [])]
+    return name, None
+
+
+def parse_node(buf: bytes):
+    f = parse(buf)
+    return {
+        "input": [v.decode("utf-8") for v in f.get(1, [])],
+        "output": [v.decode("utf-8") for v in f.get(2, [])],
+        "name": f.get(3, [b""])[0].decode("utf-8"),
+        "op_type": f.get(4, [b""])[0].decode("utf-8"),
+        "attrs": dict(parse_attribute(a) for a in f.get(5, [])),
+    }
+
+
+def parse_value_info(buf: bytes):
+    f = parse(buf)
+    name = f.get(1, [b""])[0].decode("utf-8")
+    shape: Tuple[int, ...] = ()
+    if 2 in f:
+        tp = parse(f[2][0])
+        if 1 in tp:  # tensor_type
+            tt = parse(tp[1][0])
+            if 2 in tt:  # shape
+                dims = []
+                for d in parse(tt[2][0]).get(1, []):
+                    dv = parse(d).get(1, [0])[0]
+                    dims.append(_signed64(dv))
+                shape = tuple(dims)
+    return name, shape
+
+
+def parse_graph(buf: bytes):
+    f = parse(buf)
+    return {
+        "nodes": [parse_node(n) for n in f.get(1, [])],
+        "name": f.get(2, [b""])[0].decode("utf-8"),
+        "initializers": dict(parse_tensor(t) for t in f.get(5, [])),
+        "inputs": [parse_value_info(v) for v in f.get(11, [])],
+        "outputs": [parse_value_info(v) for v in f.get(12, [])],
+    }
+
+
+def parse_model(buf: bytes):
+    f = parse(buf)
+    if 7 not in f:
+        raise ValueError("not an ONNX ModelProto (no graph field)")
+    return {
+        "ir_version": f.get(1, [0])[0],
+        "producer": f.get(2, [b""])[0].decode("utf-8"),
+        "graph": parse_graph(f[7][0]),
+    }

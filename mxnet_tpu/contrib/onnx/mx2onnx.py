@@ -1,0 +1,204 @@
+"""Symbol → ONNX export.
+
+API parity with the reference ``python/mxnet/contrib/onnx/mx2onnx/``
+(``export_model(sym, params, input_shape, onnx_file_path)``). Emits
+ModelProto bytes through :mod:`._proto`; the op subset matches the
+importer's so exported models round-trip, and the encoding is the standard
+wire format readable by onnxruntime/netron.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ...base import MXNetError
+from . import _proto
+
+__all__ = ["export_model"]
+
+
+def _tuple_attr(attrs, key, default=()):
+    v = attrs.get(key, default)
+    return [int(x) for x in (v if isinstance(v, (tuple, list)) else (v,))]
+
+
+def export_model(sym, params, input_shape, input_type=np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export (reference mx2onnx/export_model.py:export_model).
+
+    ``params`` maps arg/aux name → NDArray (merge of arg_params+aux_params,
+    or a Gluon ``collect_params`` realized dict). ``input_shape`` is a list
+    with one shape tuple per data input.
+    """
+    params = {k.split(":", 1)[-1]: v for k, v in params.items()}
+    np_params = {k: (v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v))
+                 for k, v in params.items()}
+
+    nodes: List[bytes] = []
+    initializers: List[bytes] = []
+    graph_inputs: List[bytes] = []
+
+    topo = sym._topo_nodes()
+    data_inputs = [n.name for n in topo
+                   if n.is_var() and n.name not in np_params]
+    if len(data_inputs) != len(input_shape):
+        raise MXNetError("export: %d data inputs %s but %d input shapes"
+                         % (len(data_inputs), data_inputs, len(input_shape)))
+    for name, shape in zip(data_inputs, input_shape):
+        graph_inputs.append(_proto.value_info(name, tuple(shape)))
+    for name, arr in np_params.items():
+        if any(n.is_var() and n.name == name for n in topo):
+            initializers.append(_proto.tensor(name, arr))
+            graph_inputs.append(_proto.value_info(name, arr.shape))
+
+    out_name: Dict[Any, str] = {}
+
+    def name_of(entry):
+        node, idx = entry
+        if node.is_var():
+            return node.name
+        return out_name[(id(node), idx)]
+
+    extra_init_count = [0]
+
+    def add_const(arr, base):
+        nm = "%s_const%d" % (base, extra_init_count[0])
+        extra_init_count[0] += 1
+        initializers.append(_proto.tensor(nm, arr))
+        graph_inputs.append(_proto.value_info(nm, arr.shape))
+        return nm
+
+    for n in topo:
+        if n.is_var():
+            continue
+        op = n.op
+        opdef_attrs = n.attrs
+        ins = [name_of(e) for e in n.inputs]
+        outs = ["%s_out%d" % (n.name, k) if n.num_outputs() > 1 else n.name
+                for k in range(n.num_outputs())]
+        for k, o in enumerate(outs):
+            out_name[(id(n), k)] = o
+        a: Dict[str, Any] = {}
+        if op == "FullyConnected":
+            no_bias = str(opdef_attrs.get("no_bias", "False")) in ("True", "1", "true")
+            if no_bias:
+                # Gemm needs C in opset<11 forms; emit MatMul with transposed
+                # weight constant instead
+                wname = ins[1]
+                w = np_params.get(wname)
+                if w is None:
+                    raise MXNetError("export: FC weight %r not in params" % wname)
+                wt = add_const(w.T.copy(), n.name)
+                nodes.append(_proto.node("MatMul", [ins[0], wt], outs, n.name))
+            else:
+                nodes.append(_proto.node("Gemm", ins[:3], outs, n.name,
+                                         {"transB": 1}))
+        elif op == "Convolution":
+            a["kernel_shape"] = _tuple_attr(opdef_attrs, "kernel")
+            if "stride" in opdef_attrs:
+                a["strides"] = _tuple_attr(opdef_attrs, "stride")
+            pad = _tuple_attr(opdef_attrs, "pad", ())
+            if pad:
+                a["pads"] = pad + pad
+            if "dilate" in opdef_attrs:
+                a["dilations"] = _tuple_attr(opdef_attrs, "dilate")
+            if "num_group" in opdef_attrs:
+                a["group"] = int(opdef_attrs["num_group"])
+            no_bias = str(opdef_attrs.get("no_bias", "False")) in ("True", "1", "true")
+            nodes.append(_proto.node("Conv", ins[:2] if no_bias else ins[:3],
+                                     outs, n.name, a))
+        elif op == "Pooling":
+            global_pool = str(opdef_attrs.get("global_pool", "False")) in \
+                ("True", "1", "true")
+            ptype = str(opdef_attrs.get("pool_type", "max"))
+            if global_pool:
+                onnx_op = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
+            else:
+                onnx_op = "MaxPool" if ptype == "max" else "AveragePool"
+                a["kernel_shape"] = _tuple_attr(opdef_attrs, "kernel")
+                if "stride" in opdef_attrs:
+                    a["strides"] = _tuple_attr(opdef_attrs, "stride")
+                pad = _tuple_attr(opdef_attrs, "pad", ())
+                if pad:
+                    a["pads"] = pad + pad
+            nodes.append(_proto.node(onnx_op, ins[:1], outs[:1], n.name, a))
+            for k in range(1, len(outs)):
+                out_name[(id(n), k)] = outs[0]
+        elif op == "BatchNorm":
+            # MXNet's BatchNorm eps default is 1e-3 (ops/nn.py), not ONNX's
+            # 1e-5 — serialize the effective value so the import matches
+            a = {"epsilon": float(opdef_attrs.get("eps", 1e-3)),
+                 "momentum": float(opdef_attrs.get("momentum", 0.9))}
+            nodes.append(_proto.node("BatchNormalization", ins[:5], outs[:1],
+                                     n.name, a))
+            for k in range(1, len(outs)):
+                out_name[(id(n), k)] = outs[0]
+        elif op == "Activation":
+            act = str(opdef_attrs.get("act_type", "relu"))
+            onnx_op = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+                       "softsign": "Softsign"}.get(act)
+            if onnx_op is None:
+                raise MXNetError("export: unsupported activation %r" % act)
+            nodes.append(_proto.node(onnx_op, ins, outs, n.name))
+        elif op == "LeakyReLU":
+            nodes.append(_proto.node(
+                "LeakyRelu", ins, outs, n.name,
+                {"alpha": float(opdef_attrs.get("slope", 0.25))}))
+        elif op in ("softmax", "log_softmax", "Softmax", "SoftmaxOutput"):
+            axis = int(opdef_attrs.get("axis", -1))
+            nodes.append(_proto.node("Softmax", ins[:1], outs, n.name,
+                                     {"axis": axis}))
+        elif op == "Flatten":
+            nodes.append(_proto.node("Flatten", ins, outs, n.name))
+        elif op in ("elemwise_add", "broadcast_add", "_plus"):
+            nodes.append(_proto.node("Add", ins, outs, n.name))
+        elif op in ("elemwise_sub", "broadcast_sub"):
+            nodes.append(_proto.node("Sub", ins, outs, n.name))
+        elif op in ("elemwise_mul", "broadcast_mul"):
+            nodes.append(_proto.node("Mul", ins, outs, n.name))
+        elif op in ("elemwise_div", "broadcast_div"):
+            nodes.append(_proto.node("Div", ins, outs, n.name))
+        elif op == "Concat":
+            nodes.append(_proto.node("Concat", ins, outs, n.name,
+                                     {"axis": int(opdef_attrs.get("dim", 1))}))
+        elif op == "Dropout":
+            nodes.append(_proto.node("Dropout", ins[:1], outs[:1], n.name,
+                                     {"ratio": float(opdef_attrs.get("p", 0.5))}))
+        elif op == "Reshape":
+            shape = _tuple_attr(opdef_attrs, "shape")
+            shp = add_const(np.asarray(shape, dtype=np.int64), n.name)
+            nodes.append(_proto.node("Reshape", [ins[0], shp], outs, n.name))
+        elif op == "transpose":
+            nodes.append(_proto.node("Transpose", ins, outs, n.name,
+                                     {"perm": _tuple_attr(opdef_attrs, "axes")}))
+        elif op == "clip":
+            nodes.append(_proto.node(
+                "Clip", ins, outs, n.name,
+                {"min": float(opdef_attrs.get("a_min", -3.4e38)),
+                 "max": float(opdef_attrs.get("a_max", 3.4e38))}))
+        elif op == "dot":
+            nodes.append(_proto.node("MatMul", ins, outs, n.name))
+        else:
+            raise MXNetError("export: op %r has no ONNX mapping" % op)
+
+    # infer output shapes for the graph outputs
+    shape_kwargs = dict(zip(data_inputs, [tuple(s) for s in input_shape]))
+    for name, arr in np_params.items():
+        shape_kwargs.setdefault(name, arr.shape)
+    try:
+        _, out_shapes, _ = sym.infer_shape_partial(**shape_kwargs)
+    except Exception:  # pragma: no cover - shape failure falls back to ()
+        out_shapes = [() for _ in sym._outputs]
+    graph_outputs = [
+        _proto.value_info(name_of(e), tuple(s) if s else ())
+        for e, s in zip(sym._outputs, out_shapes)]
+
+    gbytes = _proto.graph(nodes, "mxnet_tpu_graph", initializers,
+                          graph_inputs, graph_outputs)
+    mbytes = _proto.model(gbytes)
+    with open(onnx_file_path, "wb") as f:
+        f.write(mbytes)
+    if verbose:
+        print("exported %d nodes to %s" % (len(nodes), onnx_file_path))
+    return onnx_file_path

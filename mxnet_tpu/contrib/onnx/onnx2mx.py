@@ -1,0 +1,172 @@
+"""ONNX → Symbol import.
+
+API parity with the reference ``python/mxnet/contrib/onnx/onnx2mx/``
+(``import_model`` returning ``(sym, arg_params, aux_params)``). Operates on
+the wire-format decoder in :mod:`._proto`, so stock ``.onnx`` files load
+without the onnx pip package (supported op subset below).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ...base import MXNetError
+from . import _proto
+
+__all__ = ["import_model", "get_model_metadata"]
+
+
+def _conv_attrs(attrs, default_kernel=()):
+    kernel = tuple(attrs.get("kernel_shape", default_kernel))
+    mx_attrs = {"kernel": kernel}
+    if "strides" in attrs:
+        mx_attrs["stride"] = tuple(attrs["strides"])
+    if "pads" in attrs:
+        pads = attrs["pads"]
+        # ONNX pads are begin+end per axis; MXNet pads are symmetric
+        half = len(pads) // 2
+        if tuple(pads[:half]) != tuple(pads[half:]):
+            raise MXNetError("asymmetric ONNX pads %r unsupported" % (pads,))
+        mx_attrs["pad"] = tuple(pads[:half])
+    if "dilations" in attrs:
+        mx_attrs["dilate"] = tuple(attrs["dilations"])
+    if "group" in attrs:
+        mx_attrs["num_group"] = attrs["group"]
+    return mx_attrs
+
+
+def import_model(model_file):
+    """Load an .onnx file → (sym, arg_params, aux_params)
+    (reference onnx2mx/import_model.py:import_model)."""
+    from ... import ndarray as nd
+    from ... import symbol as sym_mod
+
+    with open(model_file, "rb") as f:
+        m = _proto.parse_model(f.read())
+    g = m["graph"]
+    inits: Dict[str, np.ndarray] = g["initializers"]
+    env: Dict[str, Any] = {}
+    aux_names = set()
+
+    for name, _shape in g["inputs"]:
+        if name not in inits:
+            env[name] = sym_mod.var(name)
+    for name in inits:
+        env[name] = sym_mod.var(name)
+
+    def take(node, i):
+        name = node["input"][i]
+        if name not in env:
+            raise MXNetError("onnx import: undefined input %r" % name)
+        return env[name]
+
+    for node in g["nodes"]:
+        op = node["op_type"]
+        attrs = node["attrs"]
+        name = node["name"] or node["output"][0]
+        ins = node["input"]
+        if op == "Gemm":
+            if attrs.get("transB", 0) != 1 or attrs.get("transA", 0) != 0 \
+                    or attrs.get("alpha", 1.0) not in (1, 1.0) \
+                    or attrs.get("beta", 1.0) not in (1, 1.0):
+                raise MXNetError("unsupported Gemm configuration %r" % attrs)
+            w = inits.get(ins[1])
+            num_hidden = int(w.shape[0]) if w is not None else 0
+            out = sym_mod.FullyConnected(
+                take(node, 0), weight=take(node, 1),
+                bias=take(node, 2) if len(ins) > 2 else None,
+                no_bias=len(ins) <= 2, num_hidden=num_hidden, name=name)
+        elif op == "MatMul":
+            out = sym_mod.dot(take(node, 0), take(node, 1), name=name)
+        elif op == "Conv":
+            w = inits.get(ins[1])
+            mx_attrs = _conv_attrs(attrs)
+            out = sym_mod.Convolution(
+                take(node, 0), weight=take(node, 1),
+                bias=take(node, 2) if len(ins) > 2 else None,
+                no_bias=len(ins) <= 2,
+                num_filter=int(w.shape[0]) if w is not None else 0,
+                name=name, **mx_attrs)
+        elif op in ("MaxPool", "AveragePool"):
+            mx_attrs = _conv_attrs(attrs)
+            out = sym_mod.Pooling(
+                take(node, 0), pool_type="max" if op == "MaxPool" else "avg",
+                name=name, **mx_attrs)
+        elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+            out = sym_mod.Pooling(
+                take(node, 0), global_pool=True, kernel=(1, 1),
+                pool_type="max" if op == "GlobalMaxPool" else "avg", name=name)
+        elif op == "BatchNormalization":
+            out = sym_mod.BatchNorm(
+                take(node, 0), gamma=take(node, 1), beta=take(node, 2),
+                moving_mean=take(node, 3), moving_var=take(node, 4),
+                eps=attrs.get("epsilon", 1e-5),
+                momentum=attrs.get("momentum", 0.9), fix_gamma=False,
+                name=name)
+            aux_names.update(ins[3:5])
+        elif op == "Relu":
+            out = sym_mod.Activation(take(node, 0), act_type="relu", name=name)
+        elif op == "Sigmoid":
+            out = sym_mod.Activation(take(node, 0), act_type="sigmoid", name=name)
+        elif op == "Tanh":
+            out = sym_mod.Activation(take(node, 0), act_type="tanh", name=name)
+        elif op == "LeakyRelu":
+            out = sym_mod.LeakyReLU(take(node, 0), act_type="leaky",
+                                    slope=attrs.get("alpha", 0.01), name=name)
+        elif op == "Softmax":
+            out = sym_mod.softmax(take(node, 0),
+                                  axis=attrs.get("axis", -1), name=name)
+        elif op == "Flatten":
+            out = sym_mod.Flatten(take(node, 0), name=name)
+        elif op in ("Add", "Sub", "Mul", "Div"):
+            fn = {"Add": sym_mod.broadcast_add, "Sub": sym_mod.broadcast_sub,
+                  "Mul": sym_mod.broadcast_mul, "Div": sym_mod.broadcast_div}[op]
+            out = fn(take(node, 0), take(node, 1), name=name)
+        elif op == "Concat":
+            args = [take(node, i) for i in range(len(ins))]
+            out = sym_mod.Concat(*args, dim=attrs.get("axis", 1),
+                                 num_args=len(args), name=name)
+        elif op == "Dropout":
+            out = sym_mod.Dropout(take(node, 0), p=attrs.get("ratio", 0.5),
+                                  name=name)
+        elif op == "Reshape":
+            shape = inits.get(ins[1])
+            if shape is None:
+                raise MXNetError("Reshape with dynamic shape input unsupported")
+            env.pop(ins[1], None)
+            out = sym_mod.Reshape(take(node, 0),
+                                  shape=tuple(int(x) for x in shape), name=name)
+        elif op == "Transpose":
+            out = sym_mod.transpose(take(node, 0),
+                                    axes=tuple(attrs.get("perm", ())), name=name)
+        elif op == "Clip":
+            out = sym_mod.clip(take(node, 0), a_min=attrs.get("min", -3.4e38),
+                               a_max=attrs.get("max", 3.4e38), name=name)
+        elif op == "Identity":
+            out = take(node, 0)
+        else:
+            raise MXNetError("onnx import: unsupported op %r" % op)
+        outs = [out] if len(node["output"]) == 1 else list(out)
+        for oname, osym in zip(node["output"], outs):
+            env[oname] = osym
+
+    outputs = [env[name] for name, _ in g["outputs"]]
+    sym = outputs[0] if len(outputs) == 1 else sym_mod.Group(outputs)
+    arg_params = {k: nd.array(v) for k, v in inits.items()
+                  if k not in aux_names and k in sym.list_arguments()}
+    aux_params = {k: nd.array(v) for k, v in inits.items() if k in aux_names}
+    return sym, arg_params, aux_params
+
+
+def get_model_metadata(model_file):
+    """Input/output shapes of an .onnx model (reference
+    onnx2mx/import_model.py:get_model_metadata)."""
+    with open(model_file, "rb") as f:
+        m = _proto.parse_model(f.read())
+    g = m["graph"]
+    inits = g["initializers"]
+    return {
+        "input_tensor_data": [(n, s) for n, s in g["inputs"] if n not in inits],
+        "output_tensor_data": list(g["outputs"]),
+    }

@@ -1,0 +1,51 @@
+"""TensorBoard logging callback.
+
+API parity with the reference ``python/mxnet/contrib/tensorboard.py``
+(LogMetricsCallback wrapping a SummaryWriter and feeding eval metrics per
+batch/epoch). The writer backend is resolved at construction:
+``torch.utils.tensorboard`` (torch is a baked-in dependency here) or
+``tensorboardX`` — whichever imports first — with a clear error otherwise.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["LogMetricsCallback", "SummaryWriter"]
+
+
+def SummaryWriter(logging_dir):  # noqa: N802 - reference-compatible factory
+    """Create a SummaryWriter from an available backend."""
+    try:
+        from torch.utils.tensorboard import SummaryWriter as _SW
+    except ImportError:
+        try:
+            from tensorboardX import SummaryWriter as _SW  # type: ignore
+        except ImportError as exc:
+            raise MXNetError(
+                "no TensorBoard writer backend available (install torch or "
+                "tensorboardX)") from exc
+    return _SW(logging_dir)
+
+
+class LogMetricsCallback(object):
+    """Log metric values each time the callback fires
+    (reference tensorboard.py:LogMetricsCallback; pass as
+    ``batch_end_callback`` / ``eval_end_callback`` to ``Module.fit``)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        """BatchEndParam callback signature."""
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)
+
+    def close(self):
+        self.summary_writer.close()

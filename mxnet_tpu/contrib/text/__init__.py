@@ -1,0 +1,6 @@
+"""Text utilities: vocabulary, pretrained embeddings, tokenization
+(reference python/mxnet/contrib/text/)."""
+from . import embedding, utils, vocab
+from .vocab import Vocabulary
+
+__all__ = ["embedding", "utils", "vocab", "Vocabulary"]

@@ -1,0 +1,256 @@
+"""Pretrained token embeddings.
+
+API parity with the reference ``python/mxnet/contrib/text/embedding.py``
+(_TokenEmbedding :133, GloVe :469, FastText :559, CustomEmbedding :659,
+CompositeEmbedding :720, register/create/get_pretrained_file_names :40-130).
+This environment has no network egress, so GloVe/FastText resolve their
+pretrained files from a local root (``MXNET_EMBEDDING_ROOT``, default
+``~/.mxnet/embedding``) instead of downloading; the text-file format parsed
+(``token<delim>v1 ... vN`` per line) is the standard GloVe/fastText .txt/.vec
+layout, so files fetched by the reference load here unchanged.
+"""
+from __future__ import annotations
+
+import io
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...base import MXNetError
+from . import vocab as _vocab
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "GloVe", "FastText", "CustomEmbedding",
+           "CompositeEmbedding"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(embedding_cls):
+    """Register an embedding class under its lowercase name
+    (reference embedding.py:40)."""
+    _REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Instantiate a registered embedding (reference embedding.py:63)."""
+    name = embedding_name.lower()
+    if name not in _REGISTRY:
+        raise MXNetError("unknown embedding %r (registered: %s)"
+                         % (embedding_name, sorted(_REGISTRY)))
+    return _REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names (reference embedding.py:90)."""
+    if embedding_name is not None:
+        cls = _REGISTRY.get(embedding_name.lower())
+        if cls is None:
+            raise MXNetError("unknown embedding %r" % embedding_name)
+        return list(cls.pretrained_file_names)
+    return {name: list(cls.pretrained_file_names)
+            for name, cls in _REGISTRY.items()}
+
+
+class TokenEmbedding(_vocab.Vocabulary):
+    """Base: a vocabulary whose indices carry vectors
+    (reference _TokenEmbedding :133)."""
+
+    pretrained_file_names: Sequence[str] = ()
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    # -- loading -----------------------------------------------------------
+    @classmethod
+    def _embedding_root(cls):
+        return os.environ.get(
+            "MXNET_EMBEDDING_ROOT",
+            os.path.join(os.path.expanduser("~"), ".mxnet", "embedding"))
+
+    @classmethod
+    def _resolve_pretrained(cls, pretrained_file_name):
+        path = os.path.join(cls._embedding_root(), cls.__name__.lower(),
+                            pretrained_file_name)
+        if not os.path.isfile(path):
+            raise MXNetError(
+                "pretrained file %s not found at %s (no network egress: "
+                "place the file there manually or point "
+                "MXNET_EMBEDDING_ROOT at it)" % (pretrained_file_name, path))
+        return path
+
+    def _load_embedding(self, path, elem_delim=" ",
+                        init_unknown_vec: Callable = np.zeros,
+                        encoding="utf8"):
+        """Parse ``token<delim>v1 .. vN`` lines (reference :232)."""
+        vectors: Dict[str, np.ndarray] = {}
+        vec_len = None
+        with io.open(path, "r", encoding=encoding) as f:
+            for lineno, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) <= 2:
+                    continue  # header line (fastText .vec) or malformed
+                token, elems = parts[0], parts[1:]
+                if vec_len is None:
+                    vec_len = len(elems)
+                elif len(elems) != vec_len:
+                    continue  # skip malformed line, like the reference warns
+                if token and token not in vectors:
+                    vectors[token] = np.asarray([float(x) for x in elems],
+                                                dtype=np.float32)
+        if vec_len is None:
+            raise MXNetError("no vectors found in %s" % path)
+        self._vec_len = vec_len
+        # extend the vocabulary with every pretrained token
+        for token in vectors:
+            if token not in self._token_to_idx:
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+        mat = np.zeros((len(self), vec_len), dtype=np.float32)
+        if self.unknown_token is not None:
+            mat[0] = init_unknown_vec(vec_len)
+        for token, vec in vectors.items():
+            mat[self._token_to_idx[token]] = vec
+        self._set_idx_to_vec(mat)
+
+    def _set_idx_to_vec(self, mat: np.ndarray):
+        from ... import ndarray as nd
+
+        self._idx_to_vec = nd.array(mat)
+
+    def _build_for_vocabulary(self, vocabulary: Optional[_vocab.Vocabulary],
+                              source: "TokenEmbedding"):
+        """Restrict vectors to an existing vocabulary (reference :345)."""
+        if vocabulary is None:
+            return
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        mat = np.zeros((len(self), source.vec_len), dtype=np.float32)
+        for token, idx in self._token_to_idx.items():
+            mat[idx] = source.get_vecs_by_tokens(token).asnumpy()
+        self._vec_len = source.vec_len
+        self._set_idx_to_vec(mat)
+
+    # -- access ------------------------------------------------------------
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vectors for token(s); unknown tokens get the unknown vector
+        (reference :366)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        if lower_case_backup:
+            toks = [t if t in self._token_to_idx else t.lower() for t in toks]
+        idxs = self.to_indices(toks)
+        if single:
+            return self._idx_to_vec[idxs[0]]
+        return self._idx_to_vec[idxs]
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors of known tokens (reference :405)."""
+        from ... import ndarray as nd
+
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        for t in toks:
+            if t not in self._token_to_idx:
+                raise MXNetError("token %r is not in the vocabulary" % t)
+        vecs = new_vectors if isinstance(new_vectors, nd.NDArray) \
+            else nd.array(new_vectors)
+        if single and len(vecs.shape) == 1:
+            vecs = vecs.reshape((1, -1))
+        mat = np.array(self._idx_to_vec.asnumpy())  # asnumpy view is read-only
+        for t, v in zip(toks, vecs.asnumpy()):
+            mat[self._token_to_idx[t]] = v
+        self._set_idx_to_vec(mat)
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe .txt embeddings (reference :469). Local-file resolution only."""
+
+    pretrained_file_names = (
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt",
+    )
+
+    def __init__(self, pretrained_file_name="glove.6B.50d.txt",
+                 init_unknown_vec=np.zeros, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(self._resolve_pretrained(pretrained_file_name),
+                             " ", init_unknown_vec)
+        if vocabulary is not None:
+            src = self
+            import copy
+
+            src = copy.copy(self)
+            self._build_for_vocabulary(vocabulary, src)
+
+
+@register
+class FastText(TokenEmbedding):
+    """fastText .vec embeddings (reference :559)."""
+
+    pretrained_file_names = (
+        "wiki.en.vec", "wiki.simple.vec", "crawl-300d-2M.vec",
+    )
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 init_unknown_vec=np.zeros, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(self._resolve_pretrained(pretrained_file_name),
+                             " ", init_unknown_vec)
+        if vocabulary is not None:
+            import copy
+
+            src = copy.copy(self)
+            self._build_for_vocabulary(vocabulary, src)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Embeddings from a user file (reference :659)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 init_unknown_vec=np.zeros, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        if vocabulary is not None:
+            import copy
+
+            src = copy.copy(self)
+            self._build_for_vocabulary(vocabulary, src)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference :720)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        super().__init__()
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        parts = []
+        for emb in token_embeddings:
+            parts.append(emb.get_vecs_by_tokens(self._idx_to_token).asnumpy())
+        mat = np.concatenate(parts, axis=1)
+        self._vec_len = mat.shape[1]
+        self._set_idx_to_vec(mat)

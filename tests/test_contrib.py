@@ -1,0 +1,209 @@
+"""Tests for contrib: text (vocab/embeddings), onnx round-trip, io,
+tensorboard callback, legacy autograd shim.
+
+Mirror of the reference's tests/python/unittest/test_contrib_text.py and
+onnx export/import CI (tests/python-pytest/onnx/).
+"""
+import os
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+from mxnet_tpu.contrib import autograd as old_autograd
+from mxnet_tpu.contrib.io import DataLoaderIter
+from mxnet_tpu.contrib.onnx import export_model, get_model_metadata, import_model
+
+
+# ---------------------------------------------------------------------------
+# text
+# ---------------------------------------------------------------------------
+
+def test_vocabulary_ordering():
+    counter = Counter(["b", "b", "a", "c", "c", "c", "d"])
+    v = text.Vocabulary(counter, most_freq_count=None, min_freq=2,
+                        unknown_token="<unk>", reserved_tokens=["<pad>"])
+    # <unk>, <pad>, then by frequency: c(3), b(2); a/d dropped by min_freq
+    assert v.idx_to_token == ["<unk>", "<pad>", "c", "b"]
+    assert v.to_indices("c") == 2
+    assert v.to_indices(["c", "zzz"]) == [2, 0]  # unknown → index 0
+    assert v.to_tokens([2, 3]) == ["c", "b"]
+    assert len(v) == 4
+
+
+def test_vocabulary_most_freq_count():
+    counter = Counter({"a": 5, "b": 4, "c": 3, "d": 2})
+    v = text.Vocabulary(counter, most_freq_count=2, unknown_token="<unk>")
+    assert v.idx_to_token == ["<unk>", "a", "b"]
+
+
+def test_count_tokens_from_str():
+    c = text.utils.count_tokens_from_str("a b\nb c", to_lower=False)
+    assert c == Counter({"b": 2, "a": 1, "c": 1})
+
+
+def test_custom_embedding_and_composite(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.embedding.CustomEmbedding(str(p))
+    assert emb.vec_len == 3
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("world").asnumpy(),
+                               [4.0, 5.0, 6.0])
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("missing").asnumpy(),
+                               [0.0, 0.0, 0.0])
+    emb.update_token_vectors("hello", mx.nd.array([[9.0, 9.0, 9.0]]))
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("hello").asnumpy(),
+                               [9.0, 9.0, 9.0])
+
+    vocab = text.Vocabulary(Counter(["hello", "hello", "xyz"]))
+    comp = text.embedding.CompositeEmbedding(vocab, [emb, emb])
+    assert comp.vec_len == 6
+    vecs = comp.get_vecs_by_tokens(["hello"]).asnumpy()
+    np.testing.assert_allclose(vecs[0], [9.0] * 3 + [9.0] * 3)
+
+
+def test_embedding_registry():
+    assert "glove" in text.embedding.get_pretrained_file_names()
+    assert "glove.6B.50d.txt" in text.embedding.get_pretrained_file_names("glove")
+    with pytest.raises(mx.MXNetError):
+        text.embedding.create("nope")
+
+
+# ---------------------------------------------------------------------------
+# onnx round-trip
+# ---------------------------------------------------------------------------
+
+def _random_params(sym, data_shape):
+    arg_shapes, _, _ = sym.infer_shape(data=data_shape)
+    rs = np.random.RandomState(0)
+    return {name: mx.nd.array(rs.randn(*shape).astype(np.float32) * 0.1)
+            for name, shape in zip(sym.list_arguments(), arg_shapes)
+            if name != "data"}
+
+
+def _forward(sym, params, data):
+    ex = sym.simple_bind(mx.cpu(), data=data.shape)
+    ex.copy_params_from({**params, "data": data})
+    return ex.forward(is_train=False)[0].asnumpy()
+
+
+def test_onnx_mlp_roundtrip(tmp_path):
+    data = mx.symbol.var("data")
+    h = mx.symbol.FullyConnected(data, num_hidden=16, name="fc1")
+    a = mx.symbol.Activation(h, act_type="relu", name="relu1")
+    out = mx.symbol.FullyConnected(a, num_hidden=4, name="fc2")
+    out = mx.symbol.softmax(out, name="sm")
+
+    params = _random_params(out, (2, 8))
+    path = str(tmp_path / "mlp.onnx")
+    export_model(out, params, [(2, 8)], onnx_file_path=path)
+    assert os.path.getsize(path) > 100
+
+    meta = get_model_metadata(path)
+    assert meta["input_tensor_data"][0][0] == "data"
+
+    sym2, arg2, aux2 = import_model(path)
+    data_nd = mx.nd.array(np.random.RandomState(1).randn(2, 8).astype(np.float32))
+    y1 = _forward(out, params, data_nd)
+    y2 = _forward(sym2, {**arg2, **aux2}, data_nd)
+    np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_conv_bn_pool_roundtrip(tmp_path):
+    data = mx.symbol.var("data")
+    c = mx.symbol.Convolution(data, num_filter=4, kernel=(3, 3), pad=(1, 1),
+                              name="conv0")
+    b = mx.symbol.BatchNorm(c, fix_gamma=False, name="bn0")
+    r = mx.symbol.Activation(b, act_type="relu", name="relu0")
+    p = mx.symbol.Pooling(r, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                          name="pool0")
+    f = mx.symbol.Flatten(p, name="flat0")
+    out = mx.symbol.FullyConnected(f, num_hidden=3, name="fc0")
+
+    shape = (2, 3, 8, 8)
+    arg_shapes, _, aux_shapes = out.infer_shape(data=shape)
+    rs = np.random.RandomState(2)
+    params = {}
+    for name, s in zip(out.list_arguments(), arg_shapes):
+        if name == "data":
+            continue
+        if "gamma" in name:
+            params[name] = mx.nd.array(np.ones(s, np.float32))
+        elif "beta" in name:
+            params[name] = mx.nd.array(np.zeros(s, np.float32))
+        else:
+            params[name] = mx.nd.array(rs.randn(*s).astype(np.float32) * 0.1)
+    aux = {}
+    for name, s in zip(out.list_auxiliary_states(), aux_shapes):
+        aux[name] = mx.nd.array(
+            np.zeros(s, np.float32) if "mean" in name else np.ones(s, np.float32))
+
+    path = str(tmp_path / "cnn.onnx")
+    export_model(out, {**params, **aux}, [shape], onnx_file_path=path)
+    sym2, arg2, aux2 = import_model(path)
+
+    data_nd = mx.nd.array(rs.randn(*shape).astype(np.float32))
+    ex1 = out.simple_bind(mx.cpu(), data=shape)
+    ex1.copy_params_from({**params, "data": data_nd}, aux)
+    y1 = ex1.forward(is_train=False)[0].asnumpy()
+    ex2 = sym2.simple_bind(mx.cpu(), data=shape)
+    ex2.copy_params_from({**arg2, "data": data_nd}, aux2)
+    y2 = ex2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(y1, y2, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_unsupported_op_raises(tmp_path):
+    x = mx.symbol.var("data")
+    s = mx.symbol.gammaln(x)
+    with pytest.raises(mx.MXNetError, match="no ONNX mapping"):
+        export_model(s, {}, [(2, 2)], onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+# ---------------------------------------------------------------------------
+# contrib.io / tensorboard / legacy autograd
+# ---------------------------------------------------------------------------
+
+def test_dataloader_iter():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    X = mx.nd.array(np.arange(24, dtype=np.float32).reshape(12, 2))
+    Y = mx.nd.array(np.arange(12, dtype=np.float32))
+    loader = DataLoader(ArrayDataset(X, Y), batch_size=4)
+    it = DataLoaderIter(loader)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 2)
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_tensorboard_callback(tmp_path):
+    tb = pytest.importorskip("torch.utils.tensorboard")  # noqa: F841
+    from collections import namedtuple
+
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+
+    cb = LogMetricsCallback(str(tmp_path / "logs"))
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([1, 0])], [mx.nd.array([[0.1, 0.9], [0.8, 0.2]])])
+    Param = namedtuple("BatchEndParam", ["eval_metric"])
+    cb(Param(eval_metric=metric))
+    cb.close()
+    assert any(os.scandir(str(tmp_path / "logs")))
+
+
+def test_legacy_contrib_autograd():
+    def f(x):
+        return mx.nd.sum(x * x)
+
+    g = old_autograd.grad(f)
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    (gx,) = g(x)
+    np.testing.assert_allclose(gx.asnumpy(), 2 * x.asnumpy(), rtol=1e-6)
+
+    gl = old_autograd.grad_and_loss(f)
+    grads, loss = gl(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), 2 * x.asnumpy(), rtol=1e-6)
+    assert abs(float(loss.asnumpy()) - 14.0) < 1e-5
