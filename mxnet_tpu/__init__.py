@@ -43,6 +43,7 @@ for _m in (
     "io",
     "recordio",
     "kvstore",
+    "elastic",
     "gluon",
     "module",
     "model",

@@ -277,6 +277,13 @@ class KVStoreTPU(KVStore):
     def num_workers(self):
         return jax.process_count()
 
+    def get_dead_nodes(self, timeout=10.0):
+        """Ranks whose heartbeat went stale (reference
+        ``KVStoreDist::GetDeadNodes``, kvstore_dist.h:121)."""
+        from . import elastic
+
+        return elastic.get_dead_nodes(timeout)
+
     def _reduce(self, datas: List[Any]):
         # one fused XLA allreduce over the devices holding the copies
         # (ICI within a slice, DCN across processes); parallel.all_reduce
@@ -388,6 +395,10 @@ def init_distributed(coordinator=None, num_workers=None, rank=None):
         process_id=int(rank),
     )
     _DIST_INITIALIZED = True
+    # publish liveness for get_dead_nodes (reference: ps-lite heartbeats)
+    from . import elastic
+
+    elastic.start_heartbeat()
     return True
 
 

@@ -85,6 +85,19 @@ def main():
                                    err_msg="param %s diverged across workers" % name)
     print("rank %d: DIST_TRAINER_OK" % rank)
 
+    # failure-detection surface: both workers heartbeating → no dead nodes
+    # (reference KVStoreDist::GetDeadNodes, kvstore_dist.h:121)
+    import time
+
+    from mxnet_tpu import elastic
+
+    time.sleep(0.5)  # allow both heartbeat threads a publish cycle
+    dead = kv.get_dead_nodes(timeout=30.0)
+    assert dead == [], "unexpected dead nodes: %r" % (dead,)
+    assert elastic.get_dead_nodes(timeout=1e-6) == list(range(nw)), \
+        "zero timeout must mark every rank stale"
+    print("rank %d: DIST_HEARTBEAT_OK" % rank)
+
 
 if __name__ == "__main__":
     main()

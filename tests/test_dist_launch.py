@@ -37,6 +37,7 @@ def test_dist_sync_kvstore_two_workers():
     for rank in (0, 1):
         assert ("rank %d: DIST_KVSTORE_OK" % rank) in out.stdout, out.stdout[-4000:]
         assert ("rank %d: DIST_TRAINER_OK" % rank) in out.stdout, out.stdout[-4000:]
+        assert ("rank %d: DIST_HEARTBEAT_OK" % rank) in out.stdout, out.stdout[-4000:]
 
 
 def test_launch_cli_rejects_empty_command():
