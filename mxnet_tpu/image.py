@@ -541,3 +541,265 @@ class ImageIter(DataIter):
         return DataBatch([data], [label], pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
+
+
+# ---------------------------------------------------------------------------
+# detection pipeline (reference python/mxnet/image/detection.py +
+# src/io/iter_image_det_recordio.cc) — feeds the SSD workload (SURVEY §7.4
+# BASELINE #4). Labels ride the .rec IRHeader array-label slot in the
+# reference's packed layout: [header_width, object_width, (extra header...),
+# obj0_cls, obj0_xmin, obj0_ymin, obj0_xmax, obj0_ymax, obj1_cls, ...] with
+# coordinates normalized to [0, 1].
+# ---------------------------------------------------------------------------
+
+
+class DetAugmenter(object):
+    """Detection augmenter: transforms (image, label[N,5+]) jointly
+    (reference detection.py:DetAugmenter)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift an image-only augmenter into the detection pipeline (only safe
+    for geometry-preserving ops — color jitter, cast; reference
+    detection.py:DetBorrowAug)."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Random horizontal flip of image and boxes (reference
+    detection.py:DetHorizontalFlipAug)."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if _np_rng().rand() < self.p:
+            arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+            src = _to_nd(arr[:, ::-1])
+            label = label.copy()
+            xmin = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - xmin
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop keeping sufficient object coverage (reference
+    detection.py:DetRandomCropAug — simplified: IoU-style constraint via
+    min coverage of each kept box, bounded retries)."""
+
+    def __init__(self, min_object_covered=0.3, min_crop_size=0.3,
+                 max_crop_size=1.0, max_attempts=25):
+        self.min_object_covered = min_object_covered
+        self.min_crop_size = min_crop_size
+        self.max_crop_size = max_crop_size
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+        h, w = arr.shape[:2]
+        rng = _np_rng()
+        for _ in range(self.max_attempts):
+            scale = rng.uniform(self.min_crop_size, self.max_crop_size)
+            cw, ch = int(w * scale), int(h * scale)
+            if cw < 1 or ch < 1:
+                continue
+            x0 = rng.randint(0, w - cw + 1)
+            y0 = rng.randint(0, h - ch + 1)
+            new_label = self._crop_boxes(label, x0 / w, y0 / h, cw / w, ch / h)
+            if len(new_label):
+                return _to_nd(arr[y0:y0 + ch, x0:x0 + cw]), new_label
+        return src, label
+
+    def _crop_boxes(self, label, cx, cy, cw, ch):
+        out = []
+        for row in label:
+            cls, xmin, ymin, xmax, ymax = row[:5]
+            ix0, iy0 = max(xmin, cx), max(ymin, cy)
+            ix1, iy1 = min(xmax, cx + cw), min(ymax, cy + ch)
+            area = max(0.0, xmax - xmin) * max(0.0, ymax - ymin)
+            inter = max(0.0, ix1 - ix0) * max(0.0, iy1 - iy0)
+            if area <= 0 or inter / area < self.min_object_covered:
+                continue
+            new = np.array(row, dtype=np.float32)
+            new[1] = (ix0 - cx) / cw
+            new[2] = (iy0 - cy) / ch
+            new[3] = (ix1 - cx) / cw
+            new[4] = (iy1 - cy) / ch
+            out.append(new)
+        return np.asarray(out, dtype=np.float32).reshape(-1, label.shape[1])
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion padding with box rescale (reference
+    detection.py:DetRandomPadAug)."""
+
+    def __init__(self, max_pad_scale=2.0, pad_val=127):
+        self.max_pad_scale = max_pad_scale
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        arr = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+        h, w = arr.shape[:2]
+        rng = _np_rng()
+        scale = rng.uniform(1.0, self.max_pad_scale)
+        nw, nh = int(w * scale), int(h * scale)
+        if nw <= w or nh <= h:
+            return src, label
+        x0 = rng.randint(0, nw - w + 1)
+        y0 = rng.randint(0, nh - h + 1)
+        canvas = np.full((nh, nw) + arr.shape[2:], self.pad_val, arr.dtype)
+        canvas[y0:y0 + h, x0:x0 + w] = arr
+        label = label.copy()
+        label[:, 1] = (label[:, 1] * w + x0) / nw
+        label[:, 2] = (label[:, 2] * h + y0) / nh
+        label[:, 3] = (label[:, 3] * w + x0) / nw
+        label[:, 4] = (label[:, 4] * h + y0) / nh
+        return _to_nd(canvas), label
+
+
+class DetForceResizeAug(DetAugmenter):
+    """Resize to exact size; normalized boxes are unchanged."""
+
+    def __init__(self, size, interp=2):
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src, label):
+        return ForceResizeAug(self.size, self.interp)(src), label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_mirror=False, mean=None, std=None, brightness=0,
+                       contrast=0, saturation=0, min_object_covered=0.3,
+                       max_attempts=25, pad_val=127, inter_method=2):
+    """Build the standard detection augmenter list (reference
+    detection.py:CreateDetAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        auglist.append(DetRandomCropAug(min_object_covered=min_object_covered,
+                                        max_attempts=max_attempts))
+    if rand_pad > 0:
+        auglist.append(DetRandomPadAug(pad_val=pad_val))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetForceResizeAug((data_shape[2], data_shape[1]),
+                                     inter_method))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(ColorJitterAug(brightness, contrast,
+                                                   saturation)))
+    if mean is not None or std is not None:
+        if mean is True or mean is None:
+            mean = np.array([123.68, 116.28, 103.53])
+        if std is True or std is None:
+            std = np.array([58.395, 57.12, 57.375])
+        auglist.append(DetBorrowAug(CastAug()))
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection data iterator (reference detection.py:ImageDetIter /
+    src/io/iter_image_det_recordio.cc): yields image batches plus object
+    labels of shape (batch, max_objects, object_width), short rows padded
+    with -1 (invalid class id) — the layout MultiBoxTarget consumes.
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, shuffle=False,
+                 aug_list=None, imglist=None, label_shape=None, **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_pad", "rand_mirror",
+                         "mean", "std", "brightness", "contrast",
+                         "saturation", "min_object_covered", "max_attempts",
+                         "pad_val", "inter_method")})
+        # base-class augmenters run through our joint (img, label) loop
+        super().__init__(batch_size, data_shape, label_width=1,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, shuffle=shuffle, aug_list=[],
+                         imglist=imglist)
+        self.det_auglist = aug_list
+        if label_shape is None:
+            label_shape = self._estimate_label_shape()
+        self.label_shape = tuple(label_shape)
+
+    def _parse_label(self, label):
+        """Unpack the reference's flat detection label into (N, width) rows."""
+        raw = np.asarray(label, dtype=np.float32).ravel()
+        if raw.size < 2:
+            raise MXNetError("ImageDetIter: label too short for detection")
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if obj_width < 5:
+            raise MXNetError("ImageDetIter: object width %d < 5" % obj_width)
+        body = raw[header_width:]
+        n = body.size // obj_width
+        return body[:n * obj_width].reshape(n, obj_width)
+
+    def _estimate_label_shape(self):
+        """Scan the dataset for the max object count (reference
+        detection.py:ImageDetIter._estimate_label_shape)."""
+        max_count, width = 0, 5
+        self.reset()
+        try:
+            while True:
+                label, _ = self.next_sample()
+                parsed = self._parse_label(label)
+                max_count = max(max_count, parsed.shape[0])
+                width = max(width, parsed.shape[1])
+        except StopIteration:
+            pass
+        self.reset()
+        return (max(1, max_count), width)
+
+    @property
+    def provide_label(self):
+        return [DataDesc("label", (self.batch_size,) + self.label_shape)]
+
+    def next(self):
+        batch_data = []
+        batch_label = []
+        pad = 0
+        try:
+            while len(batch_data) < self.batch_size:
+                label, s = self.next_sample()
+                img = imdecode(s, 1 if self.data_shape[0] == 3 else 0)
+                parsed = self._parse_label(label)
+                for aug in self.det_auglist:
+                    img, parsed = aug(img, parsed)
+                chw = img.asnumpy().transpose(2, 0, 1).astype(np.float32)
+                full = np.full(self.label_shape, -1.0, dtype=np.float32)
+                n = min(parsed.shape[0], self.label_shape[0])
+                full[:n, :parsed.shape[1]] = parsed[:n]
+                batch_data.append(chw)
+                batch_label.append(full)
+        except StopIteration:
+            if not batch_data:
+                raise
+            pad = self.batch_size - len(batch_data)
+            while len(batch_data) < self.batch_size:
+                batch_data.append(batch_data[-1])
+                batch_label.append(batch_label[-1])
+        data = nd_mod.array(np.stack(batch_data))
+        label = nd_mod.array(np.stack(batch_label))
+        return DataBatch([data], [label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def reshape(self, data_shape=None, label_shape=None):
+        if data_shape is not None:
+            self.data_shape = tuple(data_shape)
+        if label_shape is not None:
+            self.label_shape = tuple(label_shape)

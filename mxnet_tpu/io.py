@@ -508,3 +508,200 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=128,
     if preprocess_threads and prefetch_buffer:
         return PrefetchingIter(it)
     return it
+
+
+class LibSVMIter(DataIter):
+    """Sparse batch iterator over LibSVM text files (reference
+    ``src/iter_libsvm.cc`` + ``iter_sparse_batchloader.h``): each line is
+    ``label[,label..] idx:value idx:value ...``; batches come out as CSR
+    arrays so sparse FullyConnected/dot paths consume them directly.
+
+    Parameters mirror the reference: ``data_libsvm`` (path),
+    ``data_shape`` (feature dimension), optional ``label_libsvm`` for
+    multi-target labels stored in a second file.
+    """
+
+    def __init__(self, data_libsvm, data_shape, batch_size,
+                 label_libsvm=None, label_shape=None, round_batch=True,
+                 data_name="data", label_name="softmax_label", **_ignored):
+        super().__init__(batch_size)
+        from .ndarray import sparse as sp
+
+        self._sp = sp
+        self.data_shape = (data_shape,) if isinstance(data_shape, int) \
+            else tuple(data_shape)
+        self.num_features = int(np.prod(self.data_shape))
+        self.round_batch = round_batch
+        self.data_name = data_name
+        self.label_name = label_name
+
+        self._rows = self._parse(data_libsvm)  # list of (label, idx[], val[])
+        if label_libsvm:
+            lab = self._parse(label_libsvm)
+            if len(lab) != len(self._rows):
+                raise MXNetError("label_libsvm row count mismatch")
+            # dense multi-target labels from the label file's indices/values
+            width = (int(np.prod(label_shape)) if label_shape else
+                     max((r[1][-1] + 1) if len(r[1]) else 1 for r in lab))
+            labels = np.zeros((len(lab), width), dtype=np.float32)
+            for i, (_, idx, val) in enumerate(lab):
+                labels[i, idx] = val
+            self._labels = labels
+        else:
+            self._labels = np.asarray([r[0] for r in self._rows],
+                                      dtype=np.float32)
+        self.cur = 0
+
+    @staticmethod
+    def _parse(path):
+        rows = []
+        with open(path) as f:
+            for line in f:
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                label = float(parts[0].split(",")[0])
+                idx, val = [], []
+                for tok in parts[1:]:
+                    k, _, v = tok.partition(":")
+                    idx.append(int(k))
+                    val.append(float(v))
+                rows.append((label, np.asarray(idx, dtype=np.int64),
+                             np.asarray(val, dtype=np.float32)))
+        return rows
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size, self.num_features))]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self._labels.ndim == 1 \
+            else (self.batch_size, self._labels.shape[1])
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        self.cur = 0
+
+    def iter_next(self):
+        return self.cur < len(self._rows)
+
+    def next(self):
+        if self.cur >= len(self._rows):
+            raise StopIteration
+        end = min(self.cur + self.batch_size, len(self._rows))
+        rows = self._rows[self.cur:end]
+        labels = self._labels[self.cur:end]
+        pad = self.batch_size - len(rows)
+        if pad and self.round_batch:
+            rows = rows + self._rows[:pad]  # wrap like the reference
+            labels = np.concatenate([labels, self._labels[:pad]], axis=0)
+        self.cur = end
+        indptr = np.zeros(len(rows) + 1, dtype=np.int64)
+        for i, (_, idx, _v) in enumerate(rows):
+            indptr[i + 1] = indptr[i] + len(idx)
+        indices = np.concatenate([r[1] for r in rows]) if rows else \
+            np.zeros((0,), np.int64)
+        values = np.concatenate([r[2] for r in rows]) if rows else \
+            np.zeros((0,), np.float32)
+        data = self._sp.csr_matrix(
+            (values, indices, indptr),
+            shape=(len(rows), self.num_features))
+        label = nd_mod.array(labels)
+        return DataBatch([data], [label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class DevicePrefetchIter(DataIter):
+    """Device-infeed pipeline: stages upcoming batches into device memory
+    on a background thread while the current step computes.
+
+    The TPU analogue of the reference's prefetcher (``iter_prefetcher.h``)
+    one level deeper: beyond overlapping host-side batch ASSEMBLY (which
+    :class:`PrefetchingIter` covers), this overlaps the host→HBM transfer
+    itself, so the accelerator never waits on PCIe/DMA — jax dispatch is
+    async, and ``jax.device_put`` from the worker thread runs concurrently
+    with the in-flight step.
+    """
+
+    def __init__(self, base_iter, ctx=None, depth=2):
+        super().__init__(base_iter.batch_size)
+        import queue
+        import threading as _threading
+
+        from .context import current_context
+
+        self.base = base_iter
+        self.ctx = ctx or current_context()
+        self._depth = max(1, depth)
+        self._queue = queue.Queue(maxsize=self._depth)
+        self._sentinel = object()
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        return self.base.provide_data
+
+    @property
+    def provide_label(self):
+        return self.base.provide_label
+
+    def _stage(self, batch):
+        import jax
+
+        dev = self.ctx.jax_device()
+
+        def put(arrs):
+            return [type(a)(jax.device_put(a._data, dev), self.ctx)
+                    if isinstance(a, nd_mod.NDArray) else a for a in arrs]
+
+        return DataBatch(put(batch.data),
+                         put(batch.label) if batch.label else batch.label,
+                         pad=batch.pad, index=getattr(batch, "index", None),
+                         provide_data=batch.provide_data,
+                         provide_label=batch.provide_label)
+
+    def _start(self):
+        import threading as _threading
+
+        def worker():
+            try:
+                for batch in self.base:
+                    self._queue.put(self._stage(batch))
+            except Exception as exc:  # noqa: BLE001 - delivered at next()
+                self._queue.put(exc)
+                return
+            self._queue.put(self._sentinel)
+
+        self._thread = _threading.Thread(target=worker, daemon=True,
+                                         name="mxtpu-device-infeed")
+        self._thread.start()
+
+    def reset(self):
+        # drain the in-flight queue, then restart on a fresh pass
+        while self._thread is not None and self._thread.is_alive():
+            try:
+                self._queue.get(timeout=0.1)
+            except Exception:  # noqa: BLE001 - queue.Empty
+                continue
+        while not self._queue.empty():
+            self._queue.get_nowait()
+        self.base.reset()
+        self._start()
+
+    def next(self):
+        item = self._queue.get()
+        if item is self._sentinel:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def iter_next(self):
+        try:
+            self._cached = self.next()
+            return True
+        except StopIteration:
+            return False
