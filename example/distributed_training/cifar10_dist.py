@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Distributed data-parallel training — BASELINE workload #5 (SURVEY §7.4).
+
+Counterpart of the reference's ``example/distributed_training/
+cifar10_dist.py``: ``kv = mx.kv.create('dist_sync')`` (:30), per-worker data
+sharding with a ``SplitSampler`` (:30,86), and ``Trainer(...,
+kvstore=store)`` (:102) so every gradient rides one global allreduce — here
+XLA collectives over ICI/DCN instead of parameter-server ZPush.
+
+Launch (the reference's ``tools/launch.py`` flow, PS-free):
+  JAX_PLATFORMS=cpu python tools/launch.py -n 2 -- \
+      python example/distributed_training/cifar10_dist.py --epochs 2
+
+Uses CIFAR-10 from ``--data-dir`` when the binaries are present (no network
+egress in this environment), otherwise a synthetic stand-in with the same
+shapes, so the distributed mechanics are runnable anywhere.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, kvstore
+from mxnet_tpu.gluon import Trainer, nn
+from mxnet_tpu.gluon.data import DataLoader, Sampler
+
+
+class SplitSampler(Sampler):
+    """Sample from this worker's contiguous shard only (reference
+    cifar10_dist.py:SplitSampler)."""
+
+    def __init__(self, length, num_parts=1, part_index=0, seed=0):
+        self.part_len = length // num_parts
+        self.start = self.part_len * part_index
+        self.seed = seed
+        self.epoch = 0
+
+    def __iter__(self):
+        rs = np.random.RandomState(self.seed + self.epoch)
+        self.epoch += 1
+        idx = self.start + rs.permutation(self.part_len)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.part_len
+
+
+def build_net(num_classes=10):
+    net = nn.HybridSequential(prefix="cifar_")
+    with net.name_scope():
+        net.add(nn.Conv2D(16, kernel_size=3, padding=1, activation="relu"),
+                nn.MaxPool2D(pool_size=2),
+                nn.Conv2D(32, kernel_size=3, padding=1, activation="relu"),
+                nn.MaxPool2D(pool_size=2),
+                nn.Flatten(),
+                nn.Dense(64, activation="relu"),
+                nn.Dense(num_classes))
+    return net
+
+
+def load_data(data_dir, n_synth=512):
+    try:
+        from mxnet_tpu.gluon.data.vision import CIFAR10
+
+        train = CIFAR10(root=data_dir, train=True)
+        X = np.stack([np.asarray(train[i][0]) for i in range(len(train))])
+        Y = np.asarray([train[i][1] for i in range(len(train))])
+        X = X.transpose(0, 3, 1, 2).astype(np.float32) / 255.0
+        return X, Y.astype(np.float32)
+    except Exception:
+        rs = np.random.RandomState(42)  # same data on every worker
+        X = rs.rand(n_synth, 3, 32, 32).astype(np.float32)
+        Y = rs.randint(0, 10, n_synth).astype(np.float32)
+        print("CIFAR-10 binaries not found; using synthetic data (%d samples)"
+              % n_synth)
+        return X, Y
+
+
+def evaluate(net, X, Y, batch_size):
+    metric = mx.metric.Accuracy()
+    for i in range(0, len(X) - batch_size + 1, batch_size):
+        out = net(mx.nd.array(X[i:i + batch_size]))
+        metric.update([mx.nd.array(Y[i:i + batch_size])], [out])
+    return metric.get()[1]
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--data-dir", default=os.path.expanduser("~/.mxnet/datasets/cifar10"))
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=32,
+                        help="per-worker batch size")
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--kv-store", default="dist_sync")
+    args = parser.parse_args()
+
+    # join the job if tools/launch.py planted rendezvous env (reference:
+    # ps-lite rendezvous inside kv creation)
+    kvstore.init_distributed()
+    store = mx.kvstore.create(args.kv_store)
+    rank, nworkers = store.rank, store.num_workers
+    print("worker %d/%d starting" % (rank, nworkers))
+
+    X, Y = load_data(args.data_dir)
+    dataset = gluon.data.ArrayDataset(mx.nd.array(X), mx.nd.array(Y))
+    sampler = SplitSampler(len(X), num_parts=nworkers, part_index=rank)
+    loader = DataLoader(dataset, batch_size=args.batch_size, sampler=sampler)
+
+    mx.random.seed(7)  # identical init on every worker
+    net = build_net()
+    net.initialize()
+    net(mx.nd.zeros((1, 3, 32, 32)))  # materialize deferred shapes
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": args.lr, "momentum": 0.9},
+                      kvstore=store)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        tic = time.time()
+        total, nb = 0.0, 0
+        for data, label in loader:
+            with autograd.record():
+                loss = loss_fn(net(data), label)
+            loss.backward()
+            trainer.step(args.batch_size * nworkers)
+            total += float(mx.nd.mean(loss).asnumpy())
+            nb += 1
+        acc = evaluate(net, X[:256], Y[:256], args.batch_size)
+        print("[worker %d epoch %d] loss %.4f train-acc(256) %.3f (%.1f img/s)"
+              % (rank, epoch, total / max(1, nb), acc,
+                 nb * args.batch_size / (time.time() - tic)))
+    print("worker %d done" % rank)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Word-level language model — BASELINE workload #3 (SURVEY §7.4).
+
+Counterpart of the reference's ``example/gluon/word_language_model/``
+(model.py:22 imperative RNNModel with Embedding + fused rnn.LSTM + tied
+Dense; train.py:131-135 truncated-BPTT ``detach()``, :169
+``clip_global_norm``). Exercises the eager engine + autograd + the
+lax.scan-fused LSTM.
+
+With no network egress, ``--data`` may point at any whitespace-tokenized
+corpus (PTB's ptb.train.txt works unchanged); by default a deterministic
+synthetic corpus keeps the example runnable end-to-end.
+
+Run (CPU mesh smoke):
+  JAX_PLATFORMS=cpu python example/gluon/word_language_model/train.py \
+      --epochs 2 --nhid 64 --emsize 64 --bptt 16 --batch-size 8
+"""
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import Block, Trainer, nn, rnn
+from mxnet_tpu.gluon.utils import clip_global_norm
+
+
+class RNNModel(Block):
+    """Embedding → LSTM → (tied) decoder (reference model.py:RNNModel)."""
+
+    def __init__(self, vocab_size, emsize, nhid, nlayers, dropout=0.2,
+                 tie_weights=False, **kwargs):
+        super().__init__(**kwargs)
+        self.nhid = nhid
+        self.nlayers = nlayers
+        with self.name_scope():
+            self.drop = nn.Dropout(dropout)
+            self.encoder = nn.Embedding(vocab_size, emsize,
+                                        weight_initializer=mx.initializer.Uniform(0.1))
+            self.rnn = rnn.LSTM(nhid, num_layers=nlayers, dropout=dropout,
+                                input_size=emsize)
+            if tie_weights:
+                if nhid != emsize:
+                    raise ValueError("tied weights need nhid == emsize")
+                self.decoder = nn.Dense(vocab_size, in_units=nhid,
+                                        params=self.encoder.params)
+            else:
+                self.decoder = nn.Dense(vocab_size, in_units=nhid)
+
+    def forward(self, inputs, hidden):
+        emb = self.drop(self.encoder(inputs))
+        output, hidden = self.rnn(emb, hidden)
+        output = self.drop(output)
+        decoded = self.decoder(output.reshape((-1, self.nhid)))
+        return decoded, hidden
+
+    def begin_state(self, *args, **kwargs):
+        return self.rnn.begin_state(*args, **kwargs)
+
+
+def load_corpus(path, synth_tokens=40000, synth_vocab=200):
+    """Token ids + vocab size from a text file, or a synthetic Zipf corpus."""
+    if path and os.path.isfile(path):
+        with open(path) as f:
+            words = f.read().replace("\n", " <eos> ").split()
+        vocab = {}
+        ids = np.empty(len(words), dtype=np.int32)
+        for i, w in enumerate(words):
+            ids[i] = vocab.setdefault(w, len(vocab))
+        return ids, len(vocab)
+    rs = np.random.RandomState(1234)
+    # Zipf-ish unigram draws with a little bigram structure
+    probs = 1.0 / np.arange(1, synth_vocab + 1)
+    probs /= probs.sum()
+    ids = rs.choice(synth_vocab, size=synth_tokens, p=probs).astype(np.int32)
+    ids[1::2] = (ids[::2][: len(ids[1::2])] + 1) % synth_vocab  # predictable pairs
+    return ids, synth_vocab
+
+
+def batchify(ids, batch_size):
+    nbatch = len(ids) // batch_size
+    data = ids[: nbatch * batch_size].reshape(batch_size, nbatch).T
+    return mx.nd.array(data)
+
+
+def detach(hidden):
+    if isinstance(hidden, (list, tuple)):
+        return [detach(h) for h in hidden]
+    return hidden.detach()
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--data", default=None, help="tokenized corpus file")
+    parser.add_argument("--emsize", type=int, default=200)
+    parser.add_argument("--nhid", type=int, default=200)
+    parser.add_argument("--nlayers", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=1.0)
+    parser.add_argument("--clip", type=float, default=0.25)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--bptt", type=int, default=35)
+    parser.add_argument("--dropout", type=float, default=0.2)
+    parser.add_argument("--tied", action="store_true")
+    parser.add_argument("--log-interval", type=int, default=20)
+    parser.add_argument("--synth-tokens", type=int, default=40000,
+                        help="synthetic corpus size when --data is absent")
+    args = parser.parse_args()
+
+    ids, vocab_size = load_corpus(args.data, synth_tokens=args.synth_tokens)
+    n_train = int(len(ids) * 0.9)
+    train_data = batchify(ids[:n_train], args.batch_size)
+    val_data = batchify(ids[n_train:], args.batch_size)
+    print("corpus: %d tokens, vocab %d" % (len(ids), vocab_size))
+
+    model = RNNModel(vocab_size, args.emsize, args.nhid, args.nlayers,
+                     args.dropout, args.tied)
+    model.initialize(mx.initializer.Xavier())
+    trainer = Trainer(model.collect_params(), "sgd",
+                      {"learning_rate": args.lr, "momentum": 0, "wd": 0})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def get_batch(source, i):
+        seq_len = min(args.bptt, source.shape[0] - 1 - i)
+        return source[i:i + seq_len], source[i + 1:i + 1 + seq_len].reshape((-1,))
+
+    def evaluate(source):
+        total, ntoks = 0.0, 0
+        hidden = model.begin_state(func=mx.nd.zeros, batch_size=args.batch_size)
+        for i in range(0, source.shape[0] - 1, args.bptt):
+            data, target = get_batch(source, i)
+            output, hidden = model(data, hidden)
+            total += float(mx.nd.sum(loss_fn(output, target)).asnumpy())
+            ntoks += target.shape[0]
+        return total / max(1, ntoks)
+
+    first_ppl = None
+    for epoch in range(args.epochs):
+        total, ntoks = 0.0, 0
+        hidden = model.begin_state(func=mx.nd.zeros, batch_size=args.batch_size)
+        tic = time.time()
+        for bi, i in enumerate(range(0, train_data.shape[0] - 1, args.bptt)):
+            data, target = get_batch(train_data, i)
+            hidden = detach(hidden)  # truncated BPTT (reference train.py:131)
+            with autograd.record():
+                output, hidden = model(data, hidden)
+                loss = loss_fn(output, target)
+            loss.backward()
+            grads = [p.grad() for p in model.collect_params().values()
+                     if p.grad_req != "null"]
+            clip_global_norm(grads, args.clip * args.batch_size * args.bptt)
+            trainer.step(data.shape[0] * data.shape[1])
+            total += float(mx.nd.sum(loss).asnumpy())
+            ntoks += target.shape[0]
+            if bi % args.log_interval == 0 and bi:
+                cur = total / ntoks
+                print("epoch %d batch %d loss %.3f ppl %.2f (%.1f tok/s)"
+                      % (epoch, bi, cur, math.exp(min(cur, 20)),
+                         ntoks * args.batch_size / (time.time() - tic)))
+        val_loss = evaluate(val_data)
+        ppl = math.exp(min(val_loss, 20))
+        if first_ppl is None:
+            first_ppl = ppl
+        print("[epoch %d] val loss %.3f val ppl %.2f" % (epoch, val_loss, ppl))
+    print("final val ppl %.2f (first %.2f)" % (ppl, first_ppl))
+    return 0 if ppl <= first_ppl else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -332,6 +332,12 @@ def _multibox_detection(attrs, cls_prob, loc_pred, anchor):
     """Decode + per-class NMS (reference multibox_detection.cc).
     Output (B, N, 6): [class_id, score, xmin, ymin, xmax, ymax]; invalid
     entries -1. class_id skips the background class."""
+    # Detections are non-differentiable (argmax/NMS); cut tangents here so a
+    # whole-graph vjp (training symbol with a monitoring detection head)
+    # never tries to linearize the Pallas NMS kernel.
+    cls_prob = jax.lax.stop_gradient(cls_prob)
+    loc_pred = jax.lax.stop_gradient(loc_pred)
+    anchor = jax.lax.stop_gradient(anchor)
     b, _, n = cls_prob.shape
     anchors = anchor.reshape(-1, 4)
     if anchors.shape[0] != n or loc_pred.shape[-1] != n * 4:
