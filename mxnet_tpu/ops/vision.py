@@ -440,3 +440,195 @@ def _identity_kl_sparse(attrs, data):
 
     f.defvjp(fwd, bwd)
     return f(data)
+
+
+# ---------------------------------------------------------------------------
+# Deformable ConvNets family (reference src/operator/contrib/
+# deformable_convolution.cc, deformable_psroi_pooling.cc, psroi_pooling.cc)
+# ---------------------------------------------------------------------------
+
+
+def _bilinear_sample_chw(img, ys, xs):
+    """Sample img (C,H,W) at float coords ys/xs (...,) with zero padding
+    outside — the deformable-conv sampling kernel, vectorized."""
+    c, h, w = img.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    dy = ys - y0
+    dx = xs - x0
+
+    def tap(yi, xi):
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        v = img[:, yc, xc]  # (C, ...)
+        return jnp.where(valid[None], v, 0.0)
+
+    v00 = tap(y0, x0)
+    v01 = tap(y0, x0 + 1)
+    v10 = tap(y0 + 1, x0)
+    v11 = tap(y0 + 1, x0 + 1)
+    return (v00 * ((1 - dy) * (1 - dx))[None] + v01 * ((1 - dy) * dx)[None]
+            + v10 * (dy * (1 - dx))[None] + v11 * (dy * dx)[None])
+
+
+def _deform_conv_inputs(attrs):
+    return ["data", "offset", "weight"] if attrs.get("no_bias") else \
+        ["data", "offset", "weight", "bias"]
+
+
+@register("_contrib_DeformableConvolution",
+          params={"kernel": (tuple, REQUIRED), "stride": (tuple, (1, 1)),
+                  "dilate": (tuple, (1, 1)), "pad": (tuple, (0, 0)),
+                  "num_filter": (int, REQUIRED), "num_group": (int, 1),
+                  "num_deformable_group": (int, 1), "no_bias": (bool, False),
+                  "workspace": (int, 1024), "layout": (str, "NCHW")},
+          inputs=_deform_conv_inputs,
+          aliases=("DeformableConvolution",))
+def _deformable_convolution(attrs, data, offset, weight, bias=None):
+    """Deformable convolution v1 (reference deformable_convolution-inl.h):
+    each kernel tap samples the input at a learned offset via bilinear
+    interpolation, then an ordinary weighted reduction runs over the taps.
+    offset: (B, 2*KH*KW*num_deformable_group, OH, OW), ordered (dy, dx) per
+    tap."""
+    kh, kw = attrs.kernel
+    sh, sw = attrs.stride
+    dh, dw = attrs.dilate
+    ph, pw = attrs.pad
+    b, c, h, w = data.shape
+    oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    ndg = attrs.num_deformable_group
+    off = offset.reshape(b, ndg, kh * kw, 2, oh, ow)
+
+    base_y = (jnp.arange(oh) * sh - ph)[:, None]  # (OH, 1)
+    base_x = (jnp.arange(ow) * sw - pw)[None, :]  # (1, OW)
+
+    def one(img, offs):
+        # img (C,H,W), offs (ndg, KH*KW, 2, OH, OW)
+        groups = jnp.split(img, ndg, axis=0)
+        cols = []
+        for g, gimg in enumerate(groups):
+            taps = []
+            for k in range(kh * kw):
+                ky, kx = divmod(k, kw)
+                ys = base_y + ky * dh + offs[g, k, 0]
+                xs = base_x + kx * dw + offs[g, k, 1]
+                taps.append(_bilinear_sample_chw(gimg, ys, xs))  # (C/ndg,OH,OW)
+            cols.append(jnp.stack(taps, axis=1))  # (C/ndg, KH*KW, OH, OW)
+        return jnp.concatenate(cols, axis=0)  # (C, KH*KW, OH, OW)
+
+    sampled = jax.vmap(one)(data, off)  # (B, C, KH*KW, OH, OW)
+    ng = attrs.num_group
+    wg = weight.reshape(ng, attrs.num_filter // ng, c // ng, kh * kw)
+    sg = sampled.reshape(b, ng, c // ng, kh * kw, oh, ow)
+    out = jnp.einsum("gock,bgckhw->bgohw", wg, sg, optimize=True)
+    out = out.reshape(b, attrs.num_filter, oh, ow)
+    if bias is not None:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+@register("_contrib_PSROIPooling",
+          params={"spatial_scale": (float, REQUIRED),
+                  "output_dim": (int, REQUIRED),
+                  "pooled_size": (int, REQUIRED),
+                  "group_size": (int, 0)},
+          inputs=("data", "rois"),
+          aliases=("PSROIPooling",))
+def _psroi_pooling(attrs, data, rois):
+    """Position-sensitive RoI average pooling (reference psroi_pooling.cc,
+    R-FCN): bin (i,j) of output channel c pools from input channel
+    c*group^2 + i*group + j, so each spatial bin looks at its own score
+    map."""
+    group = attrs.group_size or attrs.pooled_size
+    p = attrs.pooled_size
+    odim = attrs.output_dim
+    _b, c, h, w = data.shape
+    ycoord = jnp.arange(h, dtype=jnp.float32)
+    xcoord = jnp.arange(w, dtype=jnp.float32)
+
+    def one(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * attrs.spatial_scale)
+        y1 = jnp.round(roi[2] * attrs.spatial_scale)
+        x2 = jnp.round(roi[3] * attrs.spatial_scale) + 1.0
+        y2 = jnp.round(roi[4] * attrs.spatial_scale) + 1.0
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh, bw = rh / p, rw / p
+        img = data[bi]  # (C, H, W)
+
+        outs = []
+        for py in range(p):
+            row = []
+            for px in range(p):
+                hs = y1 + py * bh
+                he = y1 + (py + 1) * bh
+                ws = x1 + px * bw
+                we = x1 + (px + 1) * bw
+                mask = ((ycoord >= jnp.floor(hs)) & (ycoord < jnp.ceil(he)))[:, None] & \
+                       ((xcoord >= jnp.floor(ws)) & (xcoord < jnp.ceil(we)))[None, :]
+                area = jnp.maximum(mask.sum(), 1)
+                gy = min(py * group // p, group - 1)
+                gx = min(px * group // p, group - 1)
+                chans = jnp.arange(odim) * group * group + gy * group + gx
+                maps = img[chans]  # (odim, H, W)
+                row.append((maps * mask[None]).sum(axis=(1, 2)) / area)
+            outs.append(jnp.stack(row, axis=-1))  # (odim, P)
+        return jnp.stack(outs, axis=-2)  # (odim, P, P)
+
+    return jax.vmap(one)(rois)
+
+
+@register("_contrib_count_sketch",
+          params={"out_dim": (int, REQUIRED),
+                  "processing_batch_size": (int, 32)},
+          inputs=("data", "h", "s"),
+          aliases=("count_sketch",))
+def _count_sketch(attrs, data, h, s):
+    """Count-sketch projection (reference count_sketch.cc, used by compact
+    bilinear pooling): out[b, h[i]] += s[i] * data[b, i] — a scatter-add
+    over hashed feature indices."""
+    b = data.shape[0]
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    out = jnp.zeros((b, attrs.out_dim), dtype=data.dtype)
+    return out.at[:, hh].add(data * ss[None, :])
+
+
+# ---------------------------------------------------------------------------
+# SyncBatchNorm + legacy v1 op names
+# ---------------------------------------------------------------------------
+
+
+def _register_aliases():
+    """Legacy/alias op names resolving to their modern implementations.
+
+    - ``_contrib_SyncBatchNorm`` (reference sync_batch_norm-inl.h): under
+      GSPMD the batch axis of a sharded tensor is ONE logical axis, so
+      BatchNorm's mean/var reductions already span every device — XLA
+      inserts the cross-replica psums the reference implements by hand
+      (verified by tests/test_sync_bn.py against per-device baselines).
+      The alias makes that contract explicit and keeps symbol JSON
+      compatibility.
+    - ``*_v1`` ops (reference batch_norm_v1.cc, convolution_v1.cc,
+      pooling_v1.cc): pre-NNVM implementations whose semantics the modern
+      ops cover; kept as loadable names for old model-zoo JSON.
+    - ``fft``/``ifft``: short names for the contrib FFT pair.
+    """
+    from .registry import OP_REGISTRY
+
+    for legacy, modern in [
+        ("_contrib_SyncBatchNorm", "BatchNorm"),
+        ("SyncBatchNorm", "BatchNorm"),
+        ("BatchNorm_v1", "BatchNorm"),
+        ("Convolution_v1", "Convolution"),
+        ("Pooling_v1", "Pooling"),
+        ("fft", "_contrib_fft"),
+        ("ifft", "_contrib_ifft"),
+    ]:
+        OP_REGISTRY.setdefault(legacy, OP_REGISTRY[modern])
+
+
+_register_aliases()

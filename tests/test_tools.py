@@ -76,8 +76,12 @@ def test_flakiness_checker_target_normalization():
 
 
 def test_diagnose_runs():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # never dial the accelerator relay from a diagnostics subprocess — a
+    # wedged tunnel would hang the import (see .claude/skills/verify)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     out = subprocess.run([sys.executable, str(REPO / "tools" / "diagnose.py")],
                          capture_output=True, text=True, timeout=180,
-                         env=dict(os.environ))
+                         env=env)
     assert out.returncode == 0
     assert "mxnet_tpu Info" in out.stdout and "JAX Info" in out.stdout

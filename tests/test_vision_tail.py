@@ -1,0 +1,126 @@
+"""Tests for the deformable/PS-ROI/count-sketch op tail and the
+SyncBatchNorm sharding contract.
+
+Reference models: tests/python/unittest/test_operator.py
+(test_deformable_convolution — zero offsets must equal plain convolution),
+test_psroipooling, count_sketch tests, and the sync_batch_norm cross-device
+statistics check (tests/python/gpu/test_operator_gpu.py).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray.ndarray import invoke
+from mxnet_tpu.ops.registry import get_op
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.randn(2, 4, 9, 9).astype(np.float32))
+    w = mx.nd.array(rs.randn(6, 4, 3, 3).astype(np.float32))
+    b = mx.nd.array(rs.randn(6).astype(np.float32))
+    offset = mx.nd.zeros((2, 2 * 9, 7, 7))
+    out_d = invoke("_contrib_DeformableConvolution", x, offset, w, b,
+                   kernel=(3, 3), num_filter=6)
+    out_c = invoke("Convolution", x, w, b, kernel=(3, 3), num_filter=6)
+    np.testing.assert_allclose(out_d.asnumpy(), out_c.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_shift():
+    """A constant integer offset of (0, 1) equals convolving the input
+    shifted left by one pixel (interior pixels)."""
+    rs = np.random.RandomState(1)
+    x_np = rs.randn(1, 2, 8, 8).astype(np.float32)
+    w = mx.nd.array(rs.randn(3, 2, 3, 3).astype(np.float32))
+    x = mx.nd.array(x_np)
+    off = np.zeros((1, 18, 6, 6), np.float32)
+    off[:, 1::2] = 1.0  # dx = +1 for every tap
+    out_d = invoke("_contrib_DeformableConvolution", x, mx.nd.array(off), w,
+                   kernel=(3, 3), num_filter=3, no_bias=True)
+    shifted = np.zeros_like(x_np)
+    shifted[..., :-1] = x_np[..., 1:]
+    out_c = invoke("Convolution", mx.nd.array(shifted), w,
+                   kernel=(3, 3), num_filter=3, no_bias=True)
+    # columns whose +1-shifted taps stay in bounds match exactly
+    np.testing.assert_allclose(out_d.asnumpy()[..., :5],
+                               out_c.asnumpy()[..., :5], rtol=1e-4, atol=1e-4)
+
+
+def test_psroi_pooling_group_selection():
+    p, odim = 2, 3
+    c = odim * p * p
+    data = np.zeros((1, c, 8, 8), np.float32)
+    for ch in range(c):
+        data[0, ch] = ch  # each score map is a distinct constant
+    rois = mx.nd.array(np.array([[0, 0, 0, 7, 7]], np.float32))
+    out = invoke("_contrib_PSROIPooling", mx.nd.array(data), rois,
+                 spatial_scale=1.0, output_dim=odim, pooled_size=p)
+    got = out.asnumpy()
+    assert got.shape == (1, odim, p, p)
+    for ci in range(odim):
+        for py in range(p):
+            for px in range(p):
+                expected = ci * p * p + py * p + px
+                assert got[0, ci, py, px] == pytest.approx(expected), \
+                    (ci, py, px)
+
+
+def test_count_sketch():
+    data = mx.nd.array(np.array([[1.0, 2.0, 3.0, 4.0]], np.float32))
+    h = mx.nd.array(np.array([[0, 1, 0, 2]], np.float32))
+    s = mx.nd.array(np.array([[1, -1, 1, 1]], np.float32))
+    out = invoke("_contrib_count_sketch", data, h, s, out_dim=3)
+    np.testing.assert_allclose(out.asnumpy(), [[4.0, -2.0, 4.0]])
+
+
+def test_legacy_aliases_resolve():
+    for name in ("BatchNorm_v1", "Convolution_v1", "Pooling_v1", "fft",
+                 "ifft", "_contrib_SyncBatchNorm"):
+        get_op(name)
+
+
+def test_sync_batch_norm_global_stats_under_sharding():
+    """The SyncBatchNorm contract (reference sync_batch_norm-inl.h): batch
+    statistics span ALL devices. Under GSPMD a batch-sharded BatchNorm
+    already reduces over the full logical batch; verify the sharded output
+    equals the full-batch single-device result and differs from the
+    per-shard one."""
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    opdef = get_op("BatchNorm")
+    attrs = opdef.parse_attrs({"fix_gamma": "False", "eps": "1e-3"})
+    rs = np.random.RandomState(0)
+    # make shard means differ so per-shard BN is distinguishable
+    x = rs.randn(16, 4, 3, 3).astype(np.float32)
+    x += np.repeat(np.arange(8, dtype=np.float32)[:, None, None, None] * 3.0,
+                   2, axis=0)
+    gamma = np.ones(4, np.float32)
+    beta = np.zeros(4, np.float32)
+    mmean = np.zeros(4, np.float32)
+    mvar = np.ones(4, np.float32)
+
+    from mxnet_tpu import _global
+
+    def bn(data):
+        # batch statistics (not moving averages) — train-mode BN
+        with _global.train_mode_scope(True):
+            out, _, _ = opdef.fcompute(attrs, data, gamma, beta, mmean, mvar)
+        return out
+
+    ref = bn(jnp.asarray(x))  # full batch, one device
+
+    mesh = Mesh(np.asarray(devices[:8]), ("dp",))
+    sharded = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    out_sharded = jax.jit(bn)(sharded)
+    np.testing.assert_allclose(np.asarray(out_sharded), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    # per-shard BN (what an unsynchronized implementation would compute)
+    per_shard = np.concatenate([np.asarray(bn(jnp.asarray(x[i:i + 2])))
+                                for i in range(0, 16, 2)])
+    assert not np.allclose(per_shard, np.asarray(ref), atol=1e-2)
