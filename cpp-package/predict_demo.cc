@@ -1,0 +1,94 @@
+/*
+ * C++ inference frontend over the C predict API (src/predict/predict.cc) —
+ * the analogue of the reference's example/image-classification/predict-cpp
+ * and the matlab/amalgamation consumers of include/mxnet/c_predict_api.h.
+ *
+ * Usage: predict_demo <prefix> <batch> <dim>
+ *   loads <prefix>-symbol.json + <prefix>-0000.params, feeds a (batch, dim)
+ *   input of 0.01*i values, prints each output value on one line.
+ *
+ * Build: make -C cpp-package predict_demo
+ */
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+extern "C" {
+const char *MXPredGetLastError(void);
+int MXPredCreate(const char *symbol_json, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char **input_keys,
+                 const uint32_t *input_shape_indptr,
+                 const uint32_t *input_shape_data, void **out);
+int MXPredSetInput(void *handle, const char *key, const float *data,
+                   uint32_t size);
+int MXPredForward(void *handle);
+int MXPredGetOutputShape(void *handle, uint32_t index, uint32_t **shape_data,
+                         uint32_t *shape_ndim);
+int MXPredGetOutput(void *handle, uint32_t index, float *data, uint32_t size);
+int MXPredFree(void *handle);
+}
+
+#define CHECK_OK(call)                                            \
+  do {                                                            \
+    if ((call) != 0) {                                            \
+      std::fprintf(stderr, "error: %s\n", MXPredGetLastError());  \
+      return 1;                                                   \
+    }                                                             \
+  } while (0)
+
+static std::string ReadFile(const std::string &path) {
+  std::ifstream f(path, std::ios::binary);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    std::fprintf(stderr, "usage: %s <prefix> <batch> <dim>\n", argv[0]);
+    return 2;
+  }
+  std::string prefix = argv[1];
+  uint32_t batch = (uint32_t)std::atoi(argv[2]);
+  uint32_t dim = (uint32_t)std::atoi(argv[3]);
+
+  std::string symbol_json = ReadFile(prefix + "-symbol.json");
+  std::string params = ReadFile(prefix + "-0000.params");
+  if (symbol_json.empty()) {
+    std::fprintf(stderr, "cannot read %s-symbol.json\n", prefix.c_str());
+    return 1;
+  }
+
+  const char *input_keys[] = {"data"};
+  uint32_t indptr[] = {0, 2};
+  uint32_t shape_data[] = {batch, dim};
+  void *pred = nullptr;
+  CHECK_OK(MXPredCreate(symbol_json.c_str(), params.data(),
+                        (int)params.size(), /*dev_type=cpu*/ 1, 0, 1,
+                        input_keys, indptr, shape_data, &pred));
+
+  std::vector<float> input(batch * dim);
+  for (size_t i = 0; i < input.size(); ++i) input[i] = 0.01f * (float)i;
+  CHECK_OK(MXPredSetInput(pred, "data", input.data(), (uint32_t)input.size()));
+  CHECK_OK(MXPredForward(pred));
+
+  uint32_t *oshape = nullptr, ondim = 0;
+  CHECK_OK(MXPredGetOutputShape(pred, 0, &oshape, &ondim));
+  uint32_t osize = 1;
+  std::printf("output shape:");
+  for (uint32_t i = 0; i < ondim; ++i) {
+    std::printf(" %u", oshape[i]);
+    osize *= oshape[i];
+  }
+  std::printf("\n");
+  std::vector<float> out(osize);
+  CHECK_OK(MXPredGetOutput(pred, 0, out.data(), osize));
+  for (uint32_t i = 0; i < osize; ++i) std::printf("%.6f\n", out[i]);
+  CHECK_OK(MXPredFree(pred));
+  return 0;
+}

@@ -1,0 +1,111 @@
+"""Python half of the C predict API (src/predict/predict.cc).
+
+The reference's ``c_predict_api.h`` exposes inference (load symbol JSON +
+params, bind, set input, forward, read output) as a flat C ABI consumed by
+the C++/Matlab/mobile frontends (``src/c_api/c_predict_api.cc``). In the
+TPU build the executor lives in Python-on-JAX, so the C ABI embeds a
+CPython interpreter and drives these functions; data crosses the boundary
+as raw float32 buffers.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+_HANDLES: Dict[int, "_Predictor"] = {}
+_NEXT = [1]
+
+
+class _Predictor:
+    def __init__(self, symbol_json: str, param_bytes: bytes,
+                 dev_type: int, input_shapes: Dict[str, Tuple[int, ...]]):
+        import mxnet_tpu as mx
+        from mxnet_tpu import symbol as sym_mod
+        from mxnet_tpu.ndarray import io_utils
+
+        self.mx = mx
+        sym = sym_mod.load_json(symbol_json)
+        ctx = mx.tpu() if dev_type == 2 else mx.cpu()
+        params = {}
+        if param_bytes:
+            import io
+            import os
+            import tempfile
+
+            fd, tmp = tempfile.mkstemp(suffix=".params")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(param_bytes)
+                loaded = io_utils.load(tmp)
+            finally:
+                os.remove(tmp)
+            for k, v in loaded.items():
+                name = k.split(":", 1)[-1]  # strip arg:/aux: prefixes
+                params[name] = v
+        arg_names = sym.list_arguments()
+        aux_names = sym.list_auxiliary_states()
+        shapes = dict(input_shapes)
+        for name in arg_names:
+            if name in params and name not in shapes:
+                shapes[name] = params[name].shape
+        self.executor = sym.simple_bind(ctx, grad_req="null", **shapes)
+        self.executor.copy_params_from(
+            {k: v for k, v in params.items() if k in arg_names},
+            {k: v for k, v in params.items() if k in aux_names},
+            allow_extra_params=True)
+        self.input_names = list(input_shapes)
+        self.input_shapes = input_shapes
+        self.inputs: Dict[str, np.ndarray] = {}
+        self.outputs: List[np.ndarray] = []
+
+    def set_input(self, key: str, buf: bytes):
+        shape = self.input_shapes[key]
+        arr = np.frombuffer(buf, dtype=np.float32).reshape(shape)
+        self.inputs[key] = arr
+
+    def forward(self):
+        feed = {k: self.mx.nd.array(v) for k, v in self.inputs.items()}
+        outs = self.executor.forward(is_train=False, **feed)
+        self.outputs = [o.asnumpy().astype(np.float32) for o in outs]
+
+    def reshape(self, new_shapes: Dict[str, Tuple[int, ...]]):
+        self.input_shapes.update(new_shapes)
+        self.executor = self.executor.reshape(**new_shapes)
+
+
+def create(symbol_json: str, param_bytes: bytes, dev_type: int,
+           input_names: List[str], input_shapes: List[List[int]]) -> int:
+    h = _NEXT[0]
+    _NEXT[0] += 1
+    _HANDLES[h] = _Predictor(symbol_json, param_bytes, dev_type,
+                             {n: tuple(s) for n, s in
+                              zip(input_names, input_shapes)})
+    return h
+
+
+def set_input(handle: int, key: str, buf: bytes) -> None:
+    _HANDLES[handle].set_input(key, buf)
+
+
+def forward(handle: int) -> None:
+    _HANDLES[handle].forward()
+
+
+def num_outputs(handle: int) -> int:
+    return len(_HANDLES[handle].executor.outputs)
+
+
+def get_output_shape(handle: int, index: int) -> List[int]:
+    p = _HANDLES[handle]
+    if p.outputs:
+        return list(p.outputs[index].shape)
+    return list(p.executor.outputs[index].shape)
+
+
+def get_output(handle: int, index: int) -> bytes:
+    return _HANDLES[handle].outputs[index].tobytes()
+
+
+def free(handle: int) -> None:
+    _HANDLES.pop(handle, None)

@@ -1,0 +1,230 @@
+/*
+ * C predict API over the embedded Python executor.
+ *
+ * Re-designs the reference's standalone inference ABI
+ * (include/mxnet/c_predict_api.h:78-200, src/c_api/c_predict_api.cc):
+ * MXPredCreate / MXPredSetInput / MXPredForward / MXPredGetOutputShape /
+ * MXPredGetOutput / MXPredFree, the surface the cpp/matlab/amalgamation
+ * frontends build on. The reference's C++ core runs the graph natively; in
+ * the TPU build the executor is Python-on-JAX, so this library embeds a
+ * CPython interpreter (initialized lazily, GIL-scoped per call) and drives
+ * mxnet_tpu._predict_embed. Tensor data crosses the ABI as raw float32
+ * buffers, exactly like the reference API.
+ *
+ * Build (see cpp-package/Makefile):
+ *   g++ -std=c++17 -O2 -fPIC -shared src/predict/predict.cc \
+ *       $(python3-config --includes) -o src/build/libmxtpu_predict.so \
+ *       $(python3-config --ldflags --embed)
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#define PRED_API __attribute__((visibility("default")))
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void SetErr(const std::string &m) { g_last_error = m; }
+
+// Derive the repo root from this library's own path (src/build/lib.. -> repo)
+std::string RepoRoot() {
+  Dl_info info;
+  if (dladdr(reinterpret_cast<void *>(&RepoRoot), &info) && info.dli_fname) {
+    std::string p = info.dli_fname;
+    auto cut = p.rfind("/src/");
+    if (cut != std::string::npos) return p.substr(0, cut);
+  }
+  return ".";
+}
+
+std::once_flag g_init_once;
+bool g_init_ok = false;
+
+void InitPython() {
+  std::call_once(g_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL acquired by initialization so callers can Ensure it
+      PyEval_SaveThread();
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *sys_path = PySys_GetObject("path");
+    PyObject *root = PyUnicode_FromString(RepoRoot().c_str());
+    PyList_Insert(sys_path, 0, root);
+    Py_DECREF(root);
+    g_init_ok = true;
+    PyGILState_Release(st);
+  });
+}
+
+// Call mxnet_tpu._predict_embed.<fn>(*args); returns new ref or null+err.
+PyObject *CallEmbed(const char *fn, PyObject *args /* stolen */) {
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu._predict_embed");
+  if (!mod) {
+    PyErr_Print();
+    Py_XDECREF(args);
+    SetErr("MXPred: cannot import mxnet_tpu._predict_embed");
+    return nullptr;
+  }
+  PyObject *f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (!f) {
+    Py_XDECREF(args);
+    SetErr(std::string("MXPred: missing helper ") + fn);
+    return nullptr;
+  }
+  PyObject *res = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (!res) {
+    PyObject *etype, *eval, *etb;
+    PyErr_Fetch(&etype, &eval, &etb);
+    PyObject *s = eval ? PyObject_Str(eval) : nullptr;
+    SetErr(std::string("MXPred ") + fn + " failed: " +
+           (s ? PyUnicode_AsUTF8(s) : "unknown python error"));
+    Py_XDECREF(s);
+    Py_XDECREF(etype);
+    Py_XDECREF(eval);
+    Py_XDECREF(etb);
+    return nullptr;
+  }
+  return res;
+}
+
+struct PredHandle {
+  long id;
+  std::vector<uint32_t> shape_buf;  // backs MXPredGetOutputShape pointers
+};
+
+}  // namespace
+
+extern "C" {
+
+PRED_API const char *MXPredGetLastError(void) { return g_last_error.c_str(); }
+
+PRED_API int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                          int param_size, int dev_type, int dev_id,
+                          uint32_t num_input_nodes, const char **input_keys,
+                          const uint32_t *input_shape_indptr,
+                          const uint32_t *input_shape_data, void **out) {
+  (void)dev_id;
+  InitPython();
+  if (!g_init_ok) {
+    SetErr("MXPredCreate: python runtime failed to initialize");
+    return -1;
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject *names = PyList_New(num_input_nodes);
+  PyObject *shapes = PyList_New(num_input_nodes);
+  for (uint32_t i = 0; i < num_input_nodes; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(input_keys[i]));
+    uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject *shp = PyList_New(hi - lo);
+    for (uint32_t j = lo; j < hi; ++j)
+      PyList_SetItem(shp, j - lo, PyLong_FromUnsignedLong(input_shape_data[j]));
+    PyList_SetItem(shapes, i, shp);
+  }
+  PyObject *args = Py_BuildValue(
+      "(s y# i N N)", symbol_json_str,
+      static_cast<const char *>(param_bytes), (Py_ssize_t)param_size,
+      dev_type, names, shapes);
+  PyObject *res = CallEmbed("create", args);
+  int rc = -1;
+  if (res) {
+    auto *h = new PredHandle{PyLong_AsLong(res), {}};
+    Py_DECREF(res);
+    *out = h;
+    rc = 0;
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+PRED_API int MXPredSetInput(void *handle, const char *key, const float *data,
+                            uint32_t size) {
+  auto *h = static_cast<PredHandle *>(handle);
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject *args = Py_BuildValue(
+      "(l s y#)", h->id, key, reinterpret_cast<const char *>(data),
+      (Py_ssize_t)(size * sizeof(float)));
+  PyObject *res = CallEmbed("set_input", args);
+  PyGILState_Release(st);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+PRED_API int MXPredForward(void *handle) {
+  auto *h = static_cast<PredHandle *>(handle);
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject *res = CallEmbed("forward", Py_BuildValue("(l)", h->id));
+  PyGILState_Release(st);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+PRED_API int MXPredGetOutputShape(void *handle, uint32_t index,
+                                  uint32_t **shape_data, uint32_t *shape_ndim) {
+  auto *h = static_cast<PredHandle *>(handle);
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject *res = CallEmbed("get_output_shape",
+                            Py_BuildValue("(l I)", h->id, index));
+  int rc = -1;
+  if (res) {
+    Py_ssize_t n = PyList_Size(res);
+    h->shape_buf.resize(n);
+    for (Py_ssize_t i = 0; i < n; ++i)
+      h->shape_buf[i] = (uint32_t)PyLong_AsUnsignedLong(PyList_GetItem(res, i));
+    Py_DECREF(res);
+    *shape_data = h->shape_buf.data();
+    *shape_ndim = (uint32_t)n;
+    rc = 0;
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+PRED_API int MXPredGetOutput(void *handle, uint32_t index, float *data,
+                             uint32_t size) {
+  auto *h = static_cast<PredHandle *>(handle);
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject *res = CallEmbed("get_output", Py_BuildValue("(l I)", h->id, index));
+  int rc = -1;
+  if (res) {
+    char *buf;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(res, &buf, &len) == 0) {
+      if ((uint32_t)(len / sizeof(float)) != size) {
+        SetErr("MXPredGetOutput: size mismatch");
+      } else {
+        std::memcpy(data, buf, len);
+        rc = 0;
+      }
+    }
+    Py_DECREF(res);
+  }
+  PyGILState_Release(st);
+  return rc;
+}
+
+PRED_API int MXPredFree(void *handle) {
+  auto *h = static_cast<PredHandle *>(handle);
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject *res = CallEmbed("free", Py_BuildValue("(l)", h->id));
+  Py_XDECREF(res);
+  PyGILState_Release(st);
+  delete h;
+  return 0;
+}
+
+}  // extern "C"
