@@ -50,6 +50,7 @@ for _m in (
     "callback",
     "monitor",
     "profiler",
+    "rtc",
     "visualization",
     "image",
     "parallel",
