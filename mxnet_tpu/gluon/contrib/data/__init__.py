@@ -1,0 +1,5 @@
+"""Contrib datasets and samplers (reference gluon/contrib/data/)."""
+from . import text
+from .sampler import IntervalSampler
+
+__all__ = ["text", "IntervalSampler"]

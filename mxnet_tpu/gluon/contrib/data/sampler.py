@@ -1,0 +1,34 @@
+"""Contrib samplers (reference python/mxnet/gluon/contrib/data/sampler.py)."""
+from __future__ import annotations
+
+from ...data.sampler import Sampler
+
+__all__ = ["IntervalSampler"]
+
+
+class IntervalSampler(Sampler):
+    """Sample indices ``i, i+interval, i+2*interval, ...`` for each start
+    ``i`` in ``[0, interval)`` (reference IntervalSampler) — the access
+    pattern truncated-BPTT language models use so consecutive batches are
+    contiguous in the corpus.
+
+    With ``rollover=False`` only the ``i=0`` pass is produced.
+    """
+
+    def __init__(self, length, interval, rollover=True):
+        assert 0 < interval <= length, (
+            "interval (%d) must be in (0, %d]" % (interval, length))
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        starts = range(self._interval) if self._rollover else [0]
+        for i in starts:
+            for j in range(i, self._length, self._interval):
+                yield j
+
+    def __len__(self):
+        if self._rollover:
+            return self._length
+        return len(range(0, self._length, self._interval))

@@ -1,0 +1,103 @@
+"""Language-modelling text datasets.
+
+API parity with the reference ``python/mxnet/gluon/contrib/data/text.py``
+(WikiText2/WikiText103: tokenized corpora chopped into fixed-length
+samples, vocabulary built on first load). This environment has no network
+egress, so datasets resolve their token files from ``root`` (place the
+extracted ``wiki.{train,valid,test}.tokens`` there) instead of downloading;
+:class:`CorpusDataset` works with any local text file and is what the
+tests exercise.
+"""
+from __future__ import annotations
+
+import io
+import os
+from collections import Counter
+
+import numpy as np
+
+from ....base import MXNetError
+from ...data.dataset import Dataset
+
+__all__ = ["CorpusDataset", "WikiText2", "WikiText103"]
+
+
+class CorpusDataset(Dataset):
+    """Fixed-length (data, label) samples from a tokenized text file.
+
+    Each sample is ``seq_len`` token ids; the label is the sequence shifted
+    by one (next-token prediction), the reference's _WikiText layout.
+    """
+
+    def __init__(self, filename, seq_len=35, bos=None, eos="<eos>",
+                 vocab=None, encoding="utf-8"):
+        from ....contrib import text as text_mod
+
+        self._seq_len = seq_len
+        with io.open(filename, "r", encoding=encoding) as f:
+            raw = f.read()
+        tokens = []
+        for line in raw.split("\n"):
+            line = line.split()
+            if not line:
+                continue
+            if bos is not None:
+                tokens.append(bos)
+            tokens.extend(line)
+            if eos is not None:
+                tokens.append(eos)
+        if vocab is None:
+            vocab = text_mod.Vocabulary(Counter(tokens), unknown_token="<unk>")
+        self.vocabulary = vocab
+        ids = np.asarray(vocab.to_indices(tokens), dtype=np.int32)
+        n = (len(ids) - 1) // seq_len
+        if n < 1:
+            raise MXNetError("corpus too short for seq_len=%d" % seq_len)
+        self._data = ids[: n * seq_len].reshape(n, seq_len)
+        self._label = ids[1: n * seq_len + 1].reshape(n, seq_len)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        from .... import ndarray as nd
+
+        return nd.array(self._data[idx]), nd.array(self._label[idx])
+
+
+class _WikiText(CorpusDataset):
+    _namespace = None
+    _segment_files = {"train": "wiki.train.tokens",
+                      "val": "wiki.valid.tokens",
+                      "test": "wiki.test.tokens"}
+
+    def __init__(self, root, segment, seq_len, vocab):
+        fname = os.path.join(os.path.expanduser(root),
+                             self._segment_files[segment])
+        if not os.path.isfile(fname):
+            raise MXNetError(
+                "%s not found at %s — this build has no network egress; "
+                "download the %s archive elsewhere and extract it into %r"
+                % (self._segment_files[segment], fname, self._namespace,
+                   root))
+        super().__init__(fname, seq_len=seq_len, vocab=vocab)
+
+
+class WikiText2(_WikiText):
+    """WikiText-2 (reference text.py:WikiText2), local files only."""
+
+    _namespace = "wikitext-2"
+
+    def __init__(self, root="~/.mxnet/datasets/wikitext-2", segment="train",
+                 seq_len=35, vocab=None):
+        super().__init__(root, segment, seq_len, vocab)
+
+
+class WikiText103(_WikiText):
+    """WikiText-103 (reference text.py:WikiText103), local files only."""
+
+    _namespace = "wikitext-103"
+
+    def __init__(self, root="~/.mxnet/datasets/wikitext-103", segment="train",
+                 seq_len=35, vocab=None):
+        super().__init__(root, segment, seq_len, vocab)

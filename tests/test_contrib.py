@@ -207,3 +207,40 @@ def test_legacy_contrib_autograd():
     grads, loss = gl(x)
     np.testing.assert_allclose(grads[0].asnumpy(), 2 * x.asnumpy(), rtol=1e-6)
     assert abs(float(loss.asnumpy()) - 14.0) < 1e-5
+
+
+# ---------------------------------------------------------------------------
+# gluon.contrib.data
+# ---------------------------------------------------------------------------
+
+def test_interval_sampler():
+    from mxnet_tpu.gluon.contrib.data import IntervalSampler
+
+    s = IntervalSampler(10, 3)
+    idx = list(s)
+    assert idx[:4] == [0, 3, 6, 9]  # first pass strides the interval
+    assert sorted(idx) == list(range(10)) and len(s) == 10
+    s2 = IntervalSampler(10, 3, rollover=False)
+    assert list(s2) == [0, 3, 6, 9] and len(s2) == 4
+
+
+def test_corpus_dataset(tmp_path):
+    from mxnet_tpu.gluon.contrib.data.text import CorpusDataset
+
+    p = tmp_path / "corpus.txt"
+    p.write_text("the cat sat\nthe dog ran\n" * 20)
+    ds = CorpusDataset(str(p), seq_len=5)
+    assert len(ds) >= 2
+    data, label = ds[0]
+    assert data.shape == (5,) and label.shape == (5,)
+    # label is data shifted by one token across the corpus stream
+    assert int(label.asnumpy()[0]) == int(ds._data[0][1])
+    vocab = ds.vocabulary
+    assert "<eos>" in vocab.token_to_idx and "cat" in vocab.token_to_idx
+
+
+def test_wikitext_missing_file_message(tmp_path):
+    from mxnet_tpu.gluon.contrib.data.text import WikiText2
+
+    with pytest.raises(mx.MXNetError, match="no network egress"):
+        WikiText2(root=str(tmp_path))
