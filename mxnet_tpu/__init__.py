@@ -57,6 +57,8 @@ for _m in (
     "contrib",
     "test_utils",
     "util",
+    "attribute",
+    "libinfo",
 ):
     try:
         globals()[_m] = _importlib.import_module("." + _m, __name__)
@@ -73,6 +75,11 @@ if hasattr(globals().get("symbol"), "Symbol"):
     var = sym.var
 if "module" in globals():
     mod = globals()["module"]
+# reference aliases: mx.viz (visualization), AttrScope at top level
+if "visualization" in globals():
+    viz = globals()["visualization"]
+if "attribute" in globals():
+    AttrScope = globals()["attribute"].AttrScope
 if hasattr(globals().get("model"), "save_checkpoint"):
     save_checkpoint = globals()["model"].save_checkpoint
     load_checkpoint = globals()["model"].load_checkpoint

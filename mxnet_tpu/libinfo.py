@@ -1,0 +1,27 @@
+"""Library metadata (reference python/mxnet/libinfo.py: __version__ and
+find_lib_path resolving libmxnet.so)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["__version__", "find_lib_path", "find_include_path"]
+
+#: capability parity target: the reference checkout is MXNet 1.3.0
+__version__ = "1.3.0+tpu"
+
+
+def find_lib_path():
+    """Paths of the native runtime libraries (reference find_lib_path —
+    there libmxnet.so IS the framework; here the compute path is JAX/XLA
+    and the native libs carry the host runtime + predict ABI)."""
+    build = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "src", "build")
+    libs = [os.path.join(build, n)
+            for n in ("libmxtpu.so", "libmxtpu_predict.so")]
+    return [p for p in libs if os.path.isfile(p)]
+
+
+def find_include_path():
+    """Directory of the C ABI headers (reference include/mxnet)."""
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "src")

@@ -705,6 +705,13 @@ def _invoke(op_name: str, sym_inputs: List[Symbol], attrs: Dict[str, Any],
             vnode = _Node(None, "%s_%s" % (name, missing), {}, [])
             entries.append((vnode, 0))
     node = _Node(op_name, name, dict(attrs), entries)
+    # scope-attached attrs (reference attribute.py:AttrScope — ctx_group,
+    # __lr_mult__, custom keys) land in _extra_attrs like _set_attr's
+    from .attribute import AttrScope
+
+    scope_attrs = AttrScope.current().get(None)
+    if scope_attrs:
+        node._extra_attrs.update(scope_attrs)
     n_out = opdef.num_outputs(parsed)
     # primary output only for multi-output layer ops whose extra outputs are
     # internal (BatchNorm mean/var); SliceChannel-style ops expose all
@@ -786,7 +793,10 @@ def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
     for k, v in kwargs.items():
         if k.startswith("__") and k.endswith("__"):
             extra[k] = str(v)
-    node._extra_attrs = extra
+    # scope attrs apply under explicit ones (reference AttrScope.get)
+    from .attribute import AttrScope
+
+    node._extra_attrs = AttrScope.current().get(extra)
     return sym
 
 

@@ -1,0 +1,55 @@
+"""General utilities (reference python/mxnet/util.py).
+
+The reference's util.py carries makedirs/py3 shims plus feature helpers;
+here the useful survivors are kept and TPU-stack introspection added.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+__all__ = ["makedirs", "use_np_shape", "get_gpu_count", "get_gpu_memory",
+           "default_array_context"]
+
+
+def makedirs(d):
+    """Create directory recursively if missing (reference util.py:makedirs)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def use_np_shape(func):
+    """Zero-size/unknown-shape semantics are native on this stack (jax/numpy
+    shapes); kept as an identity decorator for reference-code compat."""
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        return func(*args, **kwargs)
+    return wrapped
+
+
+def get_gpu_count():
+    """Accelerator count (reference mx.context.num_gpus analogue)."""
+    from .context import num_tpus
+
+    return num_tpus()
+
+
+def get_gpu_memory(dev_id=0):
+    """Per-device (free, total) memory in bytes when the backend reports it."""
+    import jax
+
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
+    if dev_id >= len(devs):
+        return (0, 0)
+    try:
+        stats = devs[dev_id].memory_stats()
+        total = stats.get("bytes_limit", 0)
+        used = stats.get("bytes_in_use", 0)
+        return (total - used, total)
+    except Exception:  # pragma: no cover - backend without memory_stats
+        return (0, 0)
+
+
+def default_array_context():
+    from .context import current_context
+
+    return current_context()

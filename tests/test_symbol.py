@@ -89,3 +89,37 @@ def test_group2ctx_model_parallel_placement():
     np.testing.assert_allclose(out_mp, out_plain, rtol=1e-5)
     ex_mp.backward()
     assert np.isfinite(ex_mp.grad_dict["fc1_weight"].asnumpy()).all()
+
+
+def test_attr_scope():
+    """AttrScope attaches attrs to symbols created inside it (reference
+    python/mxnet/attribute.py; tests/python/unittest/test_attr.py)."""
+    with mx.AttrScope(ctx_group="stage1", __lr_mult__="2"):
+        a = mx.sym.var("scoped_a")
+        b = mx.sym.FullyConnected(a, num_hidden=4, name="scoped_fc")
+        with mx.AttrScope(ctx_group="stage2"):
+            c = mx.sym.exp(b, name="scoped_exp")
+    d = mx.sym.var("unscoped")
+    assert a.attr("ctx_group") == "stage1"
+    assert a.attr("__lr_mult__") == "2"
+    assert b.attr("ctx_group") == "stage1"
+    # inner scope overrides, inherits the rest
+    assert c.attr("ctx_group") == "stage2"
+    assert c.attr("__lr_mult__") == "2"
+    assert d.attr("ctx_group") is None
+    # explicit attr beats the scope (reference AttrScope.get contract)
+    with mx.AttrScope(ctx_group="stage1"):
+        e = mx.sym.var("explicit", attr={"ctx_group": "stage9"})
+    assert e.attr("ctx_group") == "stage9"
+
+
+def test_libinfo_and_util():
+    from mxnet_tpu import libinfo, util
+
+    assert libinfo.__version__.startswith("1.3.0")
+    for p in libinfo.find_lib_path():
+        import os
+
+        assert os.path.isfile(p)
+    assert mx.viz is mx.visualization
+    assert util.get_gpu_count() >= 0
