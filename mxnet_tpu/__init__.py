@@ -54,6 +54,7 @@ for _m in (
     "visualization",
     "image",
     "parallel",
+    "sequence_parallel",
     "contrib",
     "test_utils",
     "util",
