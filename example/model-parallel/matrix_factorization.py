@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Model-parallel matrix factorization via group2ctx.
+
+Counterpart of the reference's ``example/model-parallel/
+matrix_factorization/`` (+ ``docs/faq/model_parallel_lstm.md``): the two
+embedding tables live in different ``ctx_group``s, mapped to different
+devices at bind time through ``group2ctx`` — the reference's manual model
+parallelism (``graph_executor.cc:1577``), realized here as XLA device
+placement constraints with automatic cross-device transfers.
+
+Run (2+ devices, e.g. the CPU test mesh):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python example/model-parallel/matrix_factorization.py --epochs 3
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_symbol(factor_size):
+    user = mx.sym.var("user")
+    item = mx.sym.var("item")
+    score = mx.sym.var("score")
+    with mx.AttrScope(ctx_group="embed_user"):
+        user_w = mx.sym.var("user_weight")
+        u = mx.sym.Embedding(user, weight=user_w, input_dim=0,
+                             output_dim=factor_size, name="user_embed")
+    with mx.AttrScope(ctx_group="embed_item"):
+        item_w = mx.sym.var("item_weight")
+        i = mx.sym.Embedding(item, weight=item_w, input_dim=0,
+                             output_dim=factor_size, name="item_embed")
+    pred = mx.sym.sum(u * i, axis=1)
+    return mx.sym.LinearRegressionOutput(pred, score, name="lro")
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--num-users", type=int, default=200)
+    parser.add_argument("--num-items", type=int, default=150)
+    parser.add_argument("--factor-size", type=int, default=16)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--epochs", type=int, default=4)
+    parser.add_argument("--lr", type=float, default=0.05)
+    args = parser.parse_args()
+
+    # synthetic low-rank ratings
+    rs = np.random.RandomState(0)
+    true_u = rs.randn(args.num_users, 4).astype(np.float32)
+    true_i = rs.randn(args.num_items, 4).astype(np.float32)
+    n = 4096
+    users = rs.randint(0, args.num_users, n).astype(np.float32)
+    items = rs.randint(0, args.num_items, n).astype(np.float32)
+    scores = np.einsum("nd,nd->n", true_u[users.astype(int)],
+                       true_i[items.astype(int)]).astype(np.float32)
+
+    net = build_symbol(args.factor_size)
+    # fix the embedding table sizes through shape hints
+    group2ctx = {"embed_user": mx.cpu(0), "embed_item": mx.cpu(1)}
+    ex = net.simple_bind(mx.cpu(), grad_req="write", group2ctx=group2ctx,
+                         user=(args.batch_size,), item=(args.batch_size,),
+                         score=(args.batch_size,),
+                         user_weight=(args.num_users, args.factor_size),
+                         item_weight=(args.num_items, args.factor_size))
+    for name, arr in ex.arg_dict.items():
+        if name.endswith("weight"):
+            arr[:] = rs.rand(*arr.shape).astype(np.float32) * 0.1
+
+    first = last = None
+    for epoch in range(args.epochs):
+        perm = rs.permutation(n)
+        total, nb = 0.0, 0
+        tic = time.time()
+        for s in range(0, n - args.batch_size + 1, args.batch_size):
+            idx = perm[s:s + args.batch_size]
+            out = ex.forward(is_train=True, user=mx.nd.array(users[idx]),
+                             item=mx.nd.array(items[idx]),
+                             score=mx.nd.array(scores[idx]))[0]
+            ex.backward()
+            for name, grad in ex.grad_dict.items():
+                if name.endswith("weight"):
+                    ex.arg_dict[name][:] = ex.arg_dict[name] - args.lr * grad
+            total += float(np.mean((out.asnumpy() - scores[idx]) ** 2))
+            nb += 1
+        rmse = np.sqrt(total / nb)
+        if first is None:
+            first = rmse
+        last = rmse
+        print("[epoch %d] rmse %.4f (%.0f samples/s)"
+              % (epoch, rmse, nb * args.batch_size / (time.time() - tic)))
+    print("rmse %.4f -> %.4f (%s)" % (first, last,
+                                      "improved" if last < first else "NOT improved"))
+    return 0 if last < first else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
