@@ -1,0 +1,98 @@
+"""Torch interop: run torch modules/functions inside the framework.
+
+TPU-native re-design of the reference's torch plugin (``plugin/torch/`` —
+``mxnet.th`` ran Torch7 tensor functions and nn criterions as MXNet
+operators). Here the bridge targets PyTorch (a baked-in CPU dependency of
+this environment): a ``torch.nn.Module`` or plain torch function executes
+inside the autograd tape as a :class:`~mxnet_tpu.autograd.Function` whose
+backward calls ``torch.autograd.grad``, so gradients flow through mixed
+mxnet_tpu/torch graphs — including into the torch module's own parameters
+(retrievable for a torch optimizer).
+
+This is a HOST-side escape hatch like the reference's plugin and the
+Custom-op bridge: the torch computation runs eagerly on CPU outside XLA,
+so use it for glue/validation, not the hot path.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .. import autograd
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+
+def _torch():
+    try:
+        import torch
+
+        return torch
+    except ImportError as exc:  # pragma: no cover - torch is baked in here
+        raise MXNetError("torch_bridge requires pytorch") from exc
+
+
+class TorchFunction(autograd.Function):
+    """Run a torch callable under our tape (reference plugin/torch op
+    bridge). ``trainable_params`` (torch tensors) also receive grads, which
+    accumulate in their ``.grad`` the usual torch way."""
+
+    def __init__(self, fn, trainable_params: Optional[List] = None):
+        super().__init__()
+        self._fn = fn
+        self._params = list(trainable_params or [])
+
+    def forward(self, *inputs):
+        torch = _torch()
+        tins = [torch.from_numpy(np.array(i.asnumpy())).requires_grad_(True)
+                for i in inputs]
+        with torch.enable_grad():
+            touts = self._fn(*tins)
+        single = torch.is_tensor(touts)
+        touts_t = (touts,) if single else tuple(touts)
+        self.save_for_backward(tins, touts_t)
+        outs = [NDArray(t.detach().numpy(), inputs[0].context)
+                for t in touts_t]
+        return outs[0] if single else outs
+
+    def backward(self, *output_grads):
+        torch = _torch()
+        tins, touts = self.saved_tensors
+        gouts = [torch.from_numpy(np.array(g.asnumpy())) for g in output_grads]
+        grads = torch.autograd.grad(
+            touts, tuple(tins) + tuple(self._params), gouts, allow_unused=True)
+        in_grads = grads[: len(tins)]
+        for p, g in zip(self._params, grads[len(tins):]):
+            if g is not None:
+                p.grad = g if p.grad is None else p.grad + g
+        return [NDArray(np.zeros(t.shape, np.float32)) if g is None
+                else NDArray(g.numpy().astype(np.float32))
+                for t, g in zip(tins, in_grads)]
+
+
+class TorchBlock(object):
+    """Wrap a ``torch.nn.Module`` as a callable block (reference
+    ``mxnet.th`` module wrappers).
+
+    Forward/backward run through :class:`TorchFunction`; the torch module's
+    parameters gather grads in their ``.grad`` fields so a torch optimizer
+    (``torch.optim.*``) can step them between batches.
+    """
+
+    def __init__(self, module):
+        torch = _torch()
+        if not isinstance(module, torch.nn.Module):
+            raise MXNetError("TorchBlock wraps a torch.nn.Module")
+        self.module = module
+
+    def torch_parameters(self):
+        return list(self.module.parameters())
+
+    def zero_grad(self):
+        for p in self.torch_parameters():
+            p.grad = None
+
+    def __call__(self, *inputs):
+        fn = TorchFunction(self.module, self.torch_parameters())
+        return fn(*inputs)
