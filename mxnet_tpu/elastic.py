@@ -25,6 +25,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+import numpy as np
+
 from .base import MXNetError
 
 __all__ = ["CheckpointManager", "run_elastic", "start_heartbeat",
@@ -132,6 +134,13 @@ class CheckpointManager(object):
         self.prefix = prefix
         self.max_keep = max_keep
         os.makedirs(directory, exist_ok=True)
+        # serializes checkpoint writes on the host dependency engine when
+        # saving asynchronously (write-after-write on one var keeps commits
+        # ordered; reference: checkpoint IO rides the engine like any op)
+        from . import engine as _engine
+
+        self._engine = _engine
+        self._io_var = _engine.new_var()
 
     # -- paths -------------------------------------------------------------
     def _manifest_path(self, epoch: int) -> str:
@@ -155,10 +164,30 @@ class CheckpointManager(object):
     # -- save/restore ------------------------------------------------------
     def save(self, epoch: int, net=None, trainer=None,
              params: Optional[Dict] = None,
-             metadata: Optional[Dict] = None) -> str:
+             metadata: Optional[Dict] = None, async_save: bool = False) -> str:
         """Commit a checkpoint for ``epoch``. ``net`` is a Gluon Block (or
         pass a raw name→NDArray ``params`` dict); ``trainer`` optionally
-        adds optimizer state."""
+        adds optimizer state.
+
+        ``async_save=True`` snapshots the parameter values now (host copy)
+        and performs the file writes on the host engine so training
+        continues immediately; writes to this manager stay ordered, and
+        :meth:`wait` / the next synchronous call joins them.
+        """
+        if async_save:
+            if net is not None:
+                # snapshot on the host so later updates don't race the write
+                params = {k: p.data().asnumpy()
+                          for k, p in net.collect_params().items()}
+                net = None
+            elif params is not None:
+                params = {k: (v.asnumpy() if hasattr(v, "asnumpy") else
+                              np.asarray(v)) for k, v in params.items()}
+            self._engine.push(
+                lambda: self.save(epoch, net=None, trainer=trainer,
+                                  params=params, metadata=metadata),
+                mutable_vars=[self._io_var])
+            return self._manifest_path(epoch)
         files = {}
         if net is not None:
             self._atomic_write(self._params_path(epoch),
@@ -202,8 +231,13 @@ class CheckpointManager(object):
                 except OSError:
                     pass
 
+    def wait(self) -> None:
+        """Join pending async saves (re-raising any write failure)."""
+        self._engine.wait_for_var(self._io_var)
+
     def latest_epoch(self) -> int:
-        """Newest committed epoch, or -1."""
+        """Newest committed epoch, or -1. Joins pending async saves first."""
+        self.wait()
         epochs = self._epochs()
         return epochs[-1] if epochs else -1
 

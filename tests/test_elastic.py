@@ -114,3 +114,29 @@ def test_manifest_metadata(tmp_path):
     assert manifest["epoch"] == 2
     assert manifest["metadata"]["step"] == 1234
     assert os.path.isfile(os.path.join(str(tmp_path), manifest["files"]["params"]))
+
+
+def test_async_save_ordered_and_joined(tmp_path):
+    """async_save rides the host engine: writes stay ordered per manager,
+    latest_epoch()/wait() join them, and the snapshot is taken at call time
+    (later mutations don't leak into the checkpoint)."""
+    cm = elastic.CheckpointManager(str(tmp_path))
+    w = mx.nd.full((3,), 1.0)
+    cm.save(0, params={"w": w}, async_save=True)
+    w[:] = 999.0  # mutate AFTER the async save snapshotted
+    for e in range(1, 4):
+        cm.save(e, params={"w": mx.nd.full((3,), float(e))}, async_save=True)
+    assert cm.latest_epoch() == 3  # joins all pending writes
+    np.testing.assert_allclose(cm.load_params(0)["w"].asnumpy(), [1.0] * 3)
+    np.testing.assert_allclose(cm.load_params(3)["w"].asnumpy(), [3.0] * 3)
+
+
+def test_async_save_failure_surfaces_at_wait(tmp_path):
+    cm = elastic.CheckpointManager(str(tmp_path / "sub"))
+    import os
+    import shutil
+
+    shutil.rmtree(str(tmp_path / "sub"))  # make the write fail
+    cm.save(0, params={"w": mx.nd.ones((2,))}, async_save=True)
+    with pytest.raises(Exception):
+        cm.wait()

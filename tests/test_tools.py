@@ -85,3 +85,23 @@ def test_diagnose_runs():
                          env=env)
     assert out.returncode == 0
     assert "mxnet_tpu Info" in out.stdout and "JAX Info" in out.stdout
+
+
+def test_im2rec_multithread(tmp_path):
+    """--num-thread packs via the host engine with serialized writes."""
+    import im2rec
+
+    from mxnet_tpu import recordio
+
+    _write_images(tmp_path / "imgs")
+    prefix = str(tmp_path / "data")
+    im2rec.main(["--list", "--recursive", prefix, str(tmp_path / "imgs")])
+    assert im2rec.main(["--resize", "16", "--encoding", ".png",
+                        "--num-thread", "4", prefix,
+                        str(tmp_path / "imgs")]) == 0
+    r = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    assert sorted(r.keys) == list(range(6))
+    for k in r.keys:
+        h, img = recordio.unpack_img(r.read_idx(k))
+        assert min(img.shape[:2]) == 16
+    r.close()

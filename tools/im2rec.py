@@ -103,24 +103,56 @@ def pack_one(args, idx, labels, rel_path):
 
 
 def make_rec(args, lst_path):
-    from mxnet_tpu import recordio
+    from mxnet_tpu import engine, recordio
 
     prefix = os.path.splitext(lst_path)[0]
     rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
-    count, errors = 0, 0
+    count = [0]
+    errors = [0]
     tic = time.time()
-    for idx, labels, rel in read_list(lst_path):
-        try:
-            rec.write_idx(idx, pack_one(args, idx, labels, rel))
-            count += 1
-        except Exception as exc:  # noqa: BLE001 - skip unreadable images
-            errors += 1
-            print("skipping %s: %s" % (rel, exc), file=sys.stderr)
-        if count % 1000 == 0 and count:
-            print("packed %d images (%.1f img/s)" % (count, count / (time.time() - tic)))
+
+    if args.num_thread > 1:
+        # parallel packing on the host dependency engine (reference
+        # im2rec.py --num-thread): decode/resize/encode jobs run on worker
+        # threads; each finished job pushes its write as an op mutating the
+        # writer var, so file writes stay serialized while packing overlaps.
+        writer_var = engine.new_var()
+
+        def make_job(idx, labels, rel):
+            def pack_job():
+                try:
+                    packed = pack_one(args, idx, labels, rel)
+                except Exception as exc:  # noqa: BLE001 - unreadable image
+                    errors[0] += 1
+                    print("skipping %s: %s" % (rel, exc), file=sys.stderr)
+                    return
+
+                def write_job():
+                    rec.write_idx(idx, packed)
+                    count[0] += 1
+
+                engine.push(write_job, mutable_vars=[writer_var])
+
+            return pack_job
+
+        for idx, labels, rel in read_list(lst_path):
+            engine.push(make_job(idx, labels, rel))
+        engine.wait_for_all()
+        engine.delete_var(writer_var)
+    else:
+        for idx, labels, rel in read_list(lst_path):
+            try:
+                rec.write_idx(idx, pack_one(args, idx, labels, rel))
+                count[0] += 1
+            except Exception as exc:  # noqa: BLE001 - skip unreadable images
+                errors[0] += 1
+                print("skipping %s: %s" % (rel, exc), file=sys.stderr)
+            if count[0] % 1000 == 0 and count[0]:
+                print("packed %d images (%.1f img/s)"
+                      % (count[0], count[0] / (time.time() - tic)))
     rec.close()
-    print("wrote %s.rec: %d records, %d errors" % (prefix, count, errors))
-    return count
+    print("wrote %s.rec: %d records, %d errors" % (prefix, count[0], errors[0]))
+    return count[0]
 
 
 def main(argv=None):
@@ -146,7 +178,13 @@ def main(argv=None):
     parser.add_argument("--quality", type=int, default=95)
     parser.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
     parser.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
+    parser.add_argument("--num-thread", type=int, default=1,
+                        help="pack with this many host-engine workers")
     args = parser.parse_args(argv)
+    if args.num_thread > 1:
+        # the native engine sizes its pool from this env at first use
+        os.environ.setdefault("MXNET_CPU_WORKER_NTHREADS",
+                              str(args.num_thread))
 
     if args.list:
         images = list(list_images(args.root, args.recursive, tuple(args.exts)))
