@@ -83,6 +83,11 @@ class Initializer(object):
             create(klass, **kwargs)._init_weight(desc, arr)
         elif desc.endswith("weight"):
             self._init_weight(desc, arr)
+        elif desc.endswith("parameters"):
+            # fused RNN packed parameter blob: 1-D, so shape-aware inits
+            # (Xavier/MSRA) cannot apply — small uniform, the reference's
+            # behavior without an explicit initializer.FusedRNN wrapper
+            Uniform(0.07)._init_weight(desc, arr)
         elif desc.endswith("bias"):
             self._init_bias(desc, arr)
         elif desc.endswith("gamma"):
@@ -96,6 +101,10 @@ class Initializer(object):
         elif desc.endswith("moving_inv_var") or desc.endswith("moving_avg"):
             self._init_zero(desc, arr)
         elif desc.endswith("min") or desc.endswith("max"):
+            self._init_zero(desc, arr)
+        elif desc.endswith("state") or desc.endswith("state_cell"):
+            # recurrent begin-states default to zeros (reference
+            # rnn ops' kNullOp-initialized states)
             self._init_zero(desc, arr)
         else:
             self._init_default(desc, arr)
