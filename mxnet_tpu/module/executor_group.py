@@ -84,9 +84,18 @@ class DataParallelExecutorGroup(object):
             dev_n = sl.stop - sl.start
             dev_shapes = {
                 n: (dev_n,) + tuple(s[1:]) for n, s in all_shapes.items()}
+            # memory sharing with a sibling group (bucketing: every bucket's
+            # executors alias the default bucket's parameter/grad arrays —
+            # reference graph_executor.cc:651 shared data pool)
+            shared_exec = None
+            if shared_group is not None and i < len(shared_group.execs):
+                shared_exec = shared_group.execs[i]
             exec_ = self.symbol.simple_bind(
                 ctx, grad_req=self._grad_req,
-                group2ctx=self.group2ctxs[i], **dev_shapes)
+                group2ctx=self.group2ctxs[i],
+                shared_exec=shared_exec,
+                shared_arg_names=self.param_names if shared_exec else None,
+                **dev_shapes)
             self.execs.append(exec_)
         self.data_arrays = [
             [(self.slices[i], e.arg_dict[name]) for i, e in enumerate(self.execs)]

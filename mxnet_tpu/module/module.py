@@ -205,11 +205,16 @@ class Module(BaseModule):
             n: s for n, s in zip(arg_names, arg_shapes) if n in self._param_names}
         self._aux_shapes = dict(zip(self._aux_names, aux_shapes))
 
+        shared_group = None
+        if shared_module is not None:
+            assert shared_module.binded, "shared_module must be binded first"
+            shared_group = shared_module._exec_group
         self._exec_group = DataParallelExecutorGroup(
             self._symbol, self._context, self._work_load_list, data_shapes,
             label_shapes, self._param_names, for_training, inputs_need_grad,
             fixed_param_names=self._fixed_param_names, grad_req=grad_req,
-            state_names=self._state_names, group2ctxs=self._group2ctxs)
+            state_names=self._state_names, group2ctxs=self._group2ctxs,
+            shared_group=shared_group)
         self.binded = True
 
         if self.params_initialized:

@@ -498,6 +498,13 @@ class Symbol(object):
         from .executor import Executor
         from .ndarray import ndarray as nd_mod
 
+        if stype_dict:
+            bad = {k: v for k, v in stype_dict.items() if v != "default"}
+            if bad:
+                raise MXNetError(
+                    "simple_bind: sparse argument storage (%r) is not "
+                    "supported — XLA arguments are dense; use the sparse "
+                    "NDArray classes eagerly instead" % (bad,))
         arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
         arg_names = self.list_arguments()
         aux_names = self.list_auxiliary_states()
@@ -505,16 +512,48 @@ class Symbol(object):
             missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
             raise MXNetError("simple_bind: cannot infer shapes for %s" % missing)
         type_dict = type_dict or {}
+        # memory sharing with an existing executor (the reference's shared
+        # data pool for bucketing executors, graph_executor.cc:651,926):
+        # arg/grad/aux arrays whose names land in shared_arg_names (default:
+        # every matching parameter) become the SAME NDArray objects, so an
+        # update through one executor is visible in all.
+        shared_args = {}
+        shared_grads = {}
+        shared_aux = {}
+        if shared_exec is not None:
+            share = set(shared_arg_names) if shared_arg_names is not None \
+                else set(shared_exec.arg_dict)
+            shared_args = {n: a for n, a in shared_exec.arg_dict.items()
+                           if n in share}
+            shared_grads = {n: g for n, g in shared_exec.grad_dict.items()
+                            if n in share and g is not None}
+            shared_aux = dict(shared_exec.aux_dict)
+        shared_buffer = shared_buffer if shared_buffer is not None else None
         args = {}
         args_grad = {}
         for name, shape in zip(arg_names, arg_shapes):
             dt = type_dict.get(name, np.float32)
-            args[name] = nd_mod.zeros(shape, ctx=ctx, dtype=dt)
+            if name in shared_args and tuple(shared_args[name].shape) == tuple(shape):
+                args[name] = shared_args[name]
+            elif shared_buffer is not None and name in shared_buffer and \
+                    tuple(shared_buffer[name].shape) == tuple(shape):
+                args[name] = shared_buffer[name]
+            else:
+                args[name] = nd_mod.zeros(shape, ctx=ctx, dtype=dt)
+                if shared_buffer is not None:
+                    shared_buffer[name] = args[name]
             if grad_req != "null":
-                args_grad[name] = nd_mod.zeros(shape, ctx=ctx, dtype=dt)
+                if name in shared_grads and \
+                        tuple(shared_grads[name].shape) == tuple(shape):
+                    args_grad[name] = shared_grads[name]
+                else:
+                    args_grad[name] = nd_mod.zeros(shape, ctx=ctx, dtype=dt)
         aux_states = {}
         for name, shape in zip(aux_names, aux_shapes):
-            aux_states[name] = nd_mod.zeros(shape, ctx=ctx)
+            if name in shared_aux and tuple(shared_aux[name].shape) == tuple(shape):
+                aux_states[name] = shared_aux[name]
+            else:
+                aux_states[name] = nd_mod.zeros(shape, ctx=ctx)
         return Executor(self, ctx, args, args_grad if grad_req != "null" else None,
                         grad_req, aux_states, group2ctx=group2ctx)
 

@@ -266,3 +266,38 @@ def test_sequential_module():
                           label=[mx.nd.zeros((8,))])
     mod.forward(batch, is_train=False)
     assert mod.get_outputs()[0].shape == (8, 4)
+
+
+def test_bucketing_executors_share_param_memory():
+    """Bucket executors alias the default bucket's parameter arrays
+    (reference shared data pool, graph_executor.cc:651): an update through
+    one bucket is visible in every other without a copy."""
+    def sym_gen(seq_len):
+        # params (embed table, fc) are bucket-independent, like real
+        # bucketing nets — only activation shapes vary with seq_len
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        emb = mx.sym.Embedding(data, input_dim=8, output_dim=6, name="shareemb")
+        pooled = mx.sym.mean(emb, axis=1)
+        fc = mx.sym.FullyConnected(pooled, num_hidden=4, name="sharefc")
+        return (mx.sym.SoftmaxOutput(fc, label, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    mod = BucketingModule(sym_gen, default_bucket_key=10, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 10))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.initializer.One())
+    from mxnet_tpu.io import DataBatch, DataDesc
+
+    batch5 = DataBatch(data=[mx.nd.ones((2, 5))], label=[mx.nd.zeros((2,))],
+                       provide_data=[DataDesc("data", (2, 5))],
+                       provide_label=[DataDesc("softmax_label", (2,))],
+                       bucket_key=5)
+    mod.forward(batch5)  # materializes the 5-bucket executor
+    exec10 = mod._buckets[10]._exec_group.execs[0]
+    exec5 = mod._buckets[5]._exec_group.execs[0]
+    assert exec5.arg_dict["sharefc_weight"] is not None
+    # weight arrays are THE SAME object across buckets
+    assert exec5.arg_dict["sharefc_weight"] is exec10.arg_dict["sharefc_weight"]
+    exec10.arg_dict["sharefc_weight"][:] = 3.5
+    np.testing.assert_allclose(exec5.arg_dict["sharefc_weight"].asnumpy(), 3.5)

@@ -123,3 +123,32 @@ def test_libinfo_and_util():
         assert os.path.isfile(p)
     assert mx.viz is mx.visualization
     assert util.get_gpu_count() >= 0
+
+
+def test_simple_bind_shared_exec_memory_sharing():
+    """shared_exec makes matching arg arrays the SAME NDArrays (the
+    reference's shared data pool across bucketing executors,
+    graph_executor.cc:651,926)."""
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=4, name="fcs")
+    ex1 = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4, name="fcs") \
+        .simple_bind(mx.cpu(), data=(2, 3))
+    ex2 = out.simple_bind(mx.cpu(), data=(5, 3), shared_exec=ex1)
+    # weight shares (same shape); data does not (different shape)
+    assert ex2.arg_dict["fcs_weight"] is ex1.arg_dict["fcs_weight"]
+    assert ex2.arg_dict["data"] is not ex1.arg_dict["data"]
+    ex1.arg_dict["fcs_weight"][:] = 7.0
+    np.testing.assert_allclose(ex2.arg_dict["fcs_weight"].asnumpy(), 7.0)
+
+
+def test_simple_bind_shared_buffer_and_stype_reject():
+    buf = {}
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=4, name="fcb")
+    ex1 = out.simple_bind(mx.cpu(), data=(2, 3), shared_buffer=buf)
+    assert "fcb_weight" in buf
+    ex2 = out.simple_bind(mx.cpu(), data=(2, 3), shared_buffer=buf)
+    assert ex2.arg_dict["fcb_weight"] is ex1.arg_dict["fcb_weight"]
+    with pytest.raises(mx.MXNetError, match="sparse argument storage"):
+        out.simple_bind(mx.cpu(), data=(2, 3),
+                        stype_dict={"fcb_weight": "row_sparse"})
