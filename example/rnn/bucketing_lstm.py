@@ -69,7 +69,7 @@ def make_batches(n, batch_size, rs):
 
 def main():
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--epochs", type=int, default=12)
+    parser.add_argument("--epochs", type=int, default=20)
     parser.add_argument("--batch-size", type=int, default=16)
     parser.add_argument("--num-hidden", type=int, default=32)
     parser.add_argument("--num-embed", type=int, default=16)
