@@ -51,6 +51,7 @@ for _m in (
     "monitor",
     "profiler",
     "rtc",
+    "runtime",
     "visualization",
     "image",
     "parallel",

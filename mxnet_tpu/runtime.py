@@ -1,0 +1,97 @@
+"""Runtime feature detection.
+
+Counterpart of the reference's build-feature surface (``MXGetVersion`` +
+feature macros in ``include/mxnet/base.h``, surfaced per SURVEY §5.6 tier
+3): instead of compile-time USE_CUDA/USE_MKLDNN flags, the TPU build's
+features are discovered at runtime — which backend is live, whether the
+native C++ runtime compiled, whether the distributed service is up.
+
+>>> import mxnet_tpu as mx
+>>> mx.runtime.Features()["TPU"].enabled
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature(object):
+    def __init__(self, name: str, enabled: bool, note: str = ""):
+        self.name = name
+        self.enabled = bool(enabled)
+        self.note = note
+
+    def __repr__(self):
+        return "%s %s" % ("✔" if self.enabled else "✖", self.name)
+
+
+def _detect() -> Dict[str, Feature]:
+    import jax
+
+    feats = OrderedDict()
+
+    def add(name, enabled, note=""):
+        feats[name] = Feature(name, enabled, note)
+
+    try:
+        backend = jax.default_backend()
+    except Exception:  # pragma: no cover - backend init failure
+        backend = "none"
+    add("TPU", backend == "tpu", "XLA TPU backend live")
+    add("CPU", True, "XLA CPU backend")
+    add("BF16", True, "bfloat16 compute (net.cast('bfloat16'))")
+    add("INT8", True, "contrib.quantization symmetric int8")
+
+    from . import _native
+
+    add("NATIVE_RUNTIME", _native.native_available(),
+        "C++ host engine/storage/recordio (src/)")
+    from .libinfo import find_lib_path
+
+    add("PREDICT_API", any("predict" in p for p in find_lib_path()),
+        "C predict ABI (src/predict/)")
+
+    try:
+        from jax.experimental import pallas  # noqa: F401
+
+        add("PALLAS", True, "custom kernels (interpret mode off-TPU)")
+    except ImportError:  # pragma: no cover
+        add("PALLAS", False)
+
+    add("DISTRIBUTED", jax.process_count() > 1,
+        "multi-process jax.distributed runtime active")
+    add("SIGNAL_HANDLER", _native.native_available(),
+        "segfault backtrace via MXNET_USE_SIGNAL_HANDLER=1")
+    try:
+        import torch  # noqa: F401
+
+        add("TORCH_BRIDGE", True, "contrib.torch_bridge interop")
+    except ImportError:
+        add("TORCH_BRIDGE", False)
+    try:
+        from torch.utils import tensorboard  # noqa: F401
+
+        add("TENSORBOARD", True)
+    except ImportError:
+        add("TENSORBOARD", False)
+    return feats
+
+
+class Features(dict):
+    """Mapping name → Feature (reference ``mx.runtime.Features``)."""
+
+    def __init__(self):
+        super().__init__(_detect())
+
+    def is_enabled(self, name: str) -> bool:
+        return name in self and self[name].enabled
+
+    def __repr__(self):
+        return "[%s]" % ", ".join(repr(f) for f in self.values())
+
+
+def feature_list():
+    """List of Feature objects (reference ``mx.runtime.feature_list``)."""
+    return list(Features().values())

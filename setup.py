@@ -1,0 +1,23 @@
+"""Install mxnet_tpu (counterpart of the reference's python/setup.py).
+
+The native C++ runtime (src/) compiles lazily on first import via
+mxnet_tpu._native (g++ required); no build step is needed here. Compute
+dependencies (jax/jaxlib) are intentionally unpinned — match them to your
+TPU runtime release.
+"""
+from setuptools import find_packages, setup
+
+setup(
+    name="mxnet_tpu",
+    version="1.3.0",
+    description="TPU-native deep learning framework with the capabilities "
+                "of Apache MXNet 1.3 on JAX/XLA/Pallas",
+    packages=find_packages(include=["mxnet_tpu", "mxnet_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=["numpy", "jax"],
+    extras_require={
+        "dev": ["pytest"],
+        "interop": ["torch"],
+    },
+    include_package_data=True,
+)

@@ -152,3 +152,14 @@ def test_simple_bind_shared_buffer_and_stype_reject():
     with pytest.raises(mx.MXNetError, match="sparse argument storage"):
         out.simple_bind(mx.cpu(), data=(2, 3),
                         stype_dict={"fcb_weight": "row_sparse"})
+
+
+def test_runtime_features():
+    from mxnet_tpu import runtime
+
+    feats = runtime.Features()
+    assert feats.is_enabled("CPU")
+    assert feats.is_enabled("PALLAS")
+    assert "NATIVE_RUNTIME" in feats
+    assert isinstance(runtime.feature_list(), list)
+    assert not feats.is_enabled("NOPE")
