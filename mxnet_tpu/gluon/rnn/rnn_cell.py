@@ -15,8 +15,8 @@ from ..nn import LeakyReLU  # noqa: F401  (API surface re-export convenience)
 
 __all__ = [
     "RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
-    "SequentialRNNCell", "DropoutCell", "ModifierCell", "ZoneoutCell",
-    "ResidualCell", "BidirectionalCell",
+    "SequentialRNNCell", "HybridSequentialRNNCell", "DropoutCell",
+    "ModifierCell", "ZoneoutCell", "ResidualCell", "BidirectionalCell",
 ]
 
 
@@ -605,3 +605,16 @@ class BidirectionalCell(HybridRecurrentCell):
 
     def hybrid_forward(self, F, inputs, states):
         raise NotImplementedError
+
+
+class HybridSequentialRNNCell(SequentialRNNCell):
+    """Hybridizable stack of cells (reference
+    rnn_cell.py:HybridSequentialRNNCell). On this stack every cell's
+    compute already traces into XLA, so the hybrid variant shares the
+    sequential implementation; the class exists so reference model code
+    constructing it (and ``hybridize()`` call sites) runs unchanged."""
+
+    def hybridize(self, active=True, **kwargs):
+        for cell in self._children.values():
+            if hasattr(cell, "hybridize"):
+                cell.hybridize(active, **kwargs)

@@ -705,3 +705,25 @@ class DevicePrefetchIter(DataIter):
             return True
         except StopIteration:
             return False
+
+
+def MXDataIter(handle=None, **kwargs):  # noqa: N802 - reference name
+    """Factory shim for the reference's C++-registered iterator wrapper
+    (``python/mxnet/io.py:MXDataIter`` wrapping MXDataIterCreateIter
+    handles). This build's iterators are Python classes over the native
+    RecordIO layer, so the factory resolves by iterator name instead of a C
+    handle: ``MXDataIter(name="ImageRecordIter", **params)``.
+    """
+    name = kwargs.pop("name", handle)
+    factories = {
+        "ImageRecordIter": ImageRecordIter,
+        "MNISTIter": MNISTIter,
+        "CSVIter": CSVIter,
+        "LibSVMIter": LibSVMIter,
+        "NDArrayIter": NDArrayIter,
+    }
+    if name not in factories:
+        raise MXNetError(
+            "MXDataIter: unknown iterator %r (available: %s)"
+            % (name, sorted(factories)))
+    return factories[name](**kwargs)

@@ -294,6 +294,11 @@ class SGD(Optimizer):
 
 
 @register
+class ccSGD(SGD):  # noqa: N801 - reference name (optimizer.py:ccSGD)
+    """Deprecated alias of SGD kept for reference-code compatibility."""
+
+
+@register
 class NAG(Optimizer):
     """Nesterov accelerated SGD (reference optimizer.py:NAG)."""
 
