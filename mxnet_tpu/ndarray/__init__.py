@@ -18,6 +18,15 @@ from .ndarray import (
     stack,
     waitall,
     onehot_encode,
+    concatenate,
+    moveaxis,
+    histogram,
+    logical_and,
+    logical_or,
+    logical_xor,
+    modulo,
+    true_divide,
+    imdecode,
 )
 from . import random  # noqa: F401
 from . import sparse  # noqa: F401

@@ -659,3 +659,68 @@ def onehot_encode(indices, out):
     res = invoke("one_hot", indices, depth=out.shape[1])
     out._data = res._data
     return out
+
+
+# -- module-level convenience functions closing the reference nd surface ----
+# (reference python/mxnet/ndarray/ndarray.py:2439,3436,3617,3824)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    """Concatenate along an axis (reference nd.concatenate)."""
+    del always_copy  # functional arrays: result is always a fresh buffer
+    return invoke("Concat", *arrays, dim=axis, num_args=len(arrays))
+
+
+def moveaxis(tensor, source, destination):
+    """Move axes like np.moveaxis (reference nd.moveaxis)."""
+    ndim = len(tensor.shape)
+    src = [source] if isinstance(source, int) else list(source)
+    dst = [destination] if isinstance(destination, int) else list(destination)
+    src = [s % ndim for s in src]
+    dst = [d % ndim for d in dst]
+    order = [a for a in range(ndim) if a not in src]
+    for d, s in sorted(zip(dst, src)):
+        order.insert(d, s)
+    return invoke("transpose", tensor, axes=tuple(order))
+
+
+def histogram(a, bins=10, range=None):
+    """Histogram (reference nd.histogram): returns (counts, bin_edges)."""
+    if isinstance(bins, NDArray):
+        counts, edges = invoke("_histogram", a, bins,
+                               bin_cnt=len(bins.asnumpy()) - 1)
+        return counts, edges
+    if range is None:
+        amin = float(a.min().asnumpy())
+        amax = float(a.max().asnumpy())
+        range = (amin, amax if amax > amin else amin + 1.0)
+    edges = np.linspace(range[0], range[1], bins + 1).astype(np.float32)
+    counts, edges_out = invoke("_histogram", a, array(edges), bin_cnt=bins)
+    return counts, edges_out
+
+
+def logical_and(lhs, rhs):
+    return invoke("broadcast_logical_and", lhs, rhs)
+
+
+def logical_or(lhs, rhs):
+    return invoke("broadcast_logical_or", lhs, rhs)
+
+
+def logical_xor(lhs, rhs):
+    return invoke("broadcast_logical_xor", lhs, rhs)
+
+
+def modulo(lhs, rhs):
+    return lhs % rhs
+
+
+def true_divide(lhs, rhs):
+    return lhs / rhs
+
+
+def imdecode(buf, **kwargs):
+    """Decode an image byte buffer (reference nd.imdecode → image pipeline)."""
+    from .. import image as _image
+
+    return _image.imdecode(buf, **kwargs)

@@ -922,3 +922,43 @@ for _opname in list(OP_REGISTRY):
 
 # symbolic control flow namespace (reference mx.sym.contrib)
 from . import sym_contrib as contrib  # noqa: E402,F401
+
+
+# -- module-level convenience functions (reference symbol.py:eye/full/...) --
+
+
+def eye(N, M=0, k=0, dtype=None, **kwargs):
+    return _invoke("_eye", [], dict(N=N, M=M or N, k=k, **kwargs))
+
+
+def full(shape, val, dtype=None, **kwargs):
+    return _invoke("_full", [], dict(shape=shape, value=float(val), **kwargs))
+
+
+def _sym_binop(broadcast_op, scalar_op, rscalar_op=None):
+    def fn(left, right, **kwargs):
+        if isinstance(left, Symbol) and isinstance(right, Symbol):
+            return _invoke(broadcast_op, [left, right], kwargs)
+        if isinstance(left, Symbol):
+            return _invoke(scalar_op, [left], dict(scalar=float(right), **kwargs))
+        if isinstance(right, Symbol):
+            op = rscalar_op or scalar_op
+            return _invoke(op, [right], dict(scalar=float(left), **kwargs))
+        raise TypeError("at least one argument must be a Symbol")
+    return fn
+
+
+maximum = _sym_binop("broadcast_maximum", "_maximum_scalar")
+minimum = _sym_binop("broadcast_minimum", "_minimum_scalar")
+hypot = _sym_binop("broadcast_hypot", "_hypot_scalar")
+
+
+def histogram(a, bins=10, range=None, **kwargs):
+    if range is None:
+        raise MXNetError("symbol histogram requires an explicit range "
+                         "(shapes must be static under tracing)")
+    # static bin edges as an arange-built constant subgraph
+    lo, hi = float(range[0]), float(range[1])
+    edge_sym = _invoke("_arange", [], dict(start=0.0, stop=float(bins + 1),
+                                           step=1.0)) * ((hi - lo) / bins) + lo
+    return _invoke("_histogram", [a, edge_sym], dict(bin_cnt=bins, **kwargs))
