@@ -1,0 +1,130 @@
+"""Fused optimizer-update ops vs the Optimizer classes.
+
+The reference's Python optimizers call these fused ops as their fast path
+(optimizer_op.cc); here both exist independently, so parity between
+mx.nd.sgd_update-family ops and mxnet_tpu.optimizer steps is the
+correctness check.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray.ndarray import invoke
+
+RS = np.random.RandomState(3)
+
+
+def _wg(shape=(5, 4)):
+    return (RS.randn(*shape).astype(np.float32),
+            RS.randn(*shape).astype(np.float32))
+
+
+def test_sgd_update_matches_optimizer():
+    w_np, g_np = _wg()
+    out = invoke("sgd_update", mx.nd.array(w_np), mx.nd.array(g_np),
+                 lr=0.1, wd=0.01)
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.01, rescale_grad=1.0)
+    w2 = mx.nd.array(w_np)
+    opt.update(0, w2, mx.nd.array(g_np), opt.create_state(0, w2))
+    np.testing.assert_allclose(out.asnumpy(), w2.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_sgd_mom_update_matches_optimizer():
+    w_np, g_np = _wg()
+    mom_np = np.zeros_like(w_np)
+    w, mom = w_np.copy(), mom_np.copy()
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.0,
+                           rescale_grad=1.0)
+    w_nd = mx.nd.array(w_np)
+    state = opt.create_state(0, w_nd)
+    for _ in range(3):
+        w_out, mom_out = invoke("sgd_mom_update", mx.nd.array(w),
+                                mx.nd.array(g_np), mx.nd.array(mom),
+                                lr=0.1, momentum=0.9)
+        w, mom = w_out.asnumpy(), mom_out.asnumpy()
+        new_state = opt.update(0, w_nd, mx.nd.array(g_np), state)
+        state = new_state if new_state is not None else state
+    np.testing.assert_allclose(w, w_nd.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_adam_update_matches_optimizer():
+    w_np, g_np = _wg()
+    opt = mx.optimizer.Adam(learning_rate=0.01, rescale_grad=1.0)
+    w_nd = mx.nd.array(w_np)
+    state = opt.create_state(0, w_nd)
+    w, m, v = w_np.copy(), np.zeros_like(w_np), np.zeros_like(w_np)
+    opt.update(0, w_nd, mx.nd.array(g_np), state)
+    # reference adam_update op applies no bias correction (the Python
+    # optimizer folds it into lr); compare against the op's own contract
+    w_out, m_out, v_out = invoke("adam_update", mx.nd.array(w_np),
+                                 mx.nd.array(g_np), mx.nd.array(m),
+                                 mx.nd.array(v), lr=0.01)
+    expected_m = 0.1 * g_np
+    expected_v = 0.001 * g_np * g_np
+    np.testing.assert_allclose(m_out.asnumpy(), expected_m, rtol=1e-5)
+    np.testing.assert_allclose(v_out.asnumpy(), expected_v, rtol=1e-5)
+    np.testing.assert_allclose(
+        w_out.asnumpy(),
+        w_np - 0.01 * expected_m / (np.sqrt(expected_v) + 1e-8), rtol=1e-5)
+
+
+def test_mp_sgd_update_precision():
+    """Multi-precision: bf16 weights, fp32 master copy drives the math."""
+    import jax.numpy as jnp
+
+    w32_np, g_np = _wg()
+    w16 = mx.nd.NDArray(jnp.asarray(w32_np, jnp.bfloat16), mx.cpu())
+    g16 = mx.nd.NDArray(jnp.asarray(g_np, jnp.bfloat16), mx.cpu())
+    w_out, w32_out = invoke("mp_sgd_update", w16, g16,
+                            mx.nd.array(w32_np), lr=0.1)
+    assert w_out._data.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        w32_out.asnumpy(),
+        w32_np - 0.1 * np.asarray(jnp.asarray(g_np, jnp.bfloat16),
+                                  np.float32),
+        rtol=1e-3, atol=1e-3)
+
+
+def test_signsgd_and_signum():
+    w_np, g_np = _wg()
+    out = invoke("signsgd_update", mx.nd.array(w_np), mx.nd.array(g_np),
+                 lr=0.1)
+    np.testing.assert_allclose(out.asnumpy(), w_np - 0.1 * np.sign(g_np),
+                               rtol=1e-6)
+    w_out, mom_out = invoke("signum_update", mx.nd.array(w_np),
+                            mx.nd.array(g_np), mx.nd.zeros(w_np.shape),
+                            lr=0.1, momentum=0.9)
+    expected_mom = -(1 - 0.9) * g_np
+    np.testing.assert_allclose(mom_out.asnumpy(), expected_mom, rtol=1e-5)
+    np.testing.assert_allclose(w_out.asnumpy(),
+                               w_np + 0.1 * np.sign(expected_mom), rtol=1e-5)
+
+
+def test_sparse_adagrad_lazy_rows():
+    """Rows with zero gradient must stay untouched (lazy sparse update)."""
+    w_np = RS.randn(6, 3).astype(np.float32)
+    g_np = np.zeros_like(w_np)
+    g_np[[1, 4]] = RS.randn(2, 3).astype(np.float32)
+    hist = np.ones_like(w_np)
+    w_out, h_out = invoke("_sparse_adagrad_update", mx.nd.array(w_np),
+                          mx.nd.array(g_np), mx.nd.array(hist), lr=0.1)
+    w2, h2 = w_out.asnumpy(), h_out.asnumpy()
+    for r in (0, 2, 3, 5):
+        np.testing.assert_array_equal(w2[r], w_np[r])
+        np.testing.assert_array_equal(h2[r], hist[r])
+    assert not np.allclose(w2[1], w_np[1])
+    np.testing.assert_allclose(h2[1], 1.0 + g_np[1] ** 2, rtol=1e-6)
+
+
+def test_rmsprop_and_ftrl_finite():
+    w_np, g_np = _wg()
+    w_out, n_out = invoke("rmsprop_update", mx.nd.array(w_np),
+                          mx.nd.array(g_np), mx.nd.zeros(w_np.shape), lr=0.01)
+    assert np.isfinite(w_out.asnumpy()).all()
+    w_out, z_out, n_out = invoke("ftrl_update", mx.nd.array(w_np),
+                                 mx.nd.array(g_np), mx.nd.zeros(w_np.shape),
+                                 mx.nd.zeros(w_np.shape), lr=0.1)
+    assert np.isfinite(w_out.asnumpy()).all()
+    # lamda1 regularization produces exact zeros for small z
+    assert (np.abs(w_out.asnumpy()) < 1e3).all()
