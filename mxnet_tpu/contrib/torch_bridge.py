@@ -45,8 +45,12 @@ class TorchFunction(autograd.Function):
 
     def forward(self, *inputs):
         torch = _torch()
-        tins = [torch.from_numpy(np.array(i.asnumpy())).requires_grad_(True)
-                for i in inputs]
+        tins = []
+        for i in inputs:
+            t = torch.from_numpy(np.array(i.asnumpy()))
+            if t.is_floating_point():  # int inputs (ids) can't require grad
+                t.requires_grad_(True)
+            tins.append(t)
         with torch.enable_grad():
             touts = self._fn(*tins)
         single = torch.is_tensor(touts)
@@ -60,15 +64,22 @@ class TorchFunction(autograd.Function):
         torch = _torch()
         tins, touts = self.saved_tensors
         gouts = [torch.from_numpy(np.array(g.asnumpy())) for g in output_grads]
+        diff_ins = [t for t in tins if t.requires_grad]
         grads = torch.autograd.grad(
-            touts, tuple(tins) + tuple(self._params), gouts, allow_unused=True)
-        in_grads = grads[: len(tins)]
-        for p, g in zip(self._params, grads[len(tins):]):
+            touts, tuple(diff_ins) + tuple(self._params), gouts,
+            allow_unused=True)
+        by_input = dict(zip(map(id, diff_ins), grads[: len(diff_ins)]))
+        for p, g in zip(self._params, grads[len(diff_ins):]):
             if g is not None:
                 p.grad = g if p.grad is None else p.grad + g
-        return [NDArray(np.zeros(t.shape, np.float32)) if g is None
-                else NDArray(g.numpy().astype(np.float32))
-                for t, g in zip(tins, in_grads)]
+        out = []
+        for t in tins:
+            g = by_input.get(id(t))
+            if g is None:  # non-differentiable (int ids) or unused input
+                out.append(NDArray(np.zeros(t.shape, np.float32)))
+            else:
+                out.append(NDArray(g.numpy().astype(np.float32)))
+        return out
 
 
 class TorchBlock(object):

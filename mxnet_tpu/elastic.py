@@ -175,18 +175,56 @@ class CheckpointManager(object):
         :meth:`wait` / the next synchronous call joins them.
         """
         if async_save:
+            # EVERYTHING is serialized to bytes NOW — params through the same
+            # save_parameters/io_utils code path the sync branch uses (so
+            # restore naming matches) and optimizer state through
+            # trainer.save_states — because serializing later on the engine
+            # thread would snapshot a LATER training step than the caller saw.
+            import tempfile
+
+            def _to_bytes(writer):
+                fd, tmp = tempfile.mkstemp(suffix=".snap")
+                os.close(fd)
+                try:
+                    writer(tmp)
+                    with open(tmp, "rb") as f:
+                        return f.read()
+                finally:
+                    os.remove(tmp)
+
+            params_bytes = None
             if net is not None:
-                # snapshot on the host so later updates don't race the write
-                params = {k: p.data().asnumpy()
-                          for k, p in net.collect_params().items()}
-                net = None
+                params_bytes = _to_bytes(lambda p: net.save_parameters(p))
             elif params is not None:
-                params = {k: (v.asnumpy() if hasattr(v, "asnumpy") else
-                              np.asarray(v)) for k, v in params.items()}
-            self._engine.push(
-                lambda: self.save(epoch, net=None, trainer=trainer,
-                                  params=params, metadata=metadata),
-                mutable_vars=[self._io_var])
+                from .ndarray import io_utils
+
+                snap = {k: (v.asnumpy() if hasattr(v, "asnumpy") else
+                            np.asarray(v)) for k, v in params.items()}
+                params_bytes = _to_bytes(lambda p: io_utils.save(p, snap))
+            states_bytes = None
+            if trainer is not None:
+                states_bytes = _to_bytes(lambda p: trainer.save_states(p))
+
+            def commit():
+                files = {}
+                if params_bytes is not None:
+                    self._atomic_write(
+                        self._params_path(epoch),
+                        lambda p: open(p, "wb").write(params_bytes))
+                    files["params"] = os.path.basename(self._params_path(epoch))
+                if states_bytes is not None:
+                    self._atomic_write(
+                        self._states_path(epoch),
+                        lambda p: open(p, "wb").write(states_bytes))
+                    files["states"] = os.path.basename(self._states_path(epoch))
+                manifest = {"epoch": epoch, "time": time.time(),
+                            "files": files, "metadata": metadata or {}}
+                self._atomic_write(
+                    self._manifest_path(epoch),
+                    lambda p: open(p, "w").write(json.dumps(manifest)))
+                self._retire_old()
+
+            self._engine.push(commit, mutable_vars=[self._io_var])
             return self._manifest_path(epoch)
         files = {}
         if net is not None:

@@ -70,12 +70,15 @@ def _detect() -> Dict[str, Feature]:
         add("TORCH_BRIDGE", True, "contrib.torch_bridge interop")
     except ImportError:
         add("TORCH_BRIDGE", False)
-    try:
-        from torch.utils import tensorboard  # noqa: F401
-
-        add("TENSORBOARD", True)
-    except ImportError:
-        add("TENSORBOARD", False)
+    tb = False
+    for mod in ("torch.utils.tensorboard", "tensorboardX"):
+        try:
+            __import__(mod)
+            tb = True
+            break
+        except ImportError:
+            continue
+    add("TENSORBOARD", tb, "contrib.tensorboard writer backend present")
     return feats
 
 

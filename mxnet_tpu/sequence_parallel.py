@@ -147,7 +147,7 @@ def ring_attention(q, k, v, mesh: Optional[Mesh] = None, causal: bool = False,
 def _ulysses_local(q, k, v, *, axis_name, causal, scale):
     """Per-device body: all-to-all seq->heads, full local attention over
     the complete sequence for this device's head subset, all-to-all back.
-    q/k/v: (B, Hl... wait — enter with (B, H, Sl, D); H must divide n."""
+    Enters with local blocks (B, H, S/n, D); H must divide n devices."""
     n = lax.psum(1, axis_name)
 
     def seq_to_heads(x):

@@ -528,23 +528,26 @@ class Symbol(object):
             shared_grads = {n: g for n, g in shared_exec.grad_dict.items()
                             if n in share and g is not None}
             shared_aux = dict(shared_exec.aux_dict)
-        shared_buffer = shared_buffer if shared_buffer is not None else None
         args = {}
         args_grad = {}
         for name, shape in zip(arg_names, arg_shapes):
             dt = type_dict.get(name, np.float32)
-            if name in shared_args and tuple(shared_args[name].shape) == tuple(shape):
+
+            def _compatible(arr):
+                return (tuple(arr.shape) == tuple(shape)
+                        and arr._data.dtype == np.dtype(dt))
+
+            if name in shared_args and _compatible(shared_args[name]):
                 args[name] = shared_args[name]
             elif shared_buffer is not None and name in shared_buffer and \
-                    tuple(shared_buffer[name].shape) == tuple(shape):
+                    _compatible(shared_buffer[name]):
                 args[name] = shared_buffer[name]
             else:
                 args[name] = nd_mod.zeros(shape, ctx=ctx, dtype=dt)
                 if shared_buffer is not None:
                     shared_buffer[name] = args[name]
             if grad_req != "null":
-                if name in shared_grads and \
-                        tuple(shared_grads[name].shape) == tuple(shape):
+                if name in shared_grads and _compatible(shared_grads[name]):
                     args_grad[name] = shared_grads[name]
                 else:
                     args_grad[name] = nd_mod.zeros(shape, ctx=ctx, dtype=dt)
@@ -928,11 +931,17 @@ from . import sym_contrib as contrib  # noqa: E402,F401
 
 
 def eye(N, M=0, k=0, dtype=None, **kwargs):
-    return _invoke("_eye", [], dict(N=N, M=M or N, k=k, **kwargs))
+    attrs = dict(N=N, M=M or N, k=k, **kwargs)
+    if dtype is not None:
+        attrs["dtype"] = dtype
+    return _invoke("_eye", [], attrs)
 
 
 def full(shape, val, dtype=None, **kwargs):
-    return _invoke("_full", [], dict(shape=shape, value=float(val), **kwargs))
+    attrs = dict(shape=shape, value=float(val), **kwargs)
+    if dtype is not None:
+        attrs["dtype"] = dtype
+    return _invoke("_full", [], attrs)
 
 
 def _sym_binop(broadcast_op, scalar_op, rscalar_op=None):

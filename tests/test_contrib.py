@@ -296,3 +296,20 @@ def test_torch_block_trains():
         opt.step()
         losses.append(float(loss.asnumpy()))
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_torch_function_integer_inputs():
+    torch = pytest.importorskip("torch")
+    from mxnet_tpu.contrib.torch_bridge import TorchBlock
+
+    torch.manual_seed(1)
+    emb = TorchBlock(torch.nn.Embedding(10, 4))
+    ids = mx.nd.array(np.array([1, 3, 5], np.float32)).astype("int64")
+    ids.attach_grad()  # in-graph trigger; int ids get zero grads
+    with mx.autograd.record():
+        out = emb(ids)
+        loss = mx.nd.sum(out)
+    loss.backward()
+    assert out.shape == (3, 4)
+    g = emb.torch_parameters()[0].grad
+    assert g is not None and float(g.abs().sum()) > 0

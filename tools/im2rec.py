@@ -116,14 +116,18 @@ def make_rec(args, lst_path):
         # im2rec.py --num-thread): decode/resize/encode jobs run on worker
         # threads; each finished job pushes its write as an op mutating the
         # writer var, so file writes stay serialized while packing overlaps.
+        import threading
+
         writer_var = engine.new_var()
+        err_lock = threading.Lock()  # pack jobs run concurrently
 
         def make_job(idx, labels, rel):
             def pack_job():
                 try:
                     packed = pack_one(args, idx, labels, rel)
                 except Exception as exc:  # noqa: BLE001 - unreadable image
-                    errors[0] += 1
+                    with err_lock:
+                        errors[0] += 1
                     print("skipping %s: %s" % (rel, exc), file=sys.stderr)
                     return
 
