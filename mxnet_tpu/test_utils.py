@@ -119,3 +119,66 @@ def check_consistency(fn: Callable, inputs: Sequence[np.ndarray], ctx_list: Sequ
                 np.testing.assert_allclose(r, v, rtol=rtol, atol=atol)
         else:
             np.testing.assert_allclose(ref, o, rtol=rtol, atol=atol)
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=1e-8,
+                           aux_states=None, ctx=None):
+    """Bind a symbol, run forward, compare outputs to expectations
+    (reference test_utils.check_symbolic_forward). ``location`` /
+    ``expected`` are lists (positional by arg/output order) or name dicts."""
+    from .ndarray import ndarray as nd_mod
+
+    ctx = ctx or default_context()
+    arg_names = sym.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    shapes = {k: np.asarray(v).shape for k, v in location.items()}
+    ex = sym.simple_bind(ctx, grad_req="null", **shapes)
+    ex.copy_params_from({k: nd_mod.array(np.asarray(v))
+                         for k, v in location.items()},
+                        {k: nd_mod.array(np.asarray(v))
+                         for k, v in (aux_states or {}).items()} or None,
+                        allow_extra_params=True)
+    outputs = ex.forward(is_train=False)
+    if isinstance(expected, dict):
+        expected = [expected[n] for n in sym.list_outputs()]
+    for out, exp in zip(outputs, expected):
+        assert_almost_equal(out.asnumpy(), np.asarray(exp), rtol, atol)
+    return outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=1e-8, aux_states=None, grad_req="write",
+                            ctx=None):
+    """Bind, forward+backward, compare input gradients
+    (reference test_utils.check_symbolic_backward)."""
+    from .ndarray import ndarray as nd_mod
+
+    ctx = ctx or default_context()
+    arg_names = sym.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(arg_names, expected))
+    shapes = {k: np.asarray(v).shape for k, v in location.items()}
+    ex = sym.simple_bind(ctx, grad_req=grad_req, **shapes)
+    ex.copy_params_from({k: nd_mod.array(np.asarray(v))
+                         for k, v in location.items()},
+                        {k: nd_mod.array(np.asarray(v))
+                         for k, v in (aux_states or {}).items()} or None,
+                        allow_extra_params=True)
+    ex.forward(is_train=True)
+    ex.backward(out_grads=[nd_mod.array(np.asarray(g)) for g in out_grads]
+                if isinstance(out_grads, (list, tuple)) else
+                nd_mod.array(np.asarray(out_grads)))
+    for name, exp in expected.items():
+        assert_almost_equal(ex.grad_dict[name].asnumpy(), np.asarray(exp),
+                            rtol, atol, names=("grad(%s)" % name, "expected"))
+    return ex.grad_dict
+
+
+def same_array(a, b) -> bool:
+    """True when two NDArrays share the same underlying buffer (reference
+    test_utils.same_array — there it mutates and checks; jax buffers are
+    immutable so identity of the backing array is the sharing criterion)."""
+    return a._data is b._data

@@ -230,3 +230,26 @@ def test_ordering_ops_sweep():
         np.argmin(x, axis=0).astype(np.float32))
     topv = invoke("topk", mx.nd.array(x), axis=1, k=3, ret_typ="value")
     np.testing.assert_allclose(topv.asnumpy(), -np.sort(-x, axis=1)[:, :3])
+
+
+def test_check_symbolic_forward_backward_harness():
+    """The reference's symbolic check harness itself (test_utils)."""
+    from mxnet_tpu import test_utils
+
+    x_np = RS.randn(3, 4).astype(np.float32)
+    s = mx.sym.exp(mx.sym.var("x"))
+    test_utils.check_symbolic_forward(s, [x_np], [np.exp(x_np)], rtol=1e-5,
+                                      atol=1e-6)
+    og = RS.randn(3, 4).astype(np.float32)
+    test_utils.check_symbolic_backward(s, [x_np], [og],
+                                       [og * np.exp(x_np)], rtol=1e-4,
+                                       atol=1e-5)
+
+
+def test_same_array_helper():
+    from mxnet_tpu import test_utils
+
+    a = mx.nd.ones((2, 2))
+    b = mx.nd.NDArray(a._data, a.context)
+    assert test_utils.same_array(a, b)
+    assert not test_utils.same_array(a, mx.nd.ones((2, 2)))
