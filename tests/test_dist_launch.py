@@ -38,6 +38,8 @@ def test_dist_sync_kvstore_two_workers():
         assert ("rank %d: DIST_KVSTORE_OK" % rank) in out.stdout, out.stdout[-4000:]
         assert ("rank %d: DIST_TRAINER_OK" % rank) in out.stdout, out.stdout[-4000:]
         assert ("rank %d: DIST_HEARTBEAT_OK" % rank) in out.stdout, out.stdout[-4000:]
+        assert ("rank %d: DIST_RING_ATTENTION_OK" % rank) in out.stdout, \
+            out.stdout[-4000:]
 
 
 def test_launch_cli_rejects_empty_command():
