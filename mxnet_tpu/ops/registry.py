@@ -71,6 +71,7 @@ class OpDef:
         self._num_outputs = num_outputs
         self.aliases = tuple(aliases)
         self.doc = doc
+        self._attr_cache: Dict[Any, "AttrDict"] = {}
 
     # ------------------------------------------------------------------
     def input_names(self, attrs: Optional[AttrDict] = None) -> List[str]:
@@ -85,7 +86,35 @@ class OpDef:
 
     def parse_attrs(self, raw: Dict[str, Any]) -> AttrDict:
         """Parse raw (possibly string-valued) attrs into typed values,
-        applying defaults and validating required fields."""
+        applying defaults and validating required fields.
+
+        Results are memoized per attr signature (eager dispatch calls this
+        on every op invocation with a handful of distinct signatures); a
+        shallow copy is returned so callers may mutate their view.
+        """
+        # only primitive-valued signatures are cacheable: object-valued attrs
+        # (e.g. control-flow subgraph Symbols) have identity hashes but
+        # overloaded __eq__, which a dict collision would misinterpret
+        key = None
+        if all(isinstance(v, (str, int, float, bool, tuple, type(None)))
+               for v in raw.values()):
+            try:
+                key = tuple(sorted(raw.items()))
+                hash(key)
+            except TypeError:
+                key = None
+        if key is not None:
+            cached = self._attr_cache.get(key)
+            if cached is not None:
+                return AttrDict(cached)
+        out = self._parse_attrs_uncached(raw)
+        if key is not None:
+            if len(self._attr_cache) > 256:  # bound per-op memory
+                self._attr_cache.clear()
+            self._attr_cache[key] = AttrDict(out)
+        return out
+
+    def _parse_attrs_uncached(self, raw: Dict[str, Any]) -> AttrDict:
         out = AttrDict()
         for pname, (ptype, pdefault) in self.params.items():
             if pname in raw:
