@@ -188,12 +188,30 @@ def _logical_xor(a, b):
     return ((a != 0) ^ (b != 0)).astype(a.dtype)
 
 
+# plain-operator forms: the jnp.<ufunc> wrappers add ~25us of eager
+# dispatch per call that the __add__-style operator path skips entirely
+def _op_add(a, b):
+    return a + b
+
+
+def _op_sub(a, b):
+    return a - b
+
+
+def _op_mul(a, b):
+    return a * b
+
+
+def _op_div(a, b):
+    return a / b
+
+
 _BINARY = {
-    "elemwise_add": (jnp.add, ("_add", "_plus", "_Plus")),
-    "elemwise_sub": (jnp.subtract, ("_sub", "_minus", "_Minus")),
-    "elemwise_mul": (jnp.multiply, ("_mul", "_Mul")),
-    "elemwise_div": (jnp.divide, ("_div", "_Div")),
-    "_grad_add": (jnp.add, ()),
+    "elemwise_add": (_op_add, ("_add", "_plus", "_Plus")),
+    "elemwise_sub": (_op_sub, ("_sub", "_minus", "_Minus")),
+    "elemwise_mul": (_op_mul, ("_mul", "_Mul")),
+    "elemwise_div": (_op_div, ("_div", "_Div")),
+    "_grad_add": (_op_add, ()),
     "_mod": (jnp.mod, ("_Mod",)),
     "_power": (jnp.power, ("_Power", "pow")),
     "_hypot": (jnp.hypot, ()),
@@ -214,10 +232,10 @@ for _n, (_f, _al) in _BINARY.items():
 
 # broadcast_* family shares implementations (jnp broadcasts natively)
 _BCAST = {
-    "broadcast_add": jnp.add,
-    "broadcast_sub": jnp.subtract,
-    "broadcast_mul": jnp.multiply,
-    "broadcast_div": jnp.divide,
+    "broadcast_add": _op_add,
+    "broadcast_sub": _op_sub,
+    "broadcast_mul": _op_mul,
+    "broadcast_div": _op_div,
     "broadcast_mod": jnp.mod,
     "broadcast_power": jnp.power,
     "broadcast_hypot": jnp.hypot,
