@@ -803,3 +803,15 @@ class ImageDetIter(ImageIter):
             self.data_shape = tuple(data_shape)
         if label_shape is not None:
             self.label_shape = tuple(label_shape)
+
+
+def scale_down(src_size, size):
+    """Clamp a crop size to the image size keeping aspect
+    (reference image.py:scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)

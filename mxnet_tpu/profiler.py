@@ -320,3 +320,31 @@ class Marker:
                     "name": "%s::%s" % (self.domain, self.name),
                     "cat": "marker", "ph": "i", "ts": _now_us(),
                     "pid": os.getpid(), "s": scope[0]})
+
+
+def dump_profile():
+    """Deprecated alias of dump() (reference profiler.py:143)."""
+    import warnings
+
+    warnings.warn("profiler.dump_profile() is deprecated. "
+                  "Please use profiler.dump() instead")
+    dump()
+
+
+def set_kvstore_handle(handle):
+    """Kept for API parity (reference profiler.py:29 wires server-side
+    profiling through the kvstore command channel; this build's kvstore is
+    in-process, so its ops are already profiled by the same collector)."""
+    global profiler_kvstore_handle
+    profiler_kvstore_handle = handle
+
+
+profiler_kvstore_handle = None
+
+
+class Event(_Span):
+    """User-defined duration event (reference profiler.py:341): a plain
+    named start()/stop() span without a Domain."""
+
+    def __init__(self, name):
+        super().__init__("event", name)
