@@ -338,7 +338,10 @@ class TrainStep(object):
                 w, s = optimizer.pure_step(
                     pvals[n], grads[n], opt_states[n], t,
                     lr * lm, optimizer.wd * wm)
-                new_p[n] = w
+                # bf16 params: f32 grads/states would silently upcast the
+                # weight each step (multi-precision keeps math in f32, the
+                # stored weight stays in the model's dtype)
+                new_p[n] = w.astype(pvals[n].dtype)
                 new_states[n] = s
             new_p.update(aux)  # BN moving stats et al.
             return loss, new_p, new_states
