@@ -98,3 +98,15 @@ def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
     raise MXNetError(
         "download(%r) needs network access, which is unavailable in this "
         "environment. Place the file at %r manually." % (url, fname))
+
+
+def _export_hook_handle():
+    """HookHandle lives with Block (block.py) but the reference exposes it
+    from gluon.utils; alias for API parity."""
+    from .block import _HookHandle
+
+    return _HookHandle
+
+
+HookHandle = _export_hook_handle()
+__all__.append("HookHandle")
