@@ -1,0 +1,96 @@
+"""Randomized roundtrip tests for the serialization boundaries.
+
+Seeded fuzz over the three formats whose corruption would be silent:
+RecordIO payloads (including magic-word adversarial content), Symbol graph
+JSON, and the .params container — the robustness analogue of the
+reference's random-seed op tests (SURVEY §4.1 determinism fixture).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.ndarray import io_utils
+from mxnet_tpu.symbol import load_json
+
+MAGIC = (0xCED7230A).to_bytes(4, "little")
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_recordio_fuzz_roundtrip(tmp_path, seed):
+    rs = np.random.RandomState(seed)
+    payloads = []
+    for _ in range(40):
+        n = int(rs.randint(0, 4000))
+        raw = rs.bytes(n)
+        if rs.rand() < 0.3 and n > 8:  # plant magic words inside
+            k = int(rs.randint(0, n - 4))
+            raw = raw[:k] + MAGIC * int(rs.randint(1, 4)) + raw[k:]
+        payloads.append(raw)
+    path = str(tmp_path / ("fuzz%d.rec" % seed))
+    w = recordio.MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i, expect in enumerate(payloads):
+        got = r.read()
+        assert got == expect, "record %d differs (len %d vs %d)" % (
+            i, -1 if got is None else len(got), len(expect))
+    assert r.read() is None
+    r.close()
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_symbol_json_fuzz_roundtrip(seed):
+    """Random small DAGs: build → tojson → load_json → same outputs."""
+    rs = np.random.RandomState(seed)
+    pool = [mx.sym.var("x%d" % i) for i in range(3)]
+    unary = ["exp", "tanh", "negative", "square"]
+    for step in range(8):
+        if rs.rand() < 0.5:
+            op = unary[rs.randint(len(unary))]
+            s = getattr(mx.sym, op)(pool[rs.randint(len(pool))],
+                                    name="u%d_%d" % (seed, step))
+        else:
+            a = pool[rs.randint(len(pool))]
+            b = pool[rs.randint(len(pool))]
+            s = mx.sym.elemwise_add(a, b, name="b%d_%d" % (seed, step))
+        pool.append(s)
+    graph = pool[-1]
+    loaded = load_json(graph.tojson())
+    binds = {"x%d" % i: np.clip(rs.randn(2, 3), -1, 1).astype(np.float32)
+             for i in range(3)}
+    shapes = {k: v.shape for k, v in binds.items()}
+    used = set(graph.list_arguments())
+
+    def run(sym):
+        ex = sym.simple_bind(mx.cpu(), **{k: s for k, s in shapes.items()
+                                          if k in used})
+        for k, v in binds.items():
+            if k in used:
+                ex.arg_dict[k][:] = v
+        return ex.forward()[0].asnumpy()
+
+    np.testing.assert_allclose(run(graph), run(loaded), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_params_container_fuzz_roundtrip(tmp_path, seed):
+    rs = np.random.RandomState(seed)
+    data = {}
+    for i in range(rs.randint(1, 8)):
+        ndim = rs.randint(0, 4)
+        shape = tuple(int(d) for d in rs.randint(1, 5, ndim))
+        dtype = [np.float32, np.float16, np.int32, np.int64,
+                 np.uint8][rs.randint(5)]
+        arr = (np.asarray(rs.rand(*shape)) * 100).astype(dtype)
+        data["arg:p%d" % i] = mx.nd.array(arr.astype(np.float32)).astype(
+            dtype.__name__)
+    path = str(tmp_path / ("p%d.params" % seed))
+    io_utils.save(path, data)
+    loaded = io_utils.load(path)
+    assert set(loaded) == set(data)
+    for k in data:
+        np.testing.assert_array_equal(loaded[k].asnumpy(), data[k].asnumpy())
+        assert loaded[k].asnumpy().dtype == data[k].asnumpy().dtype
