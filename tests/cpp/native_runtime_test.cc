@@ -1,0 +1,139 @@
+/*
+ * C++ unit tests for the native runtime — the counterpart of the
+ * reference's tests/cpp/{engine,storage} googletest suites
+ * (threaded_engine_test.cc dependency stress, storage_test.cc allocator
+ * reuse), written against the public C ABI with plain asserts since
+ * googletest is not part of this toolchain.
+ *
+ * Built + run by tests/test_native.py::test_cpp_unit_suite:
+ *   g++ -std=c++17 -O2 tests/cpp/native_runtime_test.cc -Isrc -Lsrc/build \
+ *       -lmxtpu -Wl,-rpath,src/build -o /tmp/native_runtime_test
+ */
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include "mxtpu.h"
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "FAILED %s:%d: %s (last error: %s)\n",         \
+                   __FILE__, __LINE__, #cond, MXTPUGetLastError());       \
+      return 1;                                                           \
+    }                                                                     \
+  } while (0)
+
+static int TestStoragePool() {
+  void *a = nullptr;
+  CHECK(MXTPUStorageAlloc(3000, &a) == 0);
+  std::memset(a, 7, 3000);
+  CHECK(MXTPUStorageFree(a) == 0);
+  void *b = nullptr;
+  CHECK(MXTPUStorageAlloc(2500, &b) == 0);  // same 4096 bucket
+  CHECK(b == a);                            // pool reuse
+  uint64_t in_use, pooled, peak, nalloc, nhit;
+  CHECK(MXTPUStorageStats(&in_use, &pooled, &peak, &nalloc, &nhit) == 0);
+  CHECK(nhit >= 1);
+  CHECK(MXTPUStorageDirectFree(b) == 0);
+  CHECK(MXTPUStorageFree(b) != 0);  // double free detected
+  std::printf("storage pool OK\n");
+  return 0;
+}
+
+struct Counter {
+  std::vector<int> *counters;
+  int idx;
+};
+
+static int BumpNonAtomic(void *arg) {
+  auto *c = static_cast<Counter *>(arg);
+  int cur = (*c->counters)[c->idx];
+  std::this_thread::sleep_for(std::chrono::microseconds(200));
+  (*c->counters)[c->idx] = cur + 1;  // engine must serialize writers
+  return 0;
+}
+
+static int TestEngineStress() {
+  std::mt19937 rng(42);
+  const int kVars = 5, kOps = 200;
+  std::vector<MXTPUVarHandle> vars(kVars);
+  for (auto &v : vars) CHECK(MXTPUEngineNewVar(&v) == 0);
+  std::vector<int> counters(kVars, 0);
+  std::vector<int> expected(kVars, 0);
+  std::vector<Counter> args;
+  args.reserve(kOps);
+  for (int i = 0; i < kOps; ++i) {
+    int vi = (int)(rng() % kVars);
+    expected[vi]++;
+    args.push_back(Counter{&counters, vi});
+    uint64_t id;
+    // random extra read deps exercise the grant bookkeeping
+    MXTPUVarHandle cv = vars[(vi + 1) % kVars];
+    int nc = (rng() % 2) ? 1 : 0;
+    CHECK(MXTPUEnginePushAsync(BumpNonAtomic, &args.back(), nc ? &cv : nullptr,
+                               nc, &vars[vi], 1, 0, &id) == 0);
+  }
+  CHECK(MXTPUEngineWaitForAll() == 0);
+  for (int i = 0; i < kVars; ++i) CHECK(counters[i] == expected[i]);
+  for (auto v : vars) CHECK(MXTPUEngineDeleteVar(v) == 0);
+  std::printf("engine stress OK (%d ops)\n", kOps);
+  return 0;
+}
+
+static int FailingOp(void *) { return 1; }
+
+static int TestEngineErrorPropagation() {
+  MXTPUVarHandle v;
+  CHECK(MXTPUEngineNewVar(&v) == 0);
+  uint64_t id;
+  CHECK(MXTPUEnginePushAsync(FailingOp, nullptr, nullptr, 0, &v, 1, 0, &id) == 0);
+  CHECK(MXTPUEngineWaitForVar(v) != 0);        // failure surfaces
+  CHECK(MXTPUEngineWaitForVar(v) == 0);        // rethrow-once
+  CHECK(MXTPUEngineDeleteVar(v) == 0);
+  std::printf("engine error propagation OK\n");
+  return 0;
+}
+
+static int TestRecordIO() {
+  const char *path = "/tmp/mxtpu_cpp_test.rec";
+  void *w = nullptr;
+  CHECK(MXTPURecordIOWriterCreate(path, &w) == 0);
+  // payload embedding the magic word must survive the split/rejoin
+  uint32_t magic = 0xced7230a;
+  std::vector<char> payload(64, 'x');
+  std::memcpy(payload.data() + 10, &magic, 4);
+  uint64_t pos;
+  CHECK(MXTPURecordIOWriterWrite(w, payload.data(), payload.size(), &pos) == 0);
+  CHECK(MXTPURecordIOWriterWrite(w, "", 0, &pos) == 0);  // empty record
+  CHECK(MXTPURecordIOWriterClose(w) == 0);
+
+  void *r = nullptr;
+  CHECK(MXTPURecordIOReaderCreate(path, &r) == 0);
+  const char *rec;
+  size_t n;
+  CHECK(MXTPURecordIOReaderNext(r, &rec, &n) == 0);
+  CHECK(n == payload.size() && std::memcmp(rec, payload.data(), n) == 0);
+  CHECK(MXTPURecordIOReaderNext(r, &rec, &n) == 0);
+  CHECK(rec != nullptr && n == 0);  // empty record, not EOF
+  CHECK(MXTPURecordIOReaderNext(r, &rec, &n) == 0);
+  CHECK(rec == nullptr);            // EOF
+  CHECK(MXTPURecordIOReaderClose(r) == 0);
+  std::printf("recordio OK\n");
+  return 0;
+}
+
+int main() {
+  int version;
+  CHECK(MXTPUGetVersion(&version) == 0);
+  if (TestStoragePool()) return 1;
+  if (TestEngineStress()) return 1;
+  if (TestEngineErrorPropagation()) return 1;
+  if (TestRecordIO()) return 1;
+  std::printf("ALL C++ TESTS PASSED\n");
+  return 0;
+}

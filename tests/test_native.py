@@ -336,3 +336,29 @@ def test_waitall_drains_host_engine():
     mx.nd.waitall()
     assert done == [1]
     engine.delete_var(var)
+
+
+def test_cpp_unit_suite(tmp_path):
+    """Build and run the pure-C++ test binary against the ABI (the
+    reference's tests/cpp layer)."""
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    from pathlib import Path
+
+    from mxnet_tpu import _native
+
+    _native.build_lib()
+    repo = Path(__file__).resolve().parent.parent
+    binary = str(tmp_path / "native_runtime_test")
+    build = subprocess.run(
+        ["g++", "-std=c++17", "-O2", str(repo / "tests" / "cpp" /
+                                        "native_runtime_test.cc"),
+         "-I", str(repo / "src"), "-L", str(repo / "src" / "build"),
+         "-lmxtpu", "-Wl,-rpath," + str(repo / "src" / "build"),
+         "-o", binary], capture_output=True, text=True, timeout=180)
+    assert build.returncode == 0, build.stderr[-2000:]
+    run = subprocess.run([binary], capture_output=True, text=True, timeout=180)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "ALL C++ TESTS PASSED" in run.stdout
