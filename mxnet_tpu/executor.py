@@ -17,7 +17,7 @@ import numpy as np
 
 from . import _fused, _global
 from . import profiler as _profiler
-from .base import MXNetError
+from .base import MXNetError, get_env
 from .context import Context, current_context
 from .ndarray.ndarray import NDArray
 
@@ -135,12 +135,20 @@ class Executor(object):
                 _global.set_train(prev)
             return tuple(outs), aux_updates
 
+        # rematerialization: recompute activations in backward instead of
+        # keeping residuals in HBM — the reference's mirror-for-recompute
+        # policy (MXNET_BACKWARD_DO_MIRROR, graph_executor.cc:259), realized
+        # as jax.checkpoint over the whole graph function
+        do_mirror = bool(get_env("MXNET_BACKWARD_DO_MIRROR", 0, int))
+
         def fwd(diff_vals, const_args, aux_vals, rng):
             def f(dv):
                 av = dict(const_args)
                 av.update(zip(diff_names, dv))
                 return run_graph(av, aux_vals, rng)
 
+            if do_mirror:
+                f = jax.checkpoint(f)
             outs, vjp_fn, aux = jax.vjp(f, list(diff_vals), has_aux=True)
 
             def vjp_flat(*cts_flat):

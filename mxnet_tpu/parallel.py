@@ -253,9 +253,13 @@ class TrainStep(object):
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh: Optional[Mesh] = None,
-                 optimizer_params=None, batch_axis: int = 0):
+                 optimizer_params=None, batch_axis: int = 0,
+                 remat: bool = False):
         from . import optimizer as opt_mod
 
+        #: recompute activations in backward (jax.checkpoint) — trades FLOPs
+        #: for HBM, the reference's MXNET_BACKWARD_DO_MIRROR policy
+        self._remat = remat
         self._net = net
         self._loss = loss_fn
         if isinstance(optimizer, str):
@@ -328,8 +332,9 @@ class TrainStep(object):
                 return loss, aux
 
             diff = {n: pvals[n] for n in diff_names}
+            lf = jax.checkpoint(loss_f) if self._remat else loss_f
             (loss, aux), grads = jax.value_and_grad(
-                loss_f, has_aux=True)(diff)
+                lf, has_aux=True)(diff)
 
             new_p = dict(const)
             new_states = {}
