@@ -102,7 +102,7 @@ _UNARY = {
     "ceil": jnp.ceil,
     "round": jnp.round,
     "rint": jnp.rint,
-    "fix": jnp.fix,
+    "fix": jnp.trunc,  # fix == round-toward-zero; jnp.fix is deprecated in jax 0.9
     "trunc": jnp.trunc,
     "gamma": getattr(jax.scipy.special, "gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x))),
     "gammaln": jax.scipy.special.gammaln,
