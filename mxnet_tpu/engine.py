@@ -132,8 +132,10 @@ def maybe_sync_eager(result) -> None:
 
 # Correlation bookkeeping. The native callback may run BEFORE PushAsync
 # returns the native opr id, so exceptions are keyed by a Python-side id
-# passed through the callback's `arg` pointer; the native→python id mapping
-# is recorded after the push and consulted when a wait reports a failure.
+# passed through the callback's `arg` pointer. The native engine echoes that
+# payload back in its failure message ("... failed (payload P)"), so a wait
+# maps a failure straight to _exc_by_pyid with no native→python id table —
+# recording such a table after PushAsync returns would race the callback.
 #
 # A SINGLE static ctypes trampoline dispatches every op by that id. This is
 # load-bearing: a per-push CFUNCTYPE closure would have to be freed at some
@@ -141,8 +143,6 @@ def maybe_sync_eager(result) -> None:
 # ffi thunk is a use-after-free — a static trampoline can never be collected.
 _pending_fns: Dict[int, Callable[[], None]] = {}   # py_id -> python fn
 _exc_by_pyid: Dict[int, BaseException] = {}        # py_id -> raised exception
-_native_to_py: Dict[int, int] = {}                 # native opr id -> py_id
-_done_pyids: list = []                             # successes pending pruning
 _cb_lock = threading.Lock()
 _next_pyid = 1
 
@@ -155,8 +155,6 @@ def _dispatch(arg):
         return 1
     try:
         fn()
-        with _cb_lock:
-            _done_pyids.append(pid)
         return 0
     except BaseException as exc:  # noqa: BLE001 - stored, re-raised at wait
         with _cb_lock:
@@ -219,7 +217,7 @@ def push(fn: Callable[[], None], const_vars: Sequence = (),
         py_id = _next_pyid
         _next_pyid += 1
         _pending_fns[py_id] = fn
-        _prune_locked()
+        _prune_exc_locked()
     cvars = (ctypes.c_uint64 * max(1, len(const_vars)))(*[int(v) for v in const_vars])
     mvars = (ctypes.c_uint64 * max(1, len(mutable_vars)))(*[int(v) for v in mutable_vars])
     opr_id = ctypes.c_uint64()
@@ -233,31 +231,28 @@ def push(fn: Callable[[], None], const_vars: Sequence = (),
         if exc is not None:  # naive mode runs inline: surface at the push
             raise exc
         _native.check_call(rc)
-    with _cb_lock:
-        _native_to_py[opr_id.value] = py_id
     return opr_id.value
 
 
-def _prune_locked() -> None:
-    """Drop bookkeeping for completed-successfully ops (bounded memory for
-    long-running pipelines). Called with _cb_lock held."""
-    if len(_done_pyids) < 512:
-        return
-    done = set(_done_pyids)
-    _done_pyids.clear()
-    for nid in [n for n, p in _native_to_py.items() if p in done]:
-        del _native_to_py[nid]
+def _prune_exc_locked() -> None:
+    """Bound _exc_by_pyid for long pipelines that never wait on a failed
+    var: keep only the most recent 512 stored failures. Called with
+    _cb_lock held."""
+    if len(_exc_by_pyid) > 512:
+        for pid in sorted(_exc_by_pyid)[:-512]:
+            del _exc_by_pyid[pid]
 
 
 def _raise_stored(err_msg: str) -> None:
-    """Map 'async operator N failed' back to the original Python exception."""
-    opr_id = None
+    """Map '... failed (payload P)' back to the original Python exception:
+    P is the py_id this side passed as the callback arg, echoed by the
+    native engine precisely so no racy native-id table is needed."""
+    py_id = None
     try:
-        opr_id = int(err_msg.strip().split()[2])
+        py_id = int(err_msg.strip().rsplit("(payload", 1)[1].split(")")[0])
     except (IndexError, ValueError):
         pass
     with _cb_lock:
-        py_id = _native_to_py.pop(opr_id, None)
         exc = _exc_by_pyid.pop(py_id, None) if py_id is not None else None
     if exc is not None:
         raise exc

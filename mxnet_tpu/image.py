@@ -85,12 +85,19 @@ def imread(filename, flag=1, to_rgb=1):
 def imresize(src, w, h, interp=1):
     """Resize HWC (reference image.py:imresize)."""
     import jax
+    import jax.numpy as jnp
 
     arr = src._data if isinstance(src, NDArray) else np.asarray(src)
     method = {0: "nearest", 1: "bilinear", 2: "cubic", 3: "bilinear",
               4: "lanczos3"}.get(interp, "bilinear")
+    in_dtype = np.asarray(arr).dtype
     out = jax.image.resize(np.asarray(arr).astype(np.float32),
                            (h, w, arr.shape[2]), method=method)
+    if np.issubdtype(in_dtype, np.integer):
+        # the reference's cv2-backed imresize preserves the input dtype
+        # (uint8 through the decode pipeline): round and clip back
+        info = np.iinfo(in_dtype)
+        out = jnp.clip(jnp.round(out), info.min, info.max).astype(in_dtype)
     return NDArray(out, src.context if isinstance(src, NDArray) else None) \
         if isinstance(src, NDArray) else _to_nd(np.asarray(out))
 

@@ -27,6 +27,18 @@ def _prep(grad, rescale_grad, clip_gradient):
     return g
 
 
+def _prep_wd(grad, weight, attrs, clip=None):
+    """adam/ftml/rmsprop/rmspropalex fold weight decay into the gradient
+    BEFORE clipping (reference optimizer_op-inl.h AdamUpdate ~:858,
+    FTMLKernel :761, RMSProp*/~:1157-1260): g = clip(rescale*grad + wd*w).
+    The sgd family clips first and applies wd outside — see _prep callers."""
+    g = grad * attrs.rescale_grad + attrs.wd * weight
+    clip = attrs.clip_gradient if clip is None else clip
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return g
+
+
 _COMMON = {
     "lr": (float, REQUIRED),
     "wd": (float, 0.0),
@@ -76,7 +88,7 @@ def _mp_sgd_mom_update(attrs, weight, grad, mom, weight32):
                       epsilon=(float, 1e-8), lazy_update=(bool, True)),
           inputs=("weight", "grad", "mean", "var"), num_outputs=3)
 def _adam_update(attrs, weight, grad, mean, var):
-    g = _prep(grad, attrs.rescale_grad, attrs.clip_gradient) + attrs.wd * weight
+    g = _prep_wd(grad, weight, attrs)
     m = attrs.beta1 * mean + (1 - attrs.beta1) * g
     v = attrs.beta2 * var + (1 - attrs.beta2) * g * g
     w = weight - attrs.lr * m / (jnp.sqrt(v) + attrs.epsilon)
@@ -90,7 +102,7 @@ def _adam_update(attrs, weight, grad, mean, var):
           inputs=("weight", "grad", "d", "v", "z"), num_outputs=4)
 def _ftml_update(attrs, weight, grad, d, v, z):
     clip = attrs.clip_grad if attrs.clip_grad > 0 else attrs.clip_gradient
-    g = _prep(grad, attrs.rescale_grad, clip) + attrs.wd * weight
+    g = _prep_wd(grad, weight, attrs, clip=clip)
     t = attrs.t
     v_new = attrs.beta2 * v + (1 - attrs.beta2) * g * g
     d_new = (1 - attrs.beta1 ** t) / attrs.lr * (
@@ -121,7 +133,7 @@ def _ftrl_update(attrs, weight, grad, z, n):
           params=dict(_COMMON, gamma1=(float, 0.95), epsilon=(float, 1e-8)),
           inputs=("weight", "grad", "n"), num_outputs=2)
 def _rmsprop_update(attrs, weight, grad, n):
-    g = _prep(grad, attrs.rescale_grad, attrs.clip_gradient) + attrs.wd * weight
+    g = _prep_wd(grad, weight, attrs)
     n_new = attrs.gamma1 * n + (1 - attrs.gamma1) * g * g
     w = weight - attrs.lr * g / jnp.sqrt(n_new + attrs.epsilon)
     return w, n_new
@@ -132,7 +144,7 @@ def _rmsprop_update(attrs, weight, grad, n):
                       epsilon=(float, 1e-8)),
           inputs=("weight", "grad", "n", "g", "delta"), num_outputs=4)
 def _rmspropalex_update(attrs, weight, grad, n, g_state, delta):
-    g = _prep(grad, attrs.rescale_grad, attrs.clip_gradient) + attrs.wd * weight
+    g = _prep_wd(grad, weight, attrs)
     n_new = attrs.gamma1 * n + (1 - attrs.gamma1) * g * g
     g_new = attrs.gamma1 * g_state + (1 - attrs.gamma1) * g
     delta_new = attrs.gamma2 * delta - attrs.lr * g / jnp.sqrt(

@@ -214,6 +214,26 @@ class Optimizer(object):
             grad = jnp.clip(grad, -self.clip_gradient, self.clip_gradient)
         return grad + wd * weight
 
+    def _preprocess_wd_in_clip(self, grad, weight, wd):
+        """rescale → +wd·weight → clip: the adam/ftml/rmsprop/adamax/nadam
+        family folds weight decay into the gradient BEFORE clipping
+        (reference optimizer.py Adam :1037 ``clip(grad*rescale + wd*weight)``,
+        optimizer_op-inl.h AdamUpdate/FTMLKernel/RMSProp kernels), unlike the
+        sgd family which clips the bare gradient (``_preprocess``)."""
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = jnp.clip(grad, -self.clip_gradient, self.clip_gradient)
+        return grad
+
+    def _preprocess_no_wd(self, grad):
+        """rescale → clip, weight decay applied separately at the weight
+        update (reference AdaGrad :1105-1108, AdaDelta :1271-1284, DCASGD
+        :909-920 — wd never enters the gradient statistics)."""
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = jnp.clip(grad, -self.clip_gradient, self.clip_gradient)
+        return grad
+
     def _fused(self, key, fn):
         """jit-compile ``fn`` once per (variant, rescale_grad, clip) key.
 
@@ -449,7 +469,7 @@ class FTML(Optimizer):
         b1, b2, eps = self.beta1, self.beta2, self.epsilon
 
         def step(w, g, d, v, z, lr, wd, t):
-            g = self._preprocess(g, w, wd)
+            g = self._preprocess_wd_in_clip(g, w, wd)
             v = b2 * v + (1 - b2) * g * g
             bc1 = 1 - jnp.power(b1, t)
             bc2 = 1 - jnp.power(b2, t)
@@ -490,16 +510,17 @@ class DCASGD(Optimizer):
 
         if mom is None:
             def step(w, g, prev, lr, wd):
-                g = self._preprocess(g, w, wd)
-                upd = -lr * (g + self.lamda * g * g * (w - prev))
+                g = self._preprocess_no_wd(g)
+                upd = -lr * (g + wd * w + self.lamda * g * g * (w - prev))
                 return w + upd, w
             new_w, new_prev = self._fused("dcasgd0", step)(w, g, prev, lr, wd)
             weight._data = new_w
             return (None, new_prev)
 
         def step(w, g, m, prev, lr, wd):
-            g = self._preprocess(g, w, wd)
-            m = self.momentum * m - lr * (g + self.lamda * g * g * (w - prev))
+            g = self._preprocess_no_wd(g)
+            m = self.momentum * m - lr * (
+                g + wd * w + self.lamda * g * g * (w - prev))
             return w + m, m, w
 
         new_w, new_m, new_prev = self._fused("dcasgd", step)(w, g, mom, prev, lr, wd)
@@ -575,7 +596,7 @@ class Adam(Optimizer):
         b1, b2, eps = self.beta1, self.beta2, self.epsilon
 
         def step(w, g, m, v, lr, wd):
-            g = self._preprocess(g, w, wd)
+            g = self._preprocess_wd_in_clip(g, w, wd)
             m = b1 * m + (1 - b1) * g
             v = b2 * v + (1 - b2) * g * g
             return w - lr * m / (jnp.sqrt(v) + eps), m, v
@@ -589,7 +610,7 @@ class Adam(Optimizer):
     def pure_step(self, w, g, state, t, lr, wd):
         b1, b2, eps = self.beta1, self.beta2, self.epsilon
         lr = lr * jnp.sqrt(1.0 - jnp.power(b2, t)) / (1.0 - jnp.power(b1, t))
-        g = self._preprocess(g, w, wd)
+        g = self._preprocess_wd_in_clip(g, w, wd)
         m, v = state
         m = b1 * m + (1 - b1) * g
         v = b2 * v + (1 - b2) * g * g
@@ -615,9 +636,9 @@ class AdaGrad(Optimizer):
         eps = self.float_stable_eps
 
         def step(w, g, h, lr, wd):
-            g = self._preprocess(g, w, wd)
+            g = self._preprocess_no_wd(g)
             h = h + g * g
-            return w - lr * g / jnp.sqrt(h + eps), h
+            return w - lr * (g / jnp.sqrt(h + eps) + wd * w), h
 
         new_w, new_h = self._fused("adagrad", step)(
             _as_jax(weight), _as_jax(grad), _as_jax(state), lr, wd)
@@ -625,9 +646,9 @@ class AdaGrad(Optimizer):
         return new_h
 
     def pure_step(self, w, g, state, t, lr, wd):
-        g = self._preprocess(g, w, wd)
+        g = self._preprocess_no_wd(g)
         h = state + g * g
-        return w - lr * g / jnp.sqrt(h + self.float_stable_eps), h
+        return w - lr * (g / jnp.sqrt(h + self.float_stable_eps) + wd * w), h
 
 
 @register
@@ -658,7 +679,7 @@ class RMSProp(Optimizer):
 
         if not self.centered:
             def step(w, g, n, lr, wd):
-                g = self._preprocess(g, w, wd)
+                g = self._preprocess_wd_in_clip(g, w, wd)
                 n = (1 - g1) * g * g + g1 * n
                 w = w - lr * g / jnp.sqrt(n + eps)
                 if cw:
@@ -670,7 +691,7 @@ class RMSProp(Optimizer):
             return (n,)
 
         def step(w, g, n, mg, delta, lr, wd):
-            g = self._preprocess(g, w, wd)
+            g = self._preprocess_wd_in_clip(g, w, wd)
             n = (1 - g1) * g * g + g1 * n
             mg = (1 - g1) * g + g1 * mg
             delta = g2 * delta - lr * g / jnp.sqrt(n - mg * mg + eps)
@@ -687,7 +708,7 @@ class RMSProp(Optimizer):
 
     def pure_step(self, w, g, state, t, lr, wd):
         g1, g2, eps = self.gamma1, self.gamma2, self.epsilon
-        g = self._preprocess(g, w, wd)
+        g = self._preprocess_wd_in_clip(g, w, wd)
         if not self.centered:
             (n,) = state
             n = (1 - g1) * g * g + g1 * n
@@ -724,11 +745,11 @@ class AdaDelta(Optimizer):
         rho, eps = self.rho, self.epsilon
 
         def step(w, g, acc_g, acc_d, wd):
-            g = self._preprocess(g, w, wd)
+            g = self._preprocess_no_wd(g)
             acc_g = rho * acc_g + (1 - rho) * g * g
             delta = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g + eps) * g
             acc_d = rho * acc_d + (1 - rho) * delta * delta
-            return w - delta, acc_g, acc_d
+            return w - (delta + wd * w), acc_g, acc_d
 
         acc_g, acc_d = state
         new_w, acc_g, acc_d = self._fused("adadelta", step)(
@@ -738,12 +759,12 @@ class AdaDelta(Optimizer):
 
     def pure_step(self, w, g, state, t, lr, wd):
         rho, eps = self.rho, self.epsilon
-        g = self._preprocess(g, w, wd)
+        g = self._preprocess_no_wd(g)
         acc_g, acc_d = state
         acc_g = rho * acc_g + (1 - rho) * g * g
         delta = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g + eps) * g
         acc_d = rho * acc_d + (1 - rho) * delta * delta
-        return w - delta, (acc_g, acc_d)
+        return w - (delta + wd * w), (acc_g, acc_d)
 
 
 @register
@@ -823,7 +844,7 @@ class Adamax(Optimizer):
         b1, b2 = self.beta1, self.beta2
 
         def step(w, g, m, u, lr, wd):
-            g = self._preprocess(g, w, wd)
+            g = self._preprocess_wd_in_clip(g, w, wd)
             m = b1 * m + (1 - b1) * g
             u = jnp.maximum(b2 * u, jnp.abs(g))
             return w - lr * m / (u + 1e-8), m, u
@@ -837,7 +858,7 @@ class Adamax(Optimizer):
     def pure_step(self, w, g, state, t, lr, wd):
         b1, b2 = self.beta1, self.beta2
         lr = lr / (1.0 - jnp.power(b1, t))
-        g = self._preprocess(g, w, wd)
+        g = self._preprocess_wd_in_clip(g, w, wd)
         m, u = state
         m = b1 * m + (1 - b1) * g
         u = jnp.maximum(b2 * u, jnp.abs(g))
@@ -875,7 +896,7 @@ class Nadam(Optimizer):
 
         # time-varying scalars enter as traced args so the kernel compiles once
         def step(w, g, m, v, lr, wd, t, mt, mt1, ms, msn):
-            g = self._preprocess(g, w, wd)
+            g = self._preprocess_wd_in_clip(g, w, wd)
             g_prime = g / (1.0 - ms)
             m = b1 * m + (1.0 - b1) * g
             m_prime = m / (1.0 - msn)

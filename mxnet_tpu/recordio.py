@@ -130,6 +130,12 @@ class MXRecordIO(object):
         embeds the magic word)."""
         assert self.writable
         self._check_pid(allow_reset=False)
+        if len(buf) > _LREC_MASK:
+            # dmlc-core hard-checks size < 1<<29; masking a longer length
+            # would silently corrupt the .rec file
+            raise MXNetError(
+                "RecordIO record too large: %d bytes (max %d)"
+                % (len(buf), _LREC_MASK))
         if self._nat is not None:
             import ctypes
 

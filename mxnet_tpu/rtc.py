@@ -74,7 +74,17 @@ class Kernel(object):
             else:
                 data = a._data if isinstance(a, NDArray) else jnp.asarray(a)
                 ins.append(data.astype(dt) if data.dtype != dt else data)
-        grid = tuple(int(g) for g in grid_dims if int(g) > 1) or (1,)
+        # Preserve grid RANK: a kernel written against grid (1, 8, 1) reads
+        # pl.program_id(1) for its real axis — dropping interior 1-dims would
+        # silently renumber its axes. Only trailing 1s are safe to strip.
+        grid = tuple(int(g) for g in grid_dims) or (1,)
+        if any(g < 1 for g in grid):
+            # CUDA rejects a zero gridDim launch; silently running zero
+            # grid steps would return an unwritten output buffer
+            raise MXNetError("kernel %s: invalid grid_dims %r (all dims "
+                             "must be >= 1)" % (self._name, grid_dims))
+        while len(grid) > 1 and grid[-1] == 1:
+            grid = grid[:-1]
         result = pl.pallas_call(
             self._fn,
             out_shape=outs if len(outs) > 1 else outs[0],

@@ -59,7 +59,8 @@ struct VarEntry {
 
 struct Var {
   std::deque<VarEntry> queue;
-  uint64_t failed_opr = 0;  // opr id that failed while mutating this var
+  uint64_t failed_opr = 0;      // opr id that failed while mutating this var
+  uint64_t failed_payload = 0;  // that opr's callback payload (frontend key)
   bool to_delete = false;
 };
 
@@ -190,9 +191,15 @@ class Engine {
     done_cv_.wait(lock, [&] { return v->queue.empty(); });
     if (v->failed_opr != 0) {
       uint64_t f = v->failed_opr;
+      uint64_t pay = v->failed_payload;
       v->failed_opr = 0;  // rethrow-once, like WaitForVar in the reference
+      v->failed_payload = 0;
       if (first_failed_ == f) first_failed_ = 0;  // don't re-report at WaitForAll
-      SetLastError("async operator " + std::to_string(f) + " failed");
+      // The payload is echoed so the frontend can map the failure to its own
+      // bookkeeping without a native-id table (engine.py keys exceptions by
+      // payload; recording a native-id map after PushAsync returns is racy).
+      SetLastError("async operator " + std::to_string(f) + " failed (payload " +
+                   std::to_string(pay) + ")");
       return -1;
     }
     return 0;
@@ -203,8 +210,11 @@ class Engine {
     done_cv_.wait(lock, [&] { return inflight_ == 0; });
     if (first_failed_ != 0) {
       uint64_t f = first_failed_;
+      uint64_t pay = first_failed_payload_;
       first_failed_ = 0;
-      SetLastError("async operator " + std::to_string(f) + " failed");
+      first_failed_payload_ = 0;
+      SetLastError("async operator " + std::to_string(f) + " failed (payload " +
+                   std::to_string(pay) + ")");
       return -1;
     }
     return 0;
@@ -298,7 +308,10 @@ class Engine {
   void CompleteLocked(Opr *opr, bool failed) {
     for (uint64_t vid : opr->const_vars) EraseEntryLocked(vid, opr, failed && false);
     for (uint64_t vid : opr->mutable_vars) EraseEntryLocked(vid, opr, failed);
-    if (failed && first_failed_ == 0) first_failed_ = opr->id;
+    if (failed && first_failed_ == 0) {
+      first_failed_ = opr->id;
+      first_failed_payload_ = reinterpret_cast<uint64_t>(opr->arg);
+    }
     live_oprs_.erase(opr->id);
     --inflight_;
     done_cv_.notify_all();
@@ -315,7 +328,10 @@ class Engine {
         break;
       }
     }
-    if (taint) v->failed_opr = opr->id;
+    if (taint) {
+      v->failed_opr = opr->id;
+      v->failed_payload = reinterpret_cast<uint64_t>(opr->arg);
+    }
     if (q.empty() && v->to_delete) {
       vars_.erase(it);
       return;
@@ -354,6 +370,7 @@ class Engine {
   std::atomic<uint64_t> next_opr_{1};
   uint64_t next_var_ = 1;
   uint64_t first_failed_ = 0;
+  uint64_t first_failed_payload_ = 0;
   int inflight_ = 0;
   int num_workers_;
   bool naive_ = false;

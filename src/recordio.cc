@@ -53,6 +53,13 @@ int MXTPURecordIOWriterCreate(const char *path, void **out) {
 
 int MXTPURecordIOWriterWrite(void *handle, const char *buf, size_t size, uint64_t *out_pos) try {
   auto *w = static_cast<mxtpu::Writer *>(handle);
+  if (size > mxtpu::kLenMask) {
+    // dmlc-core hard-checks size < 1<<29; masking would corrupt the file
+    mxtpu::SetLastError("MXTPURecordIOWriterWrite: record too large (" +
+                        std::to_string(size) + " bytes, max " +
+                        std::to_string(mxtpu::kLenMask) + ")");
+    return -1;
+  }
   long pos = std::ftell(w->f);
   if (pos < 0) {
     mxtpu::SetLastError("MXTPURecordIOWriterWrite: ftell failed");

@@ -362,3 +362,25 @@ def test_cpp_unit_suite(tmp_path):
     run = subprocess.run([binary], capture_output=True, text=True, timeout=180)
     assert run.returncode == 0, run.stdout + run.stderr
     assert "ALL C++ TESTS PASSED" in run.stdout
+
+
+def test_recordio_oversize_record_rejected(tmp_path):
+    """dmlc-core hard-checks record size < 1<<29; both writers must raise
+    rather than mask the length into a corrupt frame (ADVICE r4)."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.base import MXNetError
+
+    big = bytes(recordio._LREC_MASK + 1)  # 512 MiB of zeros (memset, fast)
+    # native-backed writer
+    w = recordio.MXRecordIO(str(tmp_path / "big.rec"), "w")
+    try:
+        with pytest.raises(MXNetError, match="too large"):
+            w.write(big)
+        w.write(b"after")  # writer still usable after the rejection
+    finally:
+        w.close()
+    r = recordio.MXRecordIO(str(tmp_path / "big.rec"), "r")
+    try:
+        assert r.read() == b"after"
+    finally:
+        r.close()

@@ -128,3 +128,72 @@ def test_rmsprop_and_ftrl_finite():
     assert np.isfinite(w_out.asnumpy()).all()
     # lamda1 regularization produces exact zeros for small z
     assert (np.abs(w_out.asnumpy()) < 1e3).all()
+
+
+def test_clip_wd_ordering():
+    """adam/ftml/rmsprop/rmspropalex clip AFTER folding in wd*weight
+    (reference optimizer_op-inl.h AdamUpdate ~:858, FTMLKernel :761,
+    RMSProp kernels ~:1157-1260); the sgd family clips the bare gradient.
+    With clip small and wd*|w| large the two orderings differ measurably."""
+    w_np = np.full((3, 2), 10.0, np.float32)
+    g_np = np.full((3, 2), 0.5, np.float32)
+    clip, wd, lr = 0.1, 1.0, 0.01
+
+    # adam: g = clip(grad + wd*w) = clip(0.5 + 10) = 0.1 everywhere
+    g_eff = np.clip(g_np + wd * w_np, -clip, clip)
+    m = (1 - 0.9) * g_eff
+    v = (1 - 0.999) * g_eff * g_eff
+    expect = w_np - lr * m / (np.sqrt(v) + 1e-8)
+    w_out, m_out, v_out = invoke(
+        "adam_update", mx.nd.array(w_np), mx.nd.array(g_np),
+        mx.nd.zeros(w_np.shape), mx.nd.zeros(w_np.shape),
+        lr=lr, wd=wd, clip_gradient=clip)
+    np.testing.assert_allclose(w_out.asnumpy(), expect, rtol=1e-6)
+    np.testing.assert_allclose(m_out.asnumpy(), m, rtol=1e-6)
+
+    # rmsprop: same prologue
+    n = (1 - 0.95) * g_eff * g_eff
+    expect = w_np - lr * g_eff / np.sqrt(n + 1e-8)
+    w_out, _ = invoke("rmsprop_update", mx.nd.array(w_np), mx.nd.array(g_np),
+                      mx.nd.zeros(w_np.shape), lr=lr, wd=wd,
+                      clip_gradient=clip)
+    np.testing.assert_allclose(w_out.asnumpy(), expect, rtol=1e-5)
+
+    # sgd clips the bare grad, wd applied outside: g=clip(0.5)=0.1,
+    # step = lr*(0.1 + wd*10)
+    expect = w_np - lr * (np.clip(g_np, -clip, clip) + wd * w_np)
+    out = invoke("sgd_update", mx.nd.array(w_np), mx.nd.array(g_np),
+                 lr=lr, wd=wd, clip_gradient=clip)
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+
+
+def test_optimizer_class_clip_wd_ordering():
+    """The Optimizer classes mirror the kernel ordering: Adam folds wd
+    before clip; AdaGrad/AdaDelta keep wd out of the gradient statistics
+    entirely (reference optimizer.py :1105-1108, AdaDelta update)."""
+    from mxnet_tpu import optimizer as opt
+    w_np = np.full((4,), 10.0, np.float32)
+    g_np = np.full((4,), 0.5, np.float32)
+    clip, wd, lr = 0.1, 1.0, 0.01
+
+    adam = opt.Adam(learning_rate=lr, wd=wd, clip_gradient=clip)
+    w = mx.nd.array(w_np)
+    st = adam.create_state(0, w)
+    st = adam.update(0, w, mx.nd.array(g_np), st)
+    g_eff = np.clip(g_np + wd * w_np, -clip, clip)   # = 0.1
+    m = 0.1 * g_eff
+    v = 0.001 * g_eff * g_eff
+    lr_t = lr * np.sqrt(1 - 0.999) / (1 - 0.9)
+    expect = w_np - lr_t * m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(w.asnumpy(), expect, rtol=1e-6)
+
+    # AdaGrad: history uses clip(bare grad); wd applied at the update
+    ada = opt.AdaGrad(learning_rate=lr, wd=wd, clip_gradient=clip)
+    w = mx.nd.array(w_np)
+    st = ada.create_state(0, w)
+    st = ada.update(0, w, mx.nd.array(g_np), st)
+    g_eff = np.clip(g_np, -clip, clip)
+    h = g_eff * g_eff
+    expect = w_np - lr * (g_eff / np.sqrt(h + 1e-7) + wd * w_np)
+    np.testing.assert_allclose(w.asnumpy(), expect, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(st), h, rtol=1e-6)

@@ -1,0 +1,115 @@
+"""Persistent TPU-relay prober (VERDICT r4 item 1).
+
+Loops for the whole session: every cycle it probes jax backend init in a
+subprocess with a hard timeout, appending a timestamped line to
+``TPU_ATTEMPTS.log``. The moment a probe sees a real TPU device it runs the
+full ``bench.py`` (saving stdout to ``BENCH_TPU_LIVE.json``), then
+``tests/test_operator_tpu.py`` and the ``__graft_entry__.entry()`` compile
+check on the real chip, and keeps re-probing afterwards (cheap) so the log
+proves relay state over the whole session.
+
+Run:  python tools/tpu_probe.py [--interval 600] [--once]
+"""
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TPU_ATTEMPTS.log")
+
+PROBE_SRC = r"""
+import json, sys
+import jax
+devs = jax.devices()
+print(json.dumps({"platform": devs[0].platform, "n": len(devs),
+                  "kind": getattr(devs[0], "device_kind", "?")}))
+"""
+
+
+def log(msg):
+    line = "%s %s" % (datetime.datetime.utcnow().isoformat() + "Z", msg)
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def probe(timeout_s=90):
+    """Probe backend init in a subprocess (a hung init can't wedge us)."""
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", PROBE_SRC], capture_output=True,
+            text=True, timeout=timeout_s, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return None, "timeout after %.0fs (relay down/wedged)" % (time.time() - t0)
+    if out.returncode != 0:
+        return None, "init raised rc=%d: %s" % (
+            out.returncode, (out.stderr or "").strip()[-300:])
+    try:
+        info = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception:
+        return None, "unparseable probe output: %r" % out.stdout[-200:]
+    return info, None
+
+
+def run_bench():
+    log("TPU UP — running full bench.py (deadline 1500s)")
+    env = dict(os.environ, MXNET_BENCH_DEADLINE_S="1500")
+    out = subprocess.run([sys.executable, "bench.py"], capture_output=True,
+                         text=True, timeout=1800, cwd=REPO, env=env)
+    last = ""
+    for ln in out.stdout.strip().splitlines():
+        if ln.startswith("{"):
+            last = ln
+    log("bench rc=%d result=%s" % (out.returncode, last[:400]))
+    if last:
+        with open(os.path.join(REPO, "BENCH_TPU_LIVE.json"), "w") as f:
+            f.write(last + "\n")
+    return last
+
+
+def run_tpu_tests():
+    log("running tests/test_operator_tpu.py on real chip")
+    out = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_operator_tpu.py",
+         "-q", "--no-header", "-x"],
+        capture_output=True, text=True, timeout=3600, cwd=REPO)
+    tail = (out.stdout or "").strip().splitlines()[-3:]
+    log("tpu tests rc=%d tail=%s" % (out.returncode, " | ".join(tail)))
+    with open(os.path.join(REPO, "TPU_TEST_RESULT.txt"), "w") as f:
+        f.write("rc=%d\n%s\n%s" % (out.returncode, out.stdout[-4000:],
+                                   out.stderr[-2000:]))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=600)
+    ap.add_argument("--once", action="store_true")
+    args = ap.parse_args()
+    benched = os.path.exists(os.path.join(REPO, "BENCH_TPU_LIVE.json"))
+    while True:
+        info, err = probe()
+        if info is None:
+            log("probe FAILED: %s" % err)
+        elif info.get("platform") != "tpu":
+            log("probe ok but platform=%s (no TPU)" % info.get("platform"))
+        else:
+            log("probe OK: %s" % json.dumps(info))
+            if not benched:
+                try:
+                    if run_bench():
+                        benched = True
+                    run_tpu_tests()
+                except Exception as e:  # noqa: BLE001
+                    log("bench/tests crashed: %r" % e)
+        if args.once:
+            break
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()
