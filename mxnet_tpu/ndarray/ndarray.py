@@ -490,6 +490,15 @@ def invoke(op_name: str, *inputs, out=None, **kwargs):
     reference's per-op FGradient)."""
     opdef = get_op(op_name)
     attrs = opdef.parse_attrs(kwargs)
+    # storage-type dispatch: route to the op's FComputeEx when a sparse
+    # NDArray is involved (or the op always dispatches ex, e.g. cast_storage
+    # whose OUTPUT storage is the sparse one) — reference DispatchMode
+    # selection in imperative_utils.h:98-176
+    if opdef.fcompute_ex is not None:
+        from . import sparse as _sp
+        if (opdef.dispatch_ex_always
+                or any(isinstance(i, _sp.BaseSparseNDArray) for i in inputs)):
+            return _invoke_ex(opdef, attrs, inputs, out)
     nd_inputs: List[Optional[NDArray]] = []
     datas = []
     for i in inputs:
@@ -524,29 +533,8 @@ def invoke(op_name: str, *inputs, out=None, **kwargs):
 
     if record:
         diff_pos = [k for k, nd in enumerate(nd_inputs) if nd is not None]
-        diff_datas = [datas[k] for k in diff_pos]
-
-        def fn(*xs):
-            full = list(datas)
-            for p, x in zip(diff_pos, xs):
-                full[p] = x
-            return opdef.fcompute(attrs, *full)
-
-        outputs, vjp_fn = jax.vjp(fn, *diff_datas)
-        single = not isinstance(outputs, (tuple, list))
-        outs_t = (outputs,) if single else tuple(outputs)
-        nd_outs = [NDArray(o, ctx) for o in outs_t]
-        node = autograd._TapeNode(
-            vjp_fn=vjp_fn,
-            inputs=[nd_inputs[k] for k in diff_pos],
-            out_shapes=[(o.shape, o.dtype) for o in outs_t],
-            single=single,
-            op_name=op_name,
-            fwd_fn=fn,
-        )
-        for idx, nd in enumerate(nd_outs):
-            nd._entry = (node, idx)
-        result = nd_outs[0] if single else nd_outs
+        result = _taped_call(op_name, attrs, datas, nd_inputs, diff_pos,
+                             opdef.fcompute, ctx)
     else:
         outputs = opdef.fcompute(attrs, *datas)
         # nullary ops (init/random) materialize on the default device; honor
@@ -562,19 +550,132 @@ def invoke(op_name: str, *inputs, out=None, **kwargs):
         else:
             result = NDArray(outputs, ctx)
 
-    if out is not None:
-        if isinstance(out, NDArray) and isinstance(result, NDArray):
-            out._data = result._data
-            out._entry = result._entry
-            result = out
-        elif isinstance(out, (list, tuple)):
-            for o, r in zip(out, result):
-                o._data = r._data
-                o._entry = r._entry
-            result = out
+    result = _bind_out(out, result)
     # NaiveEngine debug mode (MXNET_ENGINE_TYPE=NaiveEngine): block until the
     # op completes so failures surface here, not at a later wait — reference
     # src/engine/naive_engine.cc:50 semantics.
+    _engine.maybe_sync_eager(result)
+    return result
+
+
+def _taped_call(op_name, attrs, datas, nd_inputs, diff_pos, compute, ctx):
+    """Shared autograd-record path for FCompute and FComputeEx dispatch:
+    jax.vjp over ``compute`` w.r.t. the inputs at ``diff_pos`` (non-diff
+    inputs — constants, sparse operands — stay closed over), tape node
+    attached to every output."""
+    from .. import autograd
+
+    diff_datas = [datas[k] for k in diff_pos]
+
+    def fn(*xs):
+        full = list(datas)
+        for p, x in zip(diff_pos, xs):
+            full[p] = x
+        return compute(attrs, *full)
+
+    outputs, vjp_fn = jax.vjp(fn, *diff_datas)
+    single = not isinstance(outputs, (tuple, list))
+    outs_t = (outputs,) if single else tuple(outputs)
+    nd_outs = [NDArray(o, ctx) for o in outs_t]
+    node = autograd._TapeNode(
+        vjp_fn=vjp_fn,
+        inputs=[nd_inputs[k] for k in diff_pos],
+        out_shapes=[(o.shape, o.dtype) for o in outs_t],
+        single=single,
+        op_name=op_name,
+        fwd_fn=fn,
+    )
+    for idx, nd in enumerate(nd_outs):
+        nd._entry = (node, idx)
+    return nd_outs[0] if single else nd_outs
+
+
+def _bind_out(out, result):
+    """Rebind ``out=`` targets to the result. Sparse storage is refused:
+    BaseSparseNDArray keeps _values/_indices/_csr_* alongside _data, and a
+    _data-only overwrite would leave those components describing the OLD
+    contents — silent corruption for the next ex-dispatched op."""
+    if out is None:
+        return result
+    from . import sparse as _sp
+
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    results = result if isinstance(result, (list, tuple)) else [result]
+    for o, r in zip(outs, results):
+        if isinstance(o, _sp.BaseSparseNDArray) \
+                or isinstance(r, _sp.BaseSparseNDArray):
+            raise MXNetError(
+                "out= is not supported for sparse storage; rebind the "
+                "result instead (sparse NDArrays are immutable views)")
+    if isinstance(out, NDArray) and isinstance(result, NDArray):
+        out._data = result._data
+        out._entry = result._entry
+        return out
+    if isinstance(out, (list, tuple)):
+        for o, r in zip(out, result):
+            o._data = r._data
+            o._entry = r._entry
+        return out
+    return result
+
+
+def _invoke_ex(opdef, attrs, inputs, out):
+    """FComputeEx eager dispatch: sparse NDArrays become SparseRep views,
+    sparse outputs come back as sparse NDArrays. Differentiable ex kernels
+    (sparse dot) are taped w.r.t. their dense inputs only — the sparse
+    operand gets grad_req=null, the reference's sparse-dot contract."""
+    from .. import autograd
+    from ..ops.sparse import SparseRep
+    from . import sparse as _sp
+
+    nd_inputs: List[Optional[NDArray]] = []
+    datas = []
+    for i in inputs:
+        if isinstance(i, _sp.RowSparseNDArray):
+            nd_inputs.append(i)
+            datas.append(SparseRep("row_sparse", i._values, i._indices,
+                                   None, i._full_shape))
+        elif isinstance(i, _sp.CSRNDArray):
+            nd_inputs.append(i)
+            datas.append(SparseRep("csr", i._csr_data, i._csr_indices,
+                                   i._csr_indptr, i._full_shape))
+        elif isinstance(i, NDArray):
+            nd_inputs.append(i)
+            datas.append(i._data)
+        elif i is None:
+            nd_inputs.append(None)
+            datas.append(None)
+        else:
+            nd_inputs.append(None)
+            datas.append(jnp.asarray(i))
+    ctx = next((nd._ctx for nd in nd_inputs if nd is not None), None) \
+        or current_context()
+
+    def wrap(o):
+        if isinstance(o, SparseRep):
+            if o.stype == "row_sparse":
+                return _sp.RowSparseNDArray(o.data, o.indices, o.shape, ctx)
+            return _sp.CSRNDArray(o.data, o.indices, o.indptr, o.shape, ctx)
+        return NDArray(o, ctx)
+
+    record = (opdef.ex_differentiable and autograd.is_recording()
+              and any(nd is not None
+                      and not isinstance(nd, _sp.BaseSparseNDArray)
+                      and nd._in_graph for nd in nd_inputs))
+    if record:
+        diff_pos = [k for k, nd in enumerate(nd_inputs)
+                    if nd is not None
+                    and not isinstance(nd, _sp.BaseSparseNDArray)]
+        result = _taped_call(opdef.name, attrs, datas, nd_inputs, diff_pos,
+                             opdef.fcompute_ex, ctx)
+    else:
+        outputs = opdef.fcompute_ex(attrs, *datas)
+        if isinstance(outputs, (tuple, list)) \
+                and not isinstance(outputs, SparseRep):
+            result = [wrap(o) for o in outputs]
+        else:
+            result = wrap(outputs)
+    result = _bind_out(out, result)
     _engine.maybe_sync_eager(result)
     return result
 

@@ -25,6 +25,7 @@ __all__ = [
     "csr_matrix",
     "cast_storage",
     "retain",
+    "dot",
 ]
 
 
@@ -68,9 +69,7 @@ class RowSparseNDArray(BaseSparseNDArray):
         raise MXNetError("cannot cast row_sparse to %r" % stype)
 
     def retain(self, row_ids) -> "RowSparseNDArray":
-        rid = row_ids._data.astype(jnp.int32) if isinstance(row_ids, NDArray) else jnp.asarray(row_ids, jnp.int32)
-        vals = jnp.take(self._data, rid, axis=0)
-        return RowSparseNDArray(vals, rid, self._full_shape, self._ctx)
+        return invoke("_sparse_retain", self, row_ids)
 
     def __repr__(self):
         return "\n<RowSparseNDArray %s @%s>" % ("x".join(map(str, self.shape)), self._ctx)
@@ -121,10 +120,9 @@ def _csr_to_dense(data, indices, indptr, shape):
     np_data = np.asarray(data)
     np_ind = np.asarray(indices).astype(np.int64)
     np_ptr = np.asarray(indptr).astype(np.int64)
+    rows = np.repeat(np.arange(shape[0]), np.diff(np_ptr))
     out = np.zeros(shape, dtype=np_data.dtype)
-    for r in range(shape[0]):
-        s, e = np_ptr[r], np_ptr[r + 1]
-        out[r, np_ind[s:e]] = np_data[s:e]
+    out[rows, np_ind] = np_data
     return jnp.asarray(out)
 
 
@@ -150,34 +148,21 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
 
 
 def cast_storage(arr: NDArray, stype: str):
-    """reference op cast_storage (src/operator/tensor/cast_storage.cc)."""
-    if stype == "default":
-        return NDArray(arr._data, arr._ctx)
-    a = arr.asnumpy()
-    if stype == "row_sparse":
-        nz_rows = np.where(np.any(a.reshape(a.shape[0], -1) != 0, axis=1))[0]
-        return RowSparseNDArray(jnp.asarray(a[nz_rows]), jnp.asarray(nz_rows.astype(np.int64)), a.shape, arr._ctx)
-    if stype == "csr":
-        if a.ndim != 2:
-            raise MXNetError("csr requires 2D")
-        data, indices, indptr = [], [], [0]
-        for r in range(a.shape[0]):
-            nz = np.nonzero(a[r])[0]
-            data.extend(a[r, nz].tolist())
-            indices.extend(nz.tolist())
-            indptr.append(len(indices))
-        return CSRNDArray(
-            jnp.asarray(np.asarray(data, dtype=a.dtype)),
-            jnp.asarray(np.asarray(indices, dtype=np.int64)),
-            jnp.asarray(np.asarray(indptr, dtype=np.int64)),
-            a.shape,
-            arr._ctx,
-        )
-    raise MXNetError("unknown stype %r" % stype)
+    """Registered op ``cast_storage`` (reference
+    src/operator/tensor/cast_storage-inl.h) — dispatches the FComputeEx
+    kernel in :mod:`mxnet_tpu.ops.sparse`."""
+    return invoke("cast_storage", arr, stype=stype)
 
 
 def retain(arr: RowSparseNDArray, row_ids):
-    return arr.retain(row_ids)
+    """Registered op ``_sparse_retain`` (reference sparse_retain-inl.h)."""
+    return invoke("_sparse_retain", arr, row_ids)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse dot (reference mx.nd.sparse.dot → dot-inl.h sparse kernels)."""
+    return invoke("dot", lhs, rhs, transpose_a=transpose_a,
+                  transpose_b=transpose_b)
 
 
 def zeros(stype, shape, ctx=None, dtype=None):

@@ -12,6 +12,7 @@ from . import detection  # noqa: F401
 from . import quantization  # noqa: F401
 from . import vision  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import sparse  # noqa: F401
 
 __all__ = ["OP_REGISTRY", "OpDef", "AttrDict", "get_op", "list_ops", "register", "REQUIRED"]
 

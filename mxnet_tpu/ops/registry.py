@@ -22,7 +22,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..base import MXNetError, parser_for
 
-__all__ = ["OpDef", "register", "get_op", "list_ops", "AttrDict", "OP_REGISTRY"]
+__all__ = ["OpDef", "register", "register_ex", "get_op", "list_ops",
+           "AttrDict", "OP_REGISTRY"]
 
 OP_REGISTRY: Dict[str, "OpDef"] = {}
 
@@ -72,6 +73,16 @@ class OpDef:
         self.aliases = tuple(aliases)
         self.doc = doc
         self._attr_cache: Dict[Any, "AttrDict"] = {}
+        # Storage-type dispatch (the reference's FComputeEx,
+        # op_attr_types.h:229): when set, invoke() routes calls with sparse
+        # NDArray inputs (or dispatch_ex_always ops) here. The ex kernel
+        # receives SparseRep views for sparse inputs and may return SparseRep
+        # outputs. ex_differentiable marks ex kernels whose outputs are dense
+        # arrays differentiable w.r.t. their dense inputs (sparse inputs get
+        # grad_req=null, matching the reference's sparse dot).
+        self.fcompute_ex: Optional[Callable] = None
+        self.dispatch_ex_always = False
+        self.ex_differentiable = False
 
     # ------------------------------------------------------------------
     def input_names(self, attrs: Optional[AttrDict] = None) -> List[str]:
@@ -195,6 +206,21 @@ def register(
         OP_REGISTRY[name] = opdef
         for a in aliases:
             OP_REGISTRY.setdefault(a, opdef)
+        return fn
+
+    return deco
+
+
+def register_ex(name: str, always: bool = False, differentiable: bool = False):
+    """Attach an FComputeEx kernel to an already-registered op (the
+    reference registers FCompute and FComputeEx as separate attributes on
+    one NNVM op, e.g. dot's DotForwardEx in dot-inl.h)."""
+
+    def deco(fn: Callable) -> Callable:
+        opdef = get_op(name)
+        opdef.fcompute_ex = fn
+        opdef.dispatch_ex_always = always
+        opdef.ex_differentiable = differentiable
         return fn
 
     return deco

@@ -1,0 +1,224 @@
+"""Sparse operator family — storage-type dispatch (FComputeEx analogue).
+
+Reference: the sparse compute kernels under ``src/operator/tensor/`` —
+``dot-inl.h`` (DotCsrDnsDnsImpl / DotCsrTransDnsImpl),
+``cast_storage-inl.h``, ``sparse_retain-inl.h``, ``square_sum-inl.h`` — and
+``_contrib_SparseEmbedding`` (indexing_op.h). XLA has no sparse storage
+(SURVEY §7.3), so the TPU-idiomatic lowering is index arithmetic +
+``segment_sum`` over the nnz vector: static shapes (nnz is fixed per
+concrete input), MXU-friendly broadcasting, and no host loops.
+
+Dispatch: :func:`mxnet_tpu.ndarray.ndarray.invoke` routes a call here when
+any input is a :class:`BaseSparseNDArray` (or the op sets
+``dispatch_ex_always``, e.g. ``cast_storage`` whose *output* storage is the
+sparse one). Sparse inputs arrive as :class:`SparseRep` views; dense inputs
+as jax arrays. Gradients: ex kernels marked ``differentiable`` are
+jax.vjp'd w.r.t. their **dense** inputs only — the sparse argument gets
+``grad_req=null`` exactly as the reference's sparse dot does.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import REQUIRED, register, register_ex
+
+__all__ = ["SparseRep", "csr_row_ids"]
+
+
+class SparseRep(NamedTuple):
+    """Functional view of a sparse NDArray's components (jax arrays)."""
+
+    stype: str                 # "csr" | "row_sparse"
+    data: Any                  # csr: (nnz,) values; rsp: (nnz_rows, *row)
+    indices: Any               # csr: (nnz,) col ids; rsp: (nnz_rows,) row ids
+    indptr: Optional[Any]      # csr only: (rows+1,) offsets
+    shape: Tuple[int, ...]     # logical dense shape
+
+
+def csr_row_ids(rep: SparseRep):
+    """Expand csr indptr to one row id per nnz element.
+
+    ``searchsorted`` over the static-length indptr keeps the whole op inside
+    XLA (vs the reference's per-row OMP loop, dot-inl.h DotCsrDnsDnsByRow).
+    """
+    nnz = rep.data.shape[0]
+    return jnp.searchsorted(rep.indptr[1:], jnp.arange(nnz), side="right")
+
+
+def _seg_sum(vals, ids, num):
+    return jax.ops.segment_sum(vals, ids.astype(jnp.int32), num_segments=num)
+
+
+# ---------------------------------------------------------------------------
+# dot(csr, dense) / dot(csr.T, dense)  — reference dot-inl.h
+# ---------------------------------------------------------------------------
+
+
+@register_ex("dot", differentiable=True)
+def _dot_ex(attrs, lhs, rhs):
+    """Sparse matrix × dense matrix.
+
+    Supported storage combinations (the ones the reference's sparse-FM and
+    embedding workloads use): lhs=csr rhs=dense, with either transpose_a.
+    Each nnz element (r, c, v) contributes v·rhs[c] to out[r] (plain) or
+    v·rhs[r] to out[c] (transposed) — one gather + one segment_sum.
+    """
+    if not isinstance(lhs, SparseRep) or isinstance(rhs, SparseRep):
+        raise MXNetError(
+            "sparse dot supports dot(csr, dense); got lhs=%s rhs=%s"
+            % (getattr(lhs, "stype", "default"), getattr(rhs, "stype", "default")))
+    if lhs.stype != "csr":
+        raise MXNetError("sparse dot lhs must be csr, got %s" % lhs.stype)
+    if attrs.transpose_b and rhs.ndim > 1:
+        # (vector rhs: transpose is a no-op, numpy-style)
+        rhs = jnp.swapaxes(rhs, 0, 1)
+    rows = csr_row_ids(lhs)
+    cols = lhs.indices.astype(jnp.int32)
+    vec = rhs.ndim == 1
+    v = lhs.data if vec else lhs.data[:, None]
+    if attrs.transpose_a:
+        gathered = jnp.take(rhs, rows, axis=0)
+        out = _seg_sum(v * gathered, cols, lhs.shape[1])
+    else:
+        gathered = jnp.take(rhs, cols, axis=0)
+        out = _seg_sum(v * gathered, rows, lhs.shape[0])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cast_storage — reference cast_storage-inl.h
+# ---------------------------------------------------------------------------
+
+
+@register("cast_storage", params={"stype": (str, REQUIRED)},
+          inputs=("data",))
+def _cast_storage_dense(attrs, x):
+    # dense→dense identity; sparse targets go through the ex kernel
+    if attrs.stype != "default":
+        raise MXNetError("cast_storage to %r dispatches FComputeEx"
+                         % attrs.stype)
+    return x
+
+
+@register_ex("cast_storage", always=True)
+def _cast_storage_ex(attrs, x):
+    stype = attrs.stype
+    if isinstance(x, SparseRep):
+        if stype == x.stype:
+            return x
+        x = _densify(x)          # sparse→sparse goes through dense
+    if stype == "default":
+        return x
+    # dense→sparse has a data-dependent nnz: eager-only, computed on host
+    # (the reference's CastStorageDnsRspImpl is likewise a non-jittable
+    # kernel — it allocates by counted nnz)
+    a = np.asarray(x)
+    if stype == "row_sparse":
+        nz = np.where(np.any(a.reshape(a.shape[0], -1) != 0, axis=1))[0]
+        return SparseRep("row_sparse", jnp.asarray(a[nz]),
+                         jnp.asarray(nz.astype(np.int64)), None, a.shape)
+    if stype == "csr":
+        if a.ndim != 2:
+            raise MXNetError("cast_storage to csr requires 2-D input")
+        r, c = np.nonzero(a)
+        indptr = np.zeros(a.shape[0] + 1, np.int64)
+        np.add.at(indptr, r + 1, 1)
+        indptr = np.cumsum(indptr)
+        return SparseRep("csr", jnp.asarray(a[r, c]),
+                         jnp.asarray(c.astype(np.int64)),
+                         jnp.asarray(indptr), a.shape)
+    raise MXNetError("cast_storage: unknown stype %r" % stype)
+
+
+def _densify(rep: SparseRep):
+    if rep.stype == "row_sparse":
+        return (jnp.zeros(rep.shape, rep.data.dtype)
+                .at[rep.indices.astype(jnp.int32)].set(rep.data))
+    rows = csr_row_ids(rep)
+    return (jnp.zeros(rep.shape, rep.data.dtype)
+            .at[rows, rep.indices.astype(jnp.int32)].set(rep.data))
+
+
+# ---------------------------------------------------------------------------
+# _sparse_retain — reference sparse_retain-inl.h
+# ---------------------------------------------------------------------------
+
+
+@register("_sparse_retain", inputs=("data", "indices"))
+def _sparse_retain_dense(attrs, data, indices):
+    raise MXNetError("_sparse_retain requires a row_sparse input")
+
+
+@register_ex("_sparse_retain")
+def _sparse_retain_ex(attrs, data, indices):
+    """Keep only the requested rows of a row_sparse array. Rows asked for
+    but absent from ``data`` come back zero (reference SparseRetainOpForwardRspImpl).
+    """
+    if not isinstance(data, SparseRep) or data.stype != "row_sparse":
+        raise MXNetError("_sparse_retain data must be row_sparse")
+    ids = (indices.data if isinstance(indices, SparseRep) else indices)
+    ids = jnp.sort(ids.astype(jnp.int64))
+    # binary-search each requested id among the stored rows; miss → zero row
+    pos = jnp.searchsorted(data.indices.astype(jnp.int64), ids)
+    pos = jnp.clip(pos, 0, data.indices.shape[0] - 1)
+    hit = jnp.take(data.indices.astype(jnp.int64), pos) == ids
+    vals = jnp.take(data.data, pos.astype(jnp.int32), axis=0)
+    mask = hit.reshape((-1,) + (1,) * (vals.ndim - 1))
+    return SparseRep("row_sparse", jnp.where(mask, vals, 0), ids, None,
+                     data.shape)
+
+
+# ---------------------------------------------------------------------------
+# _square_sum (rsp path) — reference square_sum-inl.h
+# ---------------------------------------------------------------------------
+
+
+@register_ex("_square_sum")
+def _square_sum_ex(attrs, x):
+    """sum(x^2) over a row_sparse input without densifying. axis=1 with
+    keepdims returns a row_sparse result sharing the input's row indices —
+    the layout the reference's lazy AdaGrad consumes."""
+    if not isinstance(x, SparseRep) or x.stype != "row_sparse":
+        raise MXNetError("_square_sum ex kernel expects row_sparse input")
+    axes = attrs.axis
+    if isinstance(axes, tuple) and len(axes) == 1:
+        axes = axes[0]
+    sq = jnp.square(x.data)
+    if axes is None or axes == ():
+        return jnp.sum(sq)  # full reduction
+    if axes == 1 and x.data.ndim == 2:
+        vals = jnp.sum(sq, axis=1, keepdims=attrs.keepdims)
+        if attrs.keepdims:
+            return SparseRep("row_sparse", vals, x.indices, None,
+                             (x.shape[0], 1))
+        return _seg_sum(vals, x.indices, x.shape[0])
+    if axes == 0:
+        # absent rows are zero, so summing the stored rows IS the column sum
+        return jnp.sum(sq, axis=0, keepdims=attrs.keepdims)
+    raise MXNetError(
+        "_square_sum on row_sparse supports axis=None/0/1 with 2-D values; "
+        "got axis=%r for values of rank %d (cast_storage to default for "
+        "general reductions)" % (attrs.axis, x.data.ndim))
+
+
+# ---------------------------------------------------------------------------
+# _contrib_SparseEmbedding — reference indexing_op.h SparseEmbedding
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_SparseEmbedding",
+          params={"input_dim": (int, REQUIRED),
+                  "output_dim": (int, REQUIRED),
+                  "dtype": ("dtype", None)},
+          inputs=("data", "weight"))
+def _sparse_embedding(attrs, data, weight):
+    """Embedding lookup whose weight gradient is row-sparse by construction
+    (only looked-up rows receive non-zero grad — the optimizer's lazy
+    row_sparse update path skips the rest; reference _contrib_SparseEmbedding
+    + sparse sgd/adagrad kernels)."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)

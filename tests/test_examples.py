@@ -66,3 +66,9 @@ def test_bucketing_lstm_example():
 def test_dcgan_example():
     out = _run("gluon/dcgan.py", "--epochs", "1", "--num-samples", "96")
     assert "adversarial mechanics OK" in out
+
+
+def test_sparse_fm_example():
+    out = _run("sparse/fm.py", "--epochs", "12", "--num-samples", "192",
+               "--feature-dim", "300", "--optimizer", "adagrad")
+    assert "IMPROVED" in out
