@@ -1,125 +1,131 @@
-"""Training callbacks (reference ``python/mxnet/callback.py``).
+"""Training callbacks — API parity with reference ``python/mxnet/callback.py``
+(Speedometer :120, do_checkpoint :55, module_checkpoint :27, ProgressBar
+:180), re-implemented for this runtime.
 
-Epoch-end callbacks receive ``(epoch, symbol, arg_params, aux_params)``;
-batch-end callbacks receive a ``BatchEndParam``-style namedtuple with
-``epoch, nbatch, eval_metric, locals`` (reference callback.py:120
-Speedometer, :55 do_checkpoint, :27 module_checkpoint, :180 ProgressBar).
+Contracts: epoch-end callbacks are called as ``cb(epoch, symbol, arg_params,
+aux_params)``; batch-end callbacks receive a ``BatchEndParam``-style object
+with ``epoch``, ``nbatch``, ``eval_metric`` and ``locals`` attributes.
 """
 from __future__ import annotations
 
 import logging
-import math
 import time
 
 __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
            "Speedometer", "ProgressBar", "LogValidationMetricsCallback"]
 
 
+def _every(period):
+    """Normalized positive period for the *-checkpoint factories."""
+    return max(1, int(period))
+
+
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
     """Epoch-end callback checkpointing a Module (reference callback.py:27)."""
-    period = int(max(1, period))
+    n = _every(period)
 
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+    def _cb(epoch, sym=None, arg=None, aux=None):
+        done = epoch + 1
+        if done % n == 0:
+            mod.save_checkpoint(prefix, done, save_optimizer_states)
 
-    return _callback
+    return _cb
 
 
 def do_checkpoint(prefix, period=1):
-    """Epoch-end callback saving prefix-symbol.json + prefix-NNNN.params
-    every ``period`` epochs (reference callback.py:55)."""
+    """Epoch-end callback writing ``prefix-symbol.json`` +
+    ``prefix-NNNN.params`` (reference callback.py:55)."""
     from . import model
 
-    period = int(max(1, period))
+    n = _every(period)
 
-    def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            model.save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+    def _cb(epoch, sym, arg, aux):
+        done = epoch + 1
+        if done % n == 0:
+            model.save_checkpoint(prefix, done, sym, arg, aux)
 
-    return _callback
+    return _cb
 
 
 def log_train_metric(period, auto_reset=False):
     """Batch-end callback logging the training metric every ``period``
     batches (reference callback.py:93)."""
 
-    def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
+    def _cb(param):
+        metric = param.eval_metric
+        if metric is None or param.nbatch % period != 0:
+            return
+        for name, value in metric.get_name_value():
+            logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                         param.epoch, param.nbatch, name, value)
+        if auto_reset:
+            metric.reset()
 
-    return _callback
+    return _cb
 
 
-class Speedometer(object):
-    """Batch-end callback logging samples/sec and metrics every ``frequent``
-    batches (reference callback.py:120)."""
+class Speedometer:
+    """Logs samples/sec (and the running metric) every ``frequent`` batches
+    (reference callback.py:120).
+
+    Internal state is a single ``(batch_count, timestamp)`` mark taken at the
+    previous report; throughput = batches-since-mark × batch_size / elapsed,
+    on a monotonic clock so wall-clock adjustments can't produce negative
+    speeds. A batch counter that goes backwards (new epoch) re-arms the mark.
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
         self.auto_reset = auto_reset
+        self._mark = None  # (nbatch, monotonic time) of the last report
 
     def __call__(self, param):
         count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-
-        if self.init:
-            if count % self.frequent == 0:
-                try:
-                    speed = self.frequent * self.batch_size / (
-                        time.time() - self.tic)
-                except ZeroDivisionError:
-                    speed = float("inf")
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info(
-                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                        param.epoch, count, speed)
-                self.tic = time.time()
+        if self._mark is None or count < self._mark[0]:
+            self._mark = (count, time.monotonic())
+            return
+        if count % self.frequent != 0 or count == self._mark[0]:
+            return
+        elapsed = time.monotonic() - self._mark[1]
+        done = (count - self._mark[0]) * self.batch_size
+        speed = done / elapsed if elapsed > 0 else float("inf")
+        metric = param.eval_metric
+        if metric is not None:
+            pairs = metric.get_name_value()
+            if self.auto_reset:
+                metric.reset()
+            tail = "".join("\t%s=%f" % nv for nv in pairs)
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                         param.epoch, count, speed, tail)
         else:
-            self.init = True
-            self.tic = time.time()
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, count, speed)
+        self._mark = (count, time.monotonic())
 
 
-class ProgressBar(object):
-    """Batch-end progress bar (reference callback.py:180)."""
+class ProgressBar:
+    """Text progress bar over ``total`` batches (reference callback.py:180)."""
 
     def __init__(self, total, length=80):
-        self.bar_len = length
         self.total = total
+        self.length = length
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+        frac = min(1.0, param.nbatch / float(self.total))
+        fill = int(self.length * frac + 0.5)
+        bar = "=" * fill + "-" * (self.length - fill)
+        logging.info("[%s] %d%%\r", bar, int(frac * 100 + 0.999))
 
 
-class LogValidationMetricsCallback(object):
+class LogValidationMetricsCallback:
     """Eval-end callback logging validation metrics (reference
     callback.py:210)."""
 
     def __call__(self, param):
-        if param.eval_metric is None:
+        metric = param.eval_metric
+        if metric is None:
             return
-        for name, value in param.eval_metric.get_name_value():
-            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name, value)
+        for name, value in metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f",
+                         param.epoch, name, value)

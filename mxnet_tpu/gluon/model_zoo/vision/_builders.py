@@ -1,0 +1,24 @@
+"""Shared construction helpers for the vision model zoo."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["seq", "named_factory"]
+
+
+def seq(*layers, prefix=""):
+    """HybridSequential from a flat layer list."""
+    s = nn.HybridSequential(prefix=prefix)
+    for l in layers:
+        s.add(l)
+    return s
+
+
+def named_factory(builder, name, doc, *bound_args):
+    """A zero-config model constructor (``resnet50_v1()``-style) delegating
+    to ``builder(*bound_args, **kwargs)``."""
+    def make(**kwargs):
+        return builder(*bound_args, **kwargs)
+    make.__name__ = name
+    make.__doc__ = doc
+    return make

@@ -1,49 +1,56 @@
-"""DenseNet (reference ``python/mxnet/gluon/model_zoo/vision/densenet.py``)."""
+"""DenseNet-BC — API parity with reference
+``python/mxnet/gluon/model_zoo/vision/densenet.py``, built fresh for this
+runtime with helper-driven construction (one ``_bn_relu_conv`` primitive
+composes dense layers, transitions, and the stem tail alike).
+"""
 from __future__ import annotations
 
 from ....base import MXNetError
-from ...block import HybridBlock
 from ... import nn
+from ...block import HybridBlock
+from ._builders import named_factory, seq as _pipeline
 
-__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169", "densenet201"]
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201"]
+
+
+def _bn_relu_conv(channels, kernel, pad=0):
+    """The pre-activation composite function H(.) of the paper."""
+    return [nn.BatchNorm(), nn.Activation("relu"),
+            nn.Conv2D(channels, kernel_size=kernel, padding=pad,
+                      use_bias=False)]
 
 
 class _DenseLayer(HybridBlock):
+    """bottleneck H(.): BN-relu-1x1 → BN-relu-3x3, output concatenated onto
+    the running feature map (reference densenet.py:_make_dense_layer)."""
+
     def __init__(self, growth_rate, bn_size, dropout, **kwargs):
         super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(bn_size * growth_rate, kernel_size=1, use_bias=False))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(growth_rate, kernel_size=3, padding=1, use_bias=False))
-        self._dropout = dropout
-        if dropout:
-            self.dropout = nn.Dropout(dropout)
+        stack = _bn_relu_conv(bn_size * growth_rate, 1) \
+            + _bn_relu_conv(growth_rate, 3, pad=1)
+        self.body = _pipeline(*stack)
+        self.dropout = nn.Dropout(dropout) if dropout else None
 
     def hybrid_forward(self, F, x):
-        out = self.body(x)
-        if self._dropout:
-            out = self.dropout(out)
-        return F.concat(x, out, dim=1)
+        grown = self.body(x)
+        if self.dropout is not None:
+            grown = self.dropout(grown)
+        return F.concat(x, grown, dim=1)
 
 
-def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):
-    out = nn.HybridSequential(prefix="stage%d_" % stage_index)
-    with out.name_scope():
+def _dense_stage(num_layers, bn_size, growth_rate, dropout, index):
+    stage = nn.HybridSequential(prefix="stage%d_" % index)
+    with stage.name_scope():
         for _ in range(num_layers):
-            out.add(_DenseLayer(growth_rate, bn_size, dropout))
-    return out
+            stage.add(_DenseLayer(growth_rate, bn_size, dropout))
+    return stage
 
 
-def _make_transition(num_output_features):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.BatchNorm())
-    out.add(nn.Activation("relu"))
-    out.add(nn.Conv2D(num_output_features, kernel_size=1, use_bias=False))
-    out.add(nn.AvgPool2D(pool_size=2, strides=2))
-    return out
+def _transition(channels):
+    """Compress + downsample between dense stages."""
+    return _pipeline(*_bn_relu_conv(channels, 1),
+                     nn.AvgPool2D(pool_size=2, strides=2))
 
 
 class DenseNet(HybridBlock):
@@ -53,32 +60,30 @@ class DenseNet(HybridBlock):
                  bn_size=4, dropout=0, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.Conv2D(num_init_features, kernel_size=7,
-                                        strides=2, padding=3, use_bias=False))
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
-            num_features = num_init_features
+            self.features = _pipeline(
+                nn.Conv2D(num_init_features, kernel_size=7, strides=2,
+                          padding=3, use_bias=False),
+                nn.BatchNorm(), nn.Activation("relu"),
+                nn.MaxPool2D(pool_size=3, strides=2, padding=1))
+            width = num_init_features
+            last = len(block_config) - 1
             for i, num_layers in enumerate(block_config):
-                self.features.add(_make_dense_block(
-                    num_layers, bn_size, growth_rate, dropout, i + 1))
-                num_features = num_features + num_layers * growth_rate
-                if i != len(block_config) - 1:
-                    self.features.add(_make_transition(num_features // 2))
-                    num_features = num_features // 2
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.AvgPool2D(pool_size=7))
-            self.features.add(nn.Flatten())
+                self.features.add(_dense_stage(num_layers, bn_size,
+                                               growth_rate, dropout, i + 1))
+                width += num_layers * growth_rate
+                if i != last:
+                    width //= 2
+                    self.features.add(_transition(width))
+            for tail in (nn.BatchNorm(), nn.Activation("relu"),
+                         nn.AvgPool2D(pool_size=7), nn.Flatten()):
+                self.features.add(tail)
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
+# depth → (stem channels, growth rate, layers per stage)
 densenet_spec = {
     121: (64, 32, [6, 12, 24, 16]),
     161: (96, 48, [6, 12, 36, 24]),
@@ -88,26 +93,24 @@ densenet_spec = {
 
 
 def get_densenet(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
-    num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    if num_layers not in densenet_spec:
+        raise MXNetError("Invalid DenseNet depth %d; options: %s"
+                         % (num_layers, sorted(densenet_spec)))
+    stem, growth, config = densenet_spec[num_layers]
     if pretrained:
         raise MXNetError(
             "pretrained weights require network access; load local .params "
             "with net.load_parameters instead")
-    return net
+    return DenseNet(stem, growth, config, **kwargs)
 
 
-def densenet121(**kwargs):
-    return get_densenet(121, **kwargs)
+def _factory(depth):
+    return named_factory(get_densenet, "densenet%d" % depth,
+                         "DenseNet-%d (reference densenet.py)." % depth,
+                         depth)
 
 
-def densenet161(**kwargs):
-    return get_densenet(161, **kwargs)
-
-
-def densenet169(**kwargs):
-    return get_densenet(169, **kwargs)
-
-
-def densenet201(**kwargs):
-    return get_densenet(201, **kwargs)
+densenet121 = _factory(121)
+densenet161 = _factory(161)
+densenet169 = _factory(169)
+densenet201 = _factory(201)

@@ -1,111 +1,134 @@
-"""Inception V3 (reference ``python/mxnet/gluon/model_zoo/vision/inception.py``)."""
+"""Inception V3 — API parity with reference
+``python/mxnet/gluon/model_zoo/vision/inception.py``, built fresh for this
+runtime: every mixed block is a table of branches, each branch a list of
+conv specs written as ``(channels, kernel, stride, padding)`` with an
+optional leading pool token ("avg"/"max"); one builder expands the tables.
+"""
 from __future__ import annotations
 
 from ....base import MXNetError
-from ...block import HybridBlock
 from ... import nn
+from ...block import HybridBlock
 from ...contrib.nn import HybridConcurrent
 
 __all__ = ["Inception3", "inception_v3"]
 
 
-def _make_basic_conv(**kwargs):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(use_bias=False, **kwargs))
-    out.add(nn.BatchNorm(epsilon=0.001))
-    out.add(nn.Activation("relu"))
-    return out
+def _cbr(channels, kernel, stride=1, padding=0):
+    """conv(no bias) → BN(eps=1e-3) → relu, the Inception basic conv."""
+    unit = nn.HybridSequential(prefix="")
+    unit.add(nn.Conv2D(channels, kernel_size=kernel, strides=stride,
+                       padding=padding, use_bias=False))
+    unit.add(nn.BatchNorm(epsilon=0.001))
+    unit.add(nn.Activation("relu"))
+    return unit
 
 
-def _make_branch(use_pool, *conv_settings):
-    out = nn.HybridSequential(prefix="")
-    if use_pool == "avg":
-        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
-    elif use_pool == "max":
-        out.add(nn.MaxPool2D(pool_size=3, strides=2))
-    setting_names = ["channels", "kernel_size", "strides", "padding"]
-    for setting in conv_settings:
-        kwargs = {}
-        for i, value in enumerate(setting):
-            if value is not None:
-                kwargs[setting_names[i]] = value
-        out.add(_make_basic_conv(**kwargs))
-    return out
+def _branch(*steps):
+    """A branch: optional leading "avg"/"max" pool token, then conv specs
+    (channels, kernel[, stride[, padding]])."""
+    seq = nn.HybridSequential(prefix="")
+    for step in steps:
+        if step == "avg":
+            seq.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+        elif step == "max":
+            seq.add(nn.MaxPool2D(pool_size=3, strides=2))
+        else:
+            seq.add(_cbr(*step))
+    return seq
 
 
-def _make_A(pool_features, prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (64, 1, None, None)))
-        out.add(_make_branch(None, (48, 1, None, None), (64, 5, None, 2)))
-        out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
-                             (96, 3, None, 1)))
-        out.add(_make_branch("avg", (pool_features, 1, None, None)))
-    return out
+def _mixed(prefix, *branch_makers):
+    """Concatenate branches along channels. Takes zero-arg builders, NOT
+    built blocks: children must be constructed inside the block's
+    name_scope or the A1_/B_/… prefixes never reach the parameter names."""
+    block = HybridConcurrent(axis=1, prefix=prefix)
+    with block.name_scope():
+        for make in branch_makers:
+            block.add(make())
+    return block
 
 
-def _make_B(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (384, 3, 2, None)))
-        out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
-                             (96, 3, 2, None)))
-        out.add(_make_branch("max"))
-    return out
+def _block_a(pool_features, prefix):
+    return _mixed(
+        prefix,
+        lambda: _branch((64, 1)),
+        lambda: _branch((48, 1), (64, 5, 1, 2)),
+        lambda: _branch((64, 1), (96, 3, 1, 1), (96, 3, 1, 1)),
+        lambda: _branch("avg", (pool_features, 1)))
 
 
-def _make_C(channels_7x7, prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None)))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0))))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (192, (1, 7), None, (0, 3))))
-        out.add(_make_branch("avg", (192, 1, None, None)))
-    return out
+def _block_b(prefix):
+    return _mixed(
+        prefix,
+        lambda: _branch((384, 3, 2)),
+        lambda: _branch((64, 1), (96, 3, 1, 1), (96, 3, 2)),
+        lambda: _branch("max"))
 
 
-def _make_D(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None), (320, 3, 2, None)))
-        out.add(_make_branch(None, (192, 1, None, None),
-                             (192, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0)),
-                             (192, 3, 2, None)))
-        out.add(_make_branch("max"))
-    return out
+def _block_c(c7, prefix):
+    return _mixed(
+        prefix,
+        lambda: _branch((192, 1)),
+        lambda: _branch((c7, 1), (c7, (1, 7), 1, (0, 3)),
+                        (192, (7, 1), 1, (3, 0))),
+        lambda: _branch((c7, 1), (c7, (7, 1), 1, (3, 0)),
+                        (c7, (1, 7), 1, (0, 3)), (c7, (7, 1), 1, (3, 0)),
+                        (192, (1, 7), 1, (0, 3))),
+        lambda: _branch("avg", (192, 1)))
 
 
-def _make_E(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (320, 1, None, None)))
+def _block_d(prefix):
+    return _mixed(
+        prefix,
+        lambda: _branch((192, 1), (320, 3, 2)),
+        lambda: _branch((192, 1), (192, (1, 7), 1, (0, 3)),
+                        (192, (7, 1), 1, (3, 0)), (192, 3, 2)),
+        lambda: _branch("max"))
 
-        branch_3x3 = nn.HybridSequential(prefix="")
-        out.add(branch_3x3)
-        branch_3x3.add(_make_branch(None, (384, 1, None, None)))
-        branch_3x3_split = HybridConcurrent(axis=1, prefix="")
-        branch_3x3_split.add(_make_branch(None, (384, (1, 3), None, (0, 1))))
-        branch_3x3_split.add(_make_branch(None, (384, (3, 1), None, (1, 0))))
-        branch_3x3.add(branch_3x3_split)
 
-        branch_3x3dbl = nn.HybridSequential(prefix="")
-        out.add(branch_3x3dbl)
-        branch_3x3dbl.add(_make_branch(None, (448, 1, None, None),
-                                       (384, 3, None, 1)))
-        branch_3x3dbl_split = HybridConcurrent(axis=1, prefix="")
-        branch_3x3dbl.add(branch_3x3dbl_split)
-        branch_3x3dbl_split.add(_make_branch(None, (384, (1, 3), None, (0, 1))))
-        branch_3x3dbl_split.add(_make_branch(None, (384, (3, 1), None, (1, 0))))
+def _fork(stem_steps):
+    """An E-block branch: a stem then a 1x3/3x1 split concatenated."""
+    seq = nn.HybridSequential(prefix="")
+    seq.add(_branch(*stem_steps))
+    split = HybridConcurrent(axis=1, prefix="")
+    split.add(_branch((384, (1, 3), 1, (0, 1))))
+    split.add(_branch((384, (3, 1), 1, (1, 0))))
+    seq.add(split)
+    return seq
 
-        out.add(_make_branch("avg", (192, 1, None, None)))
-    return out
+
+def _block_e(prefix):
+    return _mixed(
+        prefix,
+        lambda: _branch((320, 1)),
+        lambda: _fork([(384, 1)]),
+        lambda: _fork([(448, 1), (384, 3, 1, 1)]),
+        lambda: _branch("avg", (192, 1)))
+
+
+# the 299x299 feature pipeline, stem through mixed blocks
+def _feature_layers():
+    yield _cbr(32, 3, 2)
+    yield _cbr(32, 3)
+    yield _cbr(64, 3, 1, 1)
+    yield nn.MaxPool2D(pool_size=3, strides=2)
+    yield _cbr(80, 1)
+    yield _cbr(192, 3)
+    yield nn.MaxPool2D(pool_size=3, strides=2)
+    yield _block_a(32, "A1_")
+    yield _block_a(64, "A2_")
+    yield _block_a(64, "A3_")
+    yield _block_b("B_")
+    yield _block_c(128, "C1_")
+    yield _block_c(160, "C2_")
+    yield _block_c(160, "C3_")
+    yield _block_c(192, "C4_")
+    yield _block_d("D_")
+    yield _block_e("E1_")
+    yield _block_e("E2_")
+    yield nn.AvgPool2D(pool_size=8)
+    yield nn.Dropout(0.5)
 
 
 class Inception3(HybridBlock):
@@ -115,38 +138,17 @@ class Inception3(HybridBlock):
         super().__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3))
-            self.features.add(_make_basic_conv(channels=64, kernel_size=3, padding=1))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=80, kernel_size=1))
-            self.features.add(_make_basic_conv(channels=192, kernel_size=3))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_A(32, "A1_"))
-            self.features.add(_make_A(64, "A2_"))
-            self.features.add(_make_A(64, "A3_"))
-            self.features.add(_make_B("B_"))
-            self.features.add(_make_C(128, "C1_"))
-            self.features.add(_make_C(160, "C2_"))
-            self.features.add(_make_C(160, "C3_"))
-            self.features.add(_make_C(192, "C4_"))
-            self.features.add(_make_D("D_"))
-            self.features.add(_make_E("E1_"))
-            self.features.add(_make_E("E2_"))
-            self.features.add(nn.AvgPool2D(pool_size=8))
-            self.features.add(nn.Dropout(0.5))
+            for layer in _feature_layers():
+                self.features.add(layer)
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
-    net = Inception3(**kwargs)
     if pretrained:
         raise MXNetError(
             "pretrained weights require network access; load local .params "
             "with net.load_parameters instead")
-    return net
+    return Inception3(**kwargs)

@@ -1,62 +1,95 @@
-"""MobileNet V1/V2 (reference ``python/mxnet/gluon/model_zoo/vision/mobilenet.py``).
+"""MobileNet V1/V2 — API parity with reference
+``python/mxnet/gluon/model_zoo/vision/mobilenet.py``, built fresh for this
+runtime.
 
-Depthwise convs map to lax grouped convolution (feature_group_count=channels),
-which XLA lowers efficiently on the TPU vector unit.
+Depthwise convs map to lax grouped convolution
+(feature_group_count=channels), which XLA lowers efficiently on the TPU
+vector unit. Both nets are described as flat layer tables — (dw-channels,
+out-channels, stride) rows for V1, (in, out, expansion, stride) rows for
+V2 — expanded by one conv-unit builder.
 """
 from __future__ import annotations
 
 from ....base import MXNetError
-from ...block import HybridBlock
 from ... import nn
+from ...block import HybridBlock
+from ._builders import named_factory
 
 __all__ = [
     "MobileNet", "MobileNetV2",
     "mobilenet1_0", "mobilenet0_75", "mobilenet0_5", "mobilenet0_25",
-    "mobilenet_v2_1_0", "mobilenet_v2_0_75", "mobilenet_v2_0_5", "mobilenet_v2_0_25",
+    "mobilenet_v2_1_0", "mobilenet_v2_0_75", "mobilenet_v2_0_5",
+    "mobilenet_v2_0_25",
     "get_mobilenet", "get_mobilenet_v2",
+]
+
+# V1 separable stack: (depthwise width, pointwise width, stride) per row,
+# before the width multiplier is applied
+_V1_ROWS = [
+    (32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2), (256, 256, 1),
+    (256, 512, 2), (512, 512, 1), (512, 512, 1), (512, 512, 1),
+    (512, 512, 1), (512, 512, 1), (512, 1024, 2), (1024, 1024, 1),
+]
+
+# V2 inverted-residual stack: (in width, out width, expansion t, stride)
+_V2_ROWS = [
+    (32, 16, 1, 1),
+    (16, 24, 6, 2), (24, 24, 6, 1),
+    (24, 32, 6, 2), (32, 32, 6, 1), (32, 32, 6, 1),
+    (32, 64, 6, 2), (64, 64, 6, 1), (64, 64, 6, 1), (64, 64, 6, 1),
+    (64, 96, 6, 1), (96, 96, 6, 1), (96, 96, 6, 1),
+    (96, 160, 6, 2), (160, 160, 6, 1), (160, 160, 6, 1),
+    (160, 320, 6, 1),
 ]
 
 
 class RELU6(HybridBlock):
-    """ReLU6 (reference mobilenet.py:RELU6)."""
+    """min(max(x, 0), 6) (reference mobilenet.py:RELU6)."""
 
     def hybrid_forward(self, F, x):
         return F.clip(x, 0, 6)
 
 
-def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1,
-              active=True, relu6=False):
-    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group,
+def _unit(out, channels, kernel=1, stride=1, pad=0, groups=1, act="relu"):
+    """conv → BN → activation; act is "relu", "relu6" or None."""
+    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=groups,
                       use_bias=False))
     out.add(nn.BatchNorm(scale=True))
-    if active:
-        out.add(RELU6() if relu6 else nn.Activation("relu"))
+    if act == "relu6":
+        out.add(RELU6())
+    elif act:
+        out.add(nn.Activation(act))
 
 
-def _add_conv_dw(out, dw_channels, channels, stride, relu6=False):
-    _add_conv(out, channels=dw_channels, kernel=3, stride=stride, pad=1,
-              num_group=dw_channels, relu6=relu6)
-    _add_conv(out, channels=channels, relu6=relu6)
+def _separable(out, dw, pw, stride, act="relu"):
+    """Depthwise 3x3 then pointwise 1x1 — one MobileNet V1 unit."""
+    _unit(out, dw, kernel=3, stride=stride, pad=1, groups=dw, act=act)
+    _unit(out, pw, act=act)
 
 
 class LinearBottleneck(HybridBlock):
-    """MobileNetV2 inverted residual (reference mobilenet.py:LinearBottleneck)."""
+    """V2 inverted residual: expand 1x1 → depthwise 3x3 → project 1x1 (no
+    activation on the projection) with identity shortcut when shapes allow
+    (reference mobilenet.py:LinearBottleneck)."""
 
     def __init__(self, in_channels, channels, t, stride, **kwargs):
         super().__init__(**kwargs)
         self.use_shortcut = stride == 1 and in_channels == channels
+        wide = in_channels * t
         with self.name_scope():
             self.out = nn.HybridSequential()
-            _add_conv(self.out, in_channels * t, relu6=True)
-            _add_conv(self.out, in_channels * t, kernel=3, stride=stride,
-                      pad=1, num_group=in_channels * t, relu6=True)
-            _add_conv(self.out, channels, active=False, relu6=True)
+            _unit(self.out, wide, act="relu6")
+            _unit(self.out, wide, kernel=3, stride=stride, pad=1,
+                  groups=wide, act="relu6")
+            _unit(self.out, channels, act=None)
 
     def hybrid_forward(self, F, x):
-        out = self.out(x)
-        if self.use_shortcut:
-            out = out + x
-        return out
+        y = self.out(x)
+        return y + x if self.use_shortcut else y
+
+
+def _scaled(width, multiplier):
+    return int(width * multiplier)
 
 
 class MobileNet(HybridBlock):
@@ -67,23 +100,17 @@ class MobileNet(HybridBlock):
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             with self.features.name_scope():
-                _add_conv(self.features, channels=int(32 * multiplier),
-                          kernel=3, pad=1, stride=2)
-                dw_channels = [int(x * multiplier) for x in
-                               [32, 64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024]]
-                channels = [int(x * multiplier) for x in
-                            [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2]
-                strides = [1, 2, 1, 2, 1, 2] + [1] * 5 + [2, 1]
-                for dwc, c, s in zip(dw_channels, channels, strides):
-                    _add_conv_dw(self.features, dw_channels=dwc, channels=c, stride=s)
+                _unit(self.features, _scaled(32, multiplier), kernel=3,
+                      stride=2, pad=1)
+                for dw, pw, stride in _V1_ROWS:
+                    _separable(self.features, _scaled(dw, multiplier),
+                               _scaled(pw, multiplier), stride)
                 self.features.add(nn.GlobalAvgPool2D())
                 self.features.add(nn.Flatten())
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 class MobileNetV2(HybridBlock):
@@ -94,21 +121,15 @@ class MobileNetV2(HybridBlock):
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="features_")
             with self.features.name_scope():
-                _add_conv(self.features, int(32 * multiplier), kernel=3,
-                          stride=2, pad=1, relu6=True)
-                in_channels_group = [int(x * multiplier) for x in
-                                     [32] + [16] + [24] * 2 + [32] * 3 + [64] * 4
-                                     + [96] * 3 + [160] * 3]
-                channels_group = [int(x * multiplier) for x in
-                                  [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3
-                                  + [160] * 3 + [320]]
-                ts = [1] + [6] * 16
-                strides = [1, 2] * 2 + [1, 1, 2] + [1] * 6 + [2] + [1] * 3
-                for in_c, c, t, s in zip(in_channels_group, channels_group, ts, strides):
+                _unit(self.features, _scaled(32, multiplier), kernel=3,
+                      stride=2, pad=1, act="relu6")
+                for in_w, out_w, t, stride in _V2_ROWS:
                     self.features.add(LinearBottleneck(
-                        in_channels=in_c, channels=c, t=t, stride=s))
-                last_channels = int(1280 * multiplier) if multiplier > 1.0 else 1280
-                _add_conv(self.features, last_channels, relu6=True)
+                        in_channels=_scaled(in_w, multiplier),
+                        channels=_scaled(out_w, multiplier),
+                        t=t, stride=stride))
+                head = _scaled(1280, multiplier) if multiplier > 1.0 else 1280
+                _unit(self.features, head, act="relu6")
                 self.features.add(nn.GlobalAvgPool2D())
             self.output = nn.HybridSequential(prefix="output_")
             with self.output.name_scope():
@@ -117,13 +138,10 @@ class MobileNetV2(HybridBlock):
                     nn.Flatten())
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
-def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
-    net = MobileNet(multiplier, **kwargs)
+def _checked(net, pretrained):
     if pretrained:
         raise MXNetError(
             "pretrained weights require network access; load local .params "
@@ -131,42 +149,28 @@ def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
     return net
 
 
-def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
-    net = MobileNetV2(multiplier, **kwargs)
-    if pretrained:
-        raise MXNetError(
-            "pretrained weights require network access; load local .params "
-            "with net.load_parameters instead")
-    return net
+def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
+                  **kwargs):
+    return _checked(MobileNet(multiplier, **kwargs), pretrained)
 
 
-def mobilenet1_0(**kwargs):
-    return get_mobilenet(1.0, **kwargs)
+def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
+                     **kwargs):
+    return _checked(MobileNetV2(multiplier, **kwargs), pretrained)
 
 
-def mobilenet0_75(**kwargs):
-    return get_mobilenet(0.75, **kwargs)
+def _factory(maker, multiplier, name):
+    kind = "MobileNetV2" if maker is get_mobilenet_v2 else "MobileNet"
+    return named_factory(maker, name,
+                         "%s with width multiplier %.2f." % (kind, multiplier),
+                         multiplier)
 
 
-def mobilenet0_5(**kwargs):
-    return get_mobilenet(0.5, **kwargs)
-
-
-def mobilenet0_25(**kwargs):
-    return get_mobilenet(0.25, **kwargs)
-
-
-def mobilenet_v2_1_0(**kwargs):
-    return get_mobilenet_v2(1.0, **kwargs)
-
-
-def mobilenet_v2_0_75(**kwargs):
-    return get_mobilenet_v2(0.75, **kwargs)
-
-
-def mobilenet_v2_0_5(**kwargs):
-    return get_mobilenet_v2(0.5, **kwargs)
-
-
-def mobilenet_v2_0_25(**kwargs):
-    return get_mobilenet_v2(0.25, **kwargs)
+mobilenet1_0 = _factory(get_mobilenet, 1.0, "mobilenet1_0")
+mobilenet0_75 = _factory(get_mobilenet, 0.75, "mobilenet0_75")
+mobilenet0_5 = _factory(get_mobilenet, 0.5, "mobilenet0_5")
+mobilenet0_25 = _factory(get_mobilenet, 0.25, "mobilenet0_25")
+mobilenet_v2_1_0 = _factory(get_mobilenet_v2, 1.0, "mobilenet_v2_1_0")
+mobilenet_v2_0_75 = _factory(get_mobilenet_v2, 0.75, "mobilenet_v2_0_75")
+mobilenet_v2_0_5 = _factory(get_mobilenet_v2, 0.5, "mobilenet_v2_0_5")
+mobilenet_v2_0_25 = _factory(get_mobilenet_v2, 0.25, "mobilenet_v2_0_25")

@@ -1,15 +1,20 @@
-"""ResNet V1/V2 (reference ``python/mxnet/gluon/model_zoo/vision/resnet.py``).
+"""ResNet V1/V2 — API parity with reference
+``python/mxnet/gluon/model_zoo/vision/resnet.py``, built fresh for this
+runtime.
 
-The flagship perf model (BASELINE.md ResNet-50). Structure and parameter
-naming mirror the reference; under ``hybridize()`` the whole network — convs,
-BNs, residual adds — compiles to one XLA module so XLA fuses BN+ReLU into
-the conv epilogues (the TPU counterpart of cuDNN fused ops).
+The flagship perf model (BASELINE.md ResNet-50). Under ``hybridize()`` the
+whole network — convs, BNs, residual adds — compiles to one XLA module so
+XLA fuses BN+ReLU into the conv epilogues (the TPU counterpart of cuDNN
+fused ops). Construction is spec-driven: each block's body is one
+``_seq``-built pipeline described by (channels, kernel, stride, pad)
+tuples instead of hand-unrolled add() chains.
 """
 from __future__ import annotations
 
 from ....base import MXNetError
-from ...block import HybridBlock
 from ... import nn
+from ...block import HybridBlock
+from ._builders import named_factory, seq as _seq
 
 __all__ = [
     "ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
@@ -19,213 +24,200 @@ __all__ = [
 ]
 
 
+def _conv(ch, k, stride=1, pad=0, in_ch=0, bias=False):
+    return nn.Conv2D(ch, kernel_size=k, strides=stride, padding=pad,
+                     use_bias=bias, in_channels=in_ch)
+
+
 def _conv3x3(channels, stride, in_channels):
-    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
+    return _conv(channels, 3, stride, 1, in_channels)
 
 
-class BasicBlockV1(HybridBlock):
-    """ResNet V1 basic block: conv-bn-relu ×2 + residual (reference resnet.py:BasicBlockV1)."""
+def _conv_bn_act(specs, final_act=True):
+    """conv→BN(→relu) pipeline from (ch, k, stride, pad, in_ch) rows; the
+    trailing relu is omitted when the residual add comes first (V1 blocks)."""
+    layers = []
+    for row_i, row in enumerate(specs):
+        layers += [_conv(*row), nn.BatchNorm()]
+        if final_act or row_i + 1 < len(specs):
+            layers.append(nn.Activation("relu"))
+    return _seq(*layers)
 
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
+
+def _shortcut(channels, stride, in_channels, with_bn):
+    proj = [_conv(channels, 1, stride, 0, in_channels)]
+    if with_bn:
+        proj.append(nn.BatchNorm())
+    return _seq(*proj)
+
+
+class _BlockV1(HybridBlock):
+    """Post-activation residual block: relu(body(x) + shortcut(x))."""
+
+    def __init__(self, body_specs, channels, stride, downsample,
+                 in_channels, **kwargs):
         super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1, strides=stride,
-                                          use_bias=False, in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
+        self.body = _conv_bn_act(body_specs, final_act=False)
+        self.downsample = _shortcut(channels, stride, in_channels,
+                                    with_bn=True) if downsample else None
 
     def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        x = F.Activation(residual + x, act_type="relu")
-        return x
+        skip = x if self.downsample is None else self.downsample(x)
+        return F.Activation(self.body(x) + skip, act_type="relu")
 
 
-class BottleneckV1(HybridBlock):
-    """ResNet V1 bottleneck 1x1-3x3-1x1 (reference resnet.py:BottleneckV1)."""
+class BasicBlockV1(_BlockV1):
+    """3x3 ×2 (reference resnet.py:BasicBlockV1)."""
 
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        specs = [(channels, 3, stride, 1, in_channels),
+                 (channels, 3, 1, 1, channels)]
+        super().__init__(specs, channels, stride, downsample, in_channels,
+                         **kwargs)
+
+
+class BottleneckV1(_BlockV1):
+    """1x1 → 3x3 → 1x1 (reference resnet.py:BottleneckV1)."""
+
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        mid = channels // 4
+        # the 1x1 convs keep their bias — a reference quirk preserved for
+        # parameter-file compatibility (reference BottleneckV1 uses the
+        # Conv2D bias default for both pointwise convs)
+        specs = [(mid, 1, stride, 0, 0, True),
+                 (mid, 3, 1, 1, mid),
+                 (channels, 1, 1, 0, 0, True)]
+        super().__init__(specs, channels, stride, downsample, in_channels,
+                         **kwargs)
+
+
+class _BlockV2(HybridBlock):
+    """Pre-activation residual block (identity mappings): the shortcut taps
+    the post-BN-relu stream, convs carry no BN after the last one."""
+
+    def __init__(self, conv_specs, channels, stride, downsample,
+                 in_channels, **kwargs):
         super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1, strides=stride,
-                                          use_bias=False, in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
+        self._bns = []
+        self._convs = []
+        for i, (ch, k, st, pad, in_ch) in enumerate(conv_specs):
+            bn = nn.BatchNorm()
+            conv = _conv(ch, k, st, pad, in_ch)
+            setattr(self, "bn%d" % (i + 1), bn)
+            setattr(self, "conv%d" % (i + 1), conv)
+            self._bns.append(bn)
+            self._convs.append(conv)
+        self.downsample = _shortcut(channels, stride, in_channels,
+                                    with_bn=False) if downsample else None
 
     def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        x = F.Activation(x + residual, act_type="relu")
-        return x
+        skip = x
+        for i, (bn, conv) in enumerate(zip(self._bns, self._convs)):
+            x = F.Activation(bn(x), act_type="relu")
+            if i == 0 and self.downsample is not None:
+                skip = self.downsample(x)
+            x = conv(x)
+        return x + skip
 
 
-class BasicBlockV2(HybridBlock):
-    """ResNet V2 pre-activation basic block (reference resnet.py:BasicBlockV2)."""
+class BasicBlockV2(_BlockV2):
+    """Pre-activation 3x3 ×2 (reference resnet.py:BasicBlockV2)."""
 
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        specs = [(channels, 3, stride, 1, in_channels),
+                 (channels, 3, 1, 1, channels)]
+        super().__init__(specs, channels, stride, downsample, in_channels,
+                         **kwargs)
+
+
+class BottleneckV2(_BlockV2):
+    """Pre-activation bottleneck (reference resnet.py:BottleneckV2)."""
+
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        mid = channels // 4
+        specs = [(mid, 1, 1, 0, 0),
+                 (mid, 3, stride, 1, mid),
+                 (channels, 1, 1, 0, 0)]
+        super().__init__(specs, channels, stride, downsample, in_channels,
+                         **kwargs)
+
+
+def _stage(block, count, channels, stride, index, in_channels):
+    """One resolution stage: a strided (possibly projected) block followed
+    by count-1 identity blocks."""
+    stage = nn.HybridSequential(prefix="stage%d_" % index)
+    with stage.name_scope():
+        stage.add(block(channels, stride, channels != in_channels,
+                        in_channels=in_channels, prefix=""))
+        for _ in range(1, count):
+            stage.add(block(channels, 1, False, in_channels=channels,
+                            prefix=""))
+    return stage
+
+
+def _stem(channels, thumbnail):
+    """Input stem: 3x3 for CIFAR-size inputs, 7x7+maxpool for ImageNet."""
+    if thumbnail:
+        return [_conv3x3(channels, 1, 0)]
+    return [nn.Conv2D(channels, 7, 2, 3, use_bias=False), nn.BatchNorm(),
+            nn.Activation("relu"), nn.MaxPool2D(3, 2, 1)]
+
+
+class _ResNet(HybridBlock):
+    """Shared features→output skeleton for both versions."""
+
+    def __init__(self, classes, **kwargs):
         super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
+        self._classes = classes
 
     def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        return x + residual
+        return self.output(self.features(x))
 
 
-class BottleneckV2(HybridBlock):
-    """ResNet V2 pre-activation bottleneck (reference resnet.py:BottleneckV2)."""
-
-    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1, use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1, use_bias=False)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        x = self.bn3(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv3(x)
-        return x + residual
-
-
-class ResNetV1(HybridBlock):
+class ResNetV1(_ResNet):
     """ResNet V1 (reference resnet.py:ResNetV1)."""
 
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False, **kwargs):
-        super().__init__(**kwargs)
+    def __init__(self, block, layers, channels, classes=1000,
+                 thumbnail=False, **kwargs):
+        super().__init__(classes, **kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=channels[i]))
+            self.features = _seq(*_stem(channels[0], thumbnail))
+            for i, count in enumerate(layers):
+                self.features.add(_stage(block, count, channels[i + 1],
+                                         1 if i == 0 else 2, i + 1,
+                                         channels[i]))
             self.features.add(nn.GlobalAvgPool2D())
             self.output = nn.Dense(classes, in_units=channels[-1])
 
-    def _make_layer(self, block, layers, channels, stride, stage_index, in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels, prefix=""))
-        return layer
 
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
-
-
-class ResNetV2(HybridBlock):
+class ResNetV2(_ResNet):
     """ResNet V2 (reference resnet.py:ResNetV2)."""
 
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False, **kwargs):
-        super().__init__(**kwargs)
+    def __init__(self, block, layers, channels, classes=1000,
+                 thumbnail=False, **kwargs):
+        super().__init__(classes, **kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.BatchNorm(scale=False, center=False))
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            in_channels = channels[0]
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=in_channels))
-                in_channels = channels[i + 1]
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes, in_units=in_channels)
-
-    def _make_layer(self, block, layers, channels, stride, stage_index, in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels, prefix=""))
-        return layer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+            # leading data BN (no affine) then the same stem as V1
+            self.features = _seq(nn.BatchNorm(scale=False, center=False),
+                                 *_stem(channels[0], thumbnail))
+            width = channels[0]
+            for i, count in enumerate(layers):
+                self.features.add(_stage(block, count, channels[i + 1],
+                                         1 if i == 0 else 2, i + 1, width))
+                width = channels[i + 1]
+            for tail in (nn.BatchNorm(), nn.Activation("relu"),
+                         nn.GlobalAvgPool2D(), nn.Flatten()):
+                self.features.add(tail)
+            self.output = nn.Dense(classes, in_units=width)
 
 
+# depth → (block kind, per-stage counts, per-stage channels)
 resnet_spec = {
     18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
     34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
@@ -240,18 +232,19 @@ resnet_block_versions = [
 ]
 
 
-def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None, **kwargs):
+def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
+               **kwargs):
     """Factory (reference resnet.py:get_resnet)."""
     if num_layers not in resnet_spec:
-        raise MXNetError(
-            "Invalid number of layers: %d. Options are %s"
-            % (num_layers, str(resnet_spec.keys())))
-    block_type, layers, channels = resnet_spec[num_layers]
+        raise MXNetError("Invalid number of layers: %d. Options are %s"
+                         % (num_layers, str(sorted(resnet_spec))))
     if version not in (1, 2):
-        raise MXNetError("Invalid resnet version: %d. Options are 1 and 2." % version)
-    resnet_class = resnet_net_versions[version - 1]
-    block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
+        raise MXNetError(
+            "Invalid resnet version: %d. Options are 1 and 2." % version)
+    kind, layers, channels = resnet_spec[num_layers]
+    net_cls = resnet_net_versions[version - 1]
+    block_cls = resnet_block_versions[version - 1][kind]
+    net = net_cls(block_cls, layers, channels, **kwargs)
     if pretrained:
         raise MXNetError(
             "pretrained weights require network access; load local .params "
@@ -259,41 +252,20 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None, **kwa
     return net
 
 
-def resnet18_v1(**kwargs):
-    return get_resnet(1, 18, **kwargs)
+def _factory(version, depth):
+    name = "resnet%d_v%d" % (depth, version)
+    return named_factory(get_resnet, name,
+                         "ResNet-%d V%d (reference resnet.py:%s)."
+                         % (depth, version, name), version, depth)
 
 
-def resnet34_v1(**kwargs):
-    return get_resnet(1, 34, **kwargs)
-
-
-def resnet50_v1(**kwargs):
-    return get_resnet(1, 50, **kwargs)
-
-
-def resnet101_v1(**kwargs):
-    return get_resnet(1, 101, **kwargs)
-
-
-def resnet152_v1(**kwargs):
-    return get_resnet(1, 152, **kwargs)
-
-
-def resnet18_v2(**kwargs):
-    return get_resnet(2, 18, **kwargs)
-
-
-def resnet34_v2(**kwargs):
-    return get_resnet(2, 34, **kwargs)
-
-
-def resnet50_v2(**kwargs):
-    return get_resnet(2, 50, **kwargs)
-
-
-def resnet101_v2(**kwargs):
-    return get_resnet(2, 101, **kwargs)
-
-
-def resnet152_v2(**kwargs):
-    return get_resnet(2, 152, **kwargs)
+resnet18_v1 = _factory(1, 18)
+resnet34_v1 = _factory(1, 34)
+resnet50_v1 = _factory(1, 50)
+resnet101_v1 = _factory(1, 101)
+resnet152_v1 = _factory(1, 152)
+resnet18_v2 = _factory(2, 18)
+resnet34_v2 = _factory(2, 34)
+resnet50_v2 = _factory(2, 50)
+resnet101_v2 = _factory(2, 101)
+resnet152_v2 = _factory(2, 152)

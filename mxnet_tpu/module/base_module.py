@@ -1,38 +1,64 @@
 """BaseModule: the high-level train/predict interface.
 
 API parity with reference ``python/mxnet/module/base_module.py`` (fit :409,
-score, predict, forward_backward, epoch loop :514-538).
+score, predict, forward_backward, epoch loop :514-538), re-implemented for
+this runtime: the training loop is a plain iterate-prepare-step loop (the
+reference's prefetch-next-batch shuffle exists to overlap sparse row pulls,
+which here ride the async engine anyway), and callback dispatch is
+centralized in one helper.
 """
 from __future__ import annotations
 
 import logging
 import time
 
-import numpy as np
-
 from .. import metric as metric_mod
-from ..base import MXNetError
-from ..context import cpu
+
 from ..initializer import Uniform
 
 __all__ = ["BaseModule"]
 
 
-def _as_list(obj):
-    if obj is None:
-        return []
-    if isinstance(obj, (list, tuple)):
-        return list(obj)
-    return [obj]
+class _BatchEndParam(object):
+    """Callback payload: epoch / nbatch / eval_metric / locals (reference
+    BatchEndParam namedtuple contract)."""
+
+    def __init__(self, epoch, nbatch, eval_metric, locals_):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = locals_
+
+
+def _fire(callbacks, *args):
+    """Invoke one callback or a list of them."""
+    if callbacks is None:
+        return
+    cbs = callbacks if isinstance(callbacks, (list, tuple)) else [callbacks]
+    for cb in cbs:
+        cb(*args)
+
+
+def _as_metric(m):
+    return m if isinstance(m, metric_mod.EvalMetric) else metric_mod.create(m)
+
+
+def _limited(data_iter, num_batch):
+    """Yield (nbatch, batch) pairs, stopping after num_batch if given."""
+    for nbatch, batch in enumerate(data_iter):
+        if num_batch is not None and nbatch >= num_batch:
+            return
+        yield nbatch, batch
 
 
 def _check_input_names(symbol, names, typename, throw):
     args = symbol.list_arguments()
-    for name in names:
-        if name in args:
-            continue
-        msg = "You created Module with Module(..., %s_names=%s) but input with name '%s' is not found in symbol.list_arguments(). Did you mean one of:\n\t%s" % (
-            typename, str(names), name, "\n\t".join(args))
+    missing = [n for n in names if n not in args]
+    for name in missing:
+        msg = ("You created Module with Module(..., %s_names=%s) but input "
+               "with name '%s' is not found in symbol.list_arguments(). "
+               "Did you mean one of:\n\t%s"
+               % (typename, str(names), name, "\n\t".join(args)))
         if throw:
             raise ValueError(msg)
         logging.warning(msg)
@@ -58,73 +84,61 @@ class BaseModule(object):
         self.forward(data_batch, is_train=True)
         self.backward()
 
-    def score(self, eval_data, eval_metric, num_batch=None, batch_end_callback=None,
-              score_end_callback=None, reset=True, epoch=0, sparse_row_id_fn=None):
+    def score(self, eval_data, eval_metric, num_batch=None,
+              batch_end_callback=None, score_end_callback=None, reset=True,
+              epoch=0, sparse_row_id_fn=None):
         """Evaluate on a data iterator (reference base_module.py:score)."""
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
+        eval_metric = _as_metric(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                for callback in _as_list(batch_end_callback):
-                    callback(_BatchEndParam(epoch, nbatch, eval_metric, locals()))
-            actual_num_batch += 1
-        if score_end_callback:
-            for callback in _as_list(score_end_callback):
-                callback(_BatchEndParam(epoch, actual_num_batch, eval_metric, locals()))
+        seen = 0
+        for nbatch, batch in _limited(eval_data, num_batch):
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+            _fire(batch_end_callback,
+                  _BatchEndParam(epoch, nbatch, eval_metric, locals()))
+            seen = nbatch + 1
+        _fire(score_end_callback,
+              _BatchEndParam(epoch, seen, eval_metric, locals()))
         return eval_metric.get_name_value()
+
+    def _unpadded_outputs(self, batch):
+        """Forwarded outputs with the batch's tail padding sliced off."""
+        keep = slice(None) if not batch.pad else slice(0, -batch.pad)
+        return [out[keep] for out in self.get_outputs()]
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad] for out in self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
+        for nbatch, batch in _limited(eval_data, num_batch):
+            self.forward(batch, is_train=False)
+            yield (self._unpadded_outputs(batch), nbatch, batch)
 
-    def predict(self, eval_data, num_batch=None, merge_batches=True, reset=True,
-                always_output_list=False, sparse_row_id_fn=None):
-        """Run prediction, collecting outputs (reference base_module.py:predict)."""
+    def predict(self, eval_data, num_batch=None, merge_batches=True,
+                reset=True, always_output_list=False, sparse_row_id_fn=None):
+        """Run prediction, collecting outputs (reference
+        base_module.py:predict)."""
         from ..ndarray import ndarray as nd_mod
 
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad].copy() for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    "Cannot merge batches, as num of outputs is not the same " \
-                    "in mini-batches. Maybe bucketing is used?"
-            output_list2 = [
-                nd_mod.concat(*[out[i] for out in output_list], dim=0)
-                for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
+        per_batch = [[o.copy() for o in outs] for outs, _, _
+                     in self.iter_predict(eval_data, num_batch, reset)]
+        if not per_batch:
+            return []
+        if not merge_batches:
+            return per_batch
+        width = len(per_batch[0])
+        if any(len(outs) != width for outs in per_batch):
+            raise ValueError(
+                "Cannot merge batches: output arity varies across "
+                "mini-batches. Maybe bucketing is used?")
+        merged = [nd_mod.concat(*[outs[i] for outs in per_batch], dim=0)
+                  for i in range(width)]
+        if width == 1 and not always_output_list:
+            return merged[0]
+        return merged
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
@@ -132,76 +146,67 @@ class BaseModule(object):
             eval_end_callback=None, eval_batch_end_callback=None,
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
-            begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None,
-            sparse_row_id_fn=None):
-        """Train the module (reference base_module.py:409; epoch loop :514-538)."""
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None, sparse_row_id_fn=None):
+        """Train the module (reference base_module.py:409)."""
         assert num_epoch is not None, "please specify number of epochs"
-        if initializer is None:
-            initializer = Uniform(0.01)
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
         if monitor is not None:
             self.install_monitor(monitor)
-        self.init_params(initializer=initializer, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
+        self.init_params(initializer=initializer or Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
-        if validation_metric is None:
-            validation_metric = eval_metric
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
+        eval_metric = _as_metric(eval_metric)
+        validation_metric = validation_metric or eval_metric
 
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            started = time.time()
             eval_metric.reset()
-            nbatch = 0
-            end_of_batch = False
-            data_iter = iter(train_data)
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
+            nbatch = -1
+            epoch_vals = []
+            for nbatch, batch in enumerate(train_data):
+                self.prepare(batch, sparse_row_id_fn=sparse_row_id_fn)
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
+                self.forward_backward(batch)
                 self.update()
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch, sparse_row_id_fn=sparse_row_id_fn)
-                except StopIteration:
-                    end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
+                self.update_metric(eval_metric, batch.label)
                 if monitor is not None:
                     monitor.toc_print()
-                if end_of_batch:
-                    eval_name_vals = eval_metric.get_name_value()
-                if batch_end_callback is not None:
-                    for callback in _as_list(batch_end_callback):
-                        callback(_BatchEndParam(epoch, nbatch, eval_metric, locals()))
-                nbatch += 1
+                # snapshot BEFORE callbacks: an auto-resetting Speedometer
+                # on the final batch would otherwise leave the epoch
+                # summary reading an empty (nan) metric
+                epoch_vals = eval_metric.get_name_value()
+                _fire(batch_end_callback,
+                      _BatchEndParam(epoch, nbatch, eval_metric, locals()))
+            if nbatch < 0:
+                raise ValueError("train_data produced no batches")
 
-            for name, val in eval_name_vals:
+            for name, val in epoch_vals:
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            self.logger.info("Epoch[%d] Time cost=%.3f",
+                             epoch, time.time() - started)
 
-            arg_params, aux_params = self.get_params()
-            self.set_params(arg_params, aux_params)
-
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params, aux_params)
+            # sync the trained device copies back into the param dicts so
+            # epoch-end checkpoints see this epoch's weights
+            arg_now, aux_now = self.get_params()
+            self.set_params(arg_now, aux_now)
+            _fire(epoch_end_callback, epoch, self.symbol, arg_now, aux_now)
 
             if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+                for name, val in self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch):
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
 
             train_data.reset()
 
@@ -214,30 +219,30 @@ class BaseModule(object):
 
     @property
     def data_names(self):
-        raise NotImplementedError()
+        raise NotImplementedError("implemented by the concrete Module")
 
     @property
     def output_names(self):
-        raise NotImplementedError()
+        raise NotImplementedError("implemented by the concrete Module")
 
     @property
     def data_shapes(self):
-        raise NotImplementedError()
+        raise NotImplementedError("implemented by the concrete Module")
 
     @property
     def label_shapes(self):
-        raise NotImplementedError()
+        raise NotImplementedError("implemented by the concrete Module")
 
     @property
     def output_shapes(self):
-        raise NotImplementedError()
+        raise NotImplementedError("implemented by the concrete Module")
 
     def get_params(self):
-        raise NotImplementedError()
+        raise NotImplementedError("implemented by the concrete Module")
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False, allow_extra=False):
-        raise NotImplementedError()
+        raise NotImplementedError("implemented by the concrete Module")
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
@@ -249,21 +254,19 @@ class BaseModule(object):
         from ..ndarray import io_utils
 
         arg_params, aux_params = self.get_params()
-        save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-        save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-        io_utils.save(fname, save_dict)
+        blob = {"arg:" + k: v for k, v in arg_params.items()}
+        blob.update(("aux:" + k, v) for k, v in aux_params.items())
+        io_utils.save(fname, blob)
 
     def load_params(self, fname):
         from ..ndarray import io_utils
 
-        save_dict = io_utils.load(fname)
-        arg_params = {}
-        aux_params = {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(":", 1)
-            if arg_type == "arg":
+        arg_params, aux_params = {}, {}
+        for key, value in io_utils.load(fname).items():
+            kind, _, name = key.partition(":")
+            if kind == "arg":
                 arg_params[name] = value
-            elif arg_type == "aux":
+            elif kind == "aux":
                 aux_params[name] = value
             else:
                 raise ValueError("Invalid param file " + fname)
@@ -279,43 +282,35 @@ class BaseModule(object):
         assert not states and not value
 
     def install_monitor(self, mon):
-        raise NotImplementedError()
+        raise NotImplementedError("implemented by the concrete Module")
 
     def prepare(self, data_batch, sparse_row_id_fn=None):
         pass
 
     def forward(self, data_batch, is_train=None):
-        raise NotImplementedError()
+        raise NotImplementedError("implemented by the concrete Module")
 
     def backward(self, out_grads=None):
-        raise NotImplementedError()
+        raise NotImplementedError("implemented by the concrete Module")
 
     def get_outputs(self, merge_multi_context=True):
-        raise NotImplementedError()
+        raise NotImplementedError("implemented by the concrete Module")
 
     def get_input_grads(self, merge_multi_context=True):
-        raise NotImplementedError()
+        raise NotImplementedError("implemented by the concrete Module")
 
     def update(self):
-        raise NotImplementedError()
+        raise NotImplementedError("implemented by the concrete Module")
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
-        raise NotImplementedError()
+        raise NotImplementedError("implemented by the concrete Module")
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
-        raise NotImplementedError()
+        raise NotImplementedError("implemented by the concrete Module")
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
-        raise NotImplementedError()
-
-
-class _BatchEndParam(object):
-    def __init__(self, epoch, nbatch, eval_metric, locals_):
-        self.epoch = epoch
-        self.nbatch = nbatch
-        self.eval_metric = eval_metric
-        self.locals = locals_
+        raise NotImplementedError("implemented by the concrete Module")

@@ -1,10 +1,10 @@
-"""Monitor: per-op output/weight statistics for debugging (NaN hunting).
+"""Monitor: per-tensor statistics for debugging (NaN hunting).
 
-Reference ``python/mxnet/monitor.py:33`` — Monitor installs a callback into
-executors that records a statistic of every intermediate output whose name
-matches ``pattern``; ``tic``/``toc`` bracket each batch. Here the executor
-surfaces intermediate outputs to the callback after the whole-graph XLA run
-(executor.py monitor hook) — per-op granularity with whole-graph compilation.
+API parity with reference ``python/mxnet/monitor.py:33`` (install/tic/toc/
+toc_print), re-implemented for the whole-graph XLA executor: the executor
+calls the installed hook once per intermediate output after the compiled
+module runs (executor.py monitor hook), which gives per-op visibility
+without breaking one-module compilation.
 """
 from __future__ import annotations
 
@@ -12,92 +12,97 @@ import logging
 import math
 import re
 
-from .ndarray.ndarray import NDArray
 from . import ndarray as nd_mod
+from .ndarray.ndarray import NDArray
 
 __all__ = ["Monitor"]
 
 
-class Monitor(object):
-    """Monitor outputs, weights, and gradients for debugging
+def _rms(x):
+    """Default statistic: RMS of the tensor (|x|_2 / sqrt(n))."""
+    return nd_mod.norm(x) / math.sqrt(x.size)
+
+
+def _fmt(stat):
+    """Render one recorded statistic: scalars print bare, arrays via numpy;
+    a stat_func may also return a list of NDArrays (reference contract)."""
+    vals = stat if isinstance(stat, list) else [stat]
+    parts = []
+    for v in vals:
+        assert isinstance(v, NDArray), "stat_func must return NDArray(s)"
+        a = v.asnumpy()
+        parts.append(str(a.reshape(-1)[0]) if a.size == 1 else str(a))
+    return "\t".join(parts) + "\t"
+
+
+class Monitor:
+    """Record a statistic of matching tensors every ``interval`` batches
     (reference monitor.py:33).
 
-    Parameters
-    ----------
-    interval : int — batches between collections
-    stat_func : callable(NDArray) -> NDArray, default |x| RMS
-    pattern : str — regex filtering tensor names
-    sort : bool — sort results by name in toc()
+    ``tic()`` arms collection for the coming batch when due; the installed
+    executor hook feeds intermediate outputs while armed; ``toc()`` adds the
+    executors' current arg/aux arrays, disarms, and returns the collected
+    ``(step, name, stat_string)`` rows.
     """
 
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-            def asum_stat(x):
-                return nd_mod.norm(x) / math.sqrt(x.size)
-            stat_func = asum_stat
-        self.stat_func = stat_func
         self.interval = interval
-        self.activated = False
-        self.queue = []
-        self.step = 0
-        self.exes = []
-        self.re_prog = re.compile(pattern)
+        self.stat_func = stat_func or _rms
         self.sort = sort
+        self._match = re.compile(pattern).match
+        self._armed = False
+        self._step = 0
+        self._rows = []      # (step, name, raw stat) while armed
+        self._exes = []
 
-        def stat_helper(name, array):
-            if not self.activated or not self.re_prog.match(name):
-                return
-            self.queue.append((self.step, name, self.stat_func(array)))
-
-        self.stat_helper = stat_helper
+    # the executor hook — bound method, stable identity across installs
+    def stat_helper(self, name, array):
+        if self._armed and self._match(name):
+            self._rows.append((self._step, name, self.stat_func(array)))
 
     def install(self, exe):
-        """Install the callback into an executor (reference monitor.py:73)."""
+        """Register with an executor (reference monitor.py:73)."""
         exe.set_monitor_callback(self.stat_helper)
-        self.exes.append(exe)
+        self._exes.append(exe)
 
     def tic(self):
-        """Start collecting stats for the coming batch (reference
-        monitor.py:85)."""
-        if self.step % self.interval == 0:
-            self.queue = []
-            self.activated = True
-        self.step += 1
+        """Arm collection if this batch is due (reference monitor.py:85)."""
+        if self._step % self.interval == 0:
+            self._rows = []
+            self._armed = True
+        self._step += 1
 
     def toc(self):
-        """Finish the batch; returns [(step, name, stat_str)] (reference
-        monitor.py:99). Also samples current arg/aux arrays."""
-        if not self.activated:
+        """Disarm and return [(step, name, stat_str)] including a sample of
+        each installed executor's arg/aux arrays (reference monitor.py:99)."""
+        if not self._armed:
             return []
-        for exe in self.exes:
-            for name, array in zip(exe._symbol.list_arguments(),
-                                   exe.arg_arrays):
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
-            for name, array in zip(exe._symbol.list_auxiliary_states(),
-                                   exe.aux_arrays):
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
-        self.activated = False
-        res = []
-        if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            assert isinstance(v_list, list)
-            s = ""
-            for v in v_list:
-                assert isinstance(v, NDArray)
-                if v.shape == (1,) or v.shape == ():
-                    s += str(v.asnumpy().reshape(-1)[0]) + "\t"
-                else:
-                    s += str(v.asnumpy()) + "\t"
-            res.append((n, k, s))
-        self.queue = []
-        return res
+        for exe in self._exes:
+            sym = exe._symbol
+            for names, arrays in ((sym.list_arguments(), exe.arg_arrays),
+                                  (sym.list_auxiliary_states(),
+                                   exe.aux_arrays)):
+                for name, arr in zip(names, arrays):
+                    if self._match(name):
+                        self._rows.append(
+                            (self._step, name, self.stat_func(arr)))
+        self._armed = False
+        rows = sorted(self._rows, key=lambda r: r[1]) if self.sort \
+            else self._rows
+        out = [(step, name, _fmt(stat)) for step, name, stat in rows]
+        self._rows = []
+        return out
 
     def toc_print(self):
-        """Finish the batch and log results (reference monitor.py:139)."""
-        for n, k, v in self.toc():
-            logging.info("Batch: %7d %30s %s", n, k, v)
+        """toc() + log each row (reference monitor.py:139)."""
+        for step, name, stat in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, stat)
+
+    # legacy attribute aliases (reference exposes these publicly)
+    @property
+    def step(self):
+        return self._step
+
+    @property
+    def activated(self):
+        return self._armed

@@ -301,3 +301,65 @@ def test_bucketing_executors_share_param_memory():
     assert exec5.arg_dict["sharefc_weight"] is exec10.arg_dict["sharefc_weight"]
     exec10.arg_dict["sharefc_weight"][:] = 3.5
     np.testing.assert_allclose(exec5.arg_dict["sharefc_weight"].asnumpy(), 3.5)
+
+
+def test_monitor_and_callbacks():
+    """Monitor tic/toc over a fit step + Speedometer/ProgressBar callbacks
+    (reference monitor.py / callback.py behavior contracts)."""
+    import logging
+    from collections import namedtuple
+
+    import mxnet_tpu as mx
+
+    # Monitor against a bound executor
+    x = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    y = mx.sym.FullyConnected(data=x, weight=w, no_bias=True,
+                              num_hidden=3, name="fc")
+    exe = y.simple_bind(mx.cpu(), data=(2, 4))
+    mon = mx.monitor.Monitor(1, pattern=".*")
+    mon.install(exe)
+    mon.tic()
+    exe.forward()
+    rows = mon.toc()
+    assert rows, "monitor collected nothing"
+    names = [r[1] for r in rows]
+    assert any("fc" in n or n in ("data", "w") for n in names)
+    for step, name, stat in rows:
+        assert isinstance(stat, str) and stat.strip()
+
+    # interval: second batch (step 1) must not arm
+    mon2 = mx.monitor.Monitor(2, pattern=".*")
+    mon2.install(exe)
+    mon2.tic()
+    exe.forward()
+    assert mon2.toc()  # armed at step 0
+    mon2.tic()
+    exe.forward()
+    assert mon2.toc() == []  # not due
+
+    # Speedometer: logs every `frequent` batches, auto-resets the metric
+    Param = namedtuple("Param", ["epoch", "nbatch", "eval_metric", "locals"])
+    metric = mx.metric.Loss()
+    metric.update(None, [mx.nd.array([1.0])])
+    speedo = mx.callback.Speedometer(batch_size=8, frequent=2,
+                                     auto_reset=True)
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda rec: records.append(rec.getMessage())
+    root = logging.getLogger()
+    old_level = root.level
+    root.setLevel(logging.INFO)
+    root.addHandler(handler)
+    try:
+        speedo(Param(0, 0, metric, None))   # arms the mark
+        speedo(Param(0, 1, metric, None))   # not due (odd)
+        speedo(Param(0, 2, metric, None))   # due -> logs
+        pb = mx.callback.ProgressBar(total=4, length=8)
+        pb(Param(0, 2, None, None))
+    finally:
+        root.removeHandler(handler)
+        root.setLevel(old_level)
+    assert any("samples/sec" in m for m in records), records
+    assert any("50%" in m for m in records), records
+    assert metric.num_inst == 0  # auto_reset happened
