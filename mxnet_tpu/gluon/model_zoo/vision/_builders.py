@@ -16,9 +16,15 @@ def seq(*layers, prefix=""):
 
 def named_factory(builder, name, doc, *bound_args):
     """A zero-config model constructor (``resnet50_v1()``-style) delegating
-    to ``builder(*bound_args, **kwargs)``."""
+    to ``builder(*bound_args, **kwargs)``. The result is picklable: it
+    advertises the caller's module and the bound ``name`` (under which the
+    caller assigns it), so ``pickle`` resolves it as a module attribute."""
+    import sys
+
     def make(**kwargs):
         return builder(*bound_args, **kwargs)
     make.__name__ = name
+    make.__qualname__ = name
+    make.__module__ = sys._getframe(1).f_globals.get("__name__", __name__)
     make.__doc__ = doc
     return make

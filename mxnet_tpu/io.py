@@ -504,6 +504,27 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=128,
             except StopIteration:
                 return False
 
+    # multiprocess pipeline (the iter_image_recordio_2.cc counterpart):
+    # used whenever an .idx exists and >1 preprocess worker is requested —
+    # JPEG decode does not scale on Python threads (GIL). Spawned workers
+    # need a re-importable __main__, so interactive/stdin sessions keep the
+    # single-process path.
+    import sys as _sys
+
+    idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+    spawnable = getattr(_sys.modules.get("__main__"), "__file__", None) \
+        is not None
+    if preprocess_threads and preprocess_threads > 1 \
+            and os.path.exists(idx_path) and spawnable \
+            and not kwargs.pop("force_single_process", False):
+        from .image_pipeline import MPImageRecordIter
+
+        return MPImageRecordIter(
+            path_imgrec=path_imgrec, data_shape=data_shape,
+            batch_size=batch_size, shuffle=shuffle,
+            label_width=label_width, preprocess_threads=preprocess_threads,
+            prefetch_buffer=prefetch_buffer, **kwargs)
+
     it = _Iter()
     if preprocess_threads and prefetch_buffer:
         return PrefetchingIter(it)

@@ -179,14 +179,18 @@ class BaseModule(object):
                 self.update_metric(eval_metric, batch.label)
                 if monitor is not None:
                     monitor.toc_print()
-                # snapshot BEFORE callbacks: an auto-resetting Speedometer
-                # on the final batch would otherwise leave the epoch
-                # summary reading an empty (nan) metric
-                epoch_vals = eval_metric.get_name_value()
-                _fire(batch_end_callback,
-                      _BatchEndParam(epoch, nbatch, eval_metric, locals()))
+                if batch_end_callback is not None:
+                    # snapshot BEFORE callbacks: an auto-resetting
+                    # Speedometer on the final batch would otherwise leave
+                    # the epoch summary reading an empty (nan) metric
+                    epoch_vals = eval_metric.get_name_value()
+                    _fire(batch_end_callback,
+                          _BatchEndParam(epoch, nbatch, eval_metric,
+                                         locals()))
             if nbatch < 0:
                 raise ValueError("train_data produced no batches")
+            if batch_end_callback is None:
+                epoch_vals = eval_metric.get_name_value()
 
             for name, val in epoch_vals:
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
