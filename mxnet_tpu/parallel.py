@@ -86,6 +86,21 @@ def _reduce_fn(mesh: Mesh, op: str):
     return fn
 
 
+def _acc_reduce(datas, op):
+    """Sequential on-device accumulation of copies for sum/mean/max/min."""
+    acc = datas[0]
+    for d in datas[1:]:
+        if op in ("sum", "mean"):
+            acc = acc + d
+        elif op == "max":
+            acc = jnp.maximum(acc, d)
+        elif op == "min":
+            acc = jnp.minimum(acc, d)
+        else:
+            raise MXNetError("unsupported all_reduce op %r" % (op,))
+    return acc
+
+
 def all_reduce(arrays: List[Any], op: str = "sum"):
     """Allreduce per-device copies into one replicated jax.Array.
 
@@ -107,35 +122,40 @@ def all_reduce(arrays: List[Any], op: str = "sum"):
     for d in datas:
         ds = list(d.devices())
         devs.append(ds[0] if len(ds) == 1 else None)
-    if None in devs or len(set(devs)) != len(devs):
-        # copies not on distinct single devices: plain on-device reduce
-        acc = datas[0]
-        for d in datas[1:]:
-            if op in ("sum", "mean"):
-                acc = acc + d
-            elif op == "max":
-                acc = jnp.maximum(acc, d)
-            elif op == "min":
-                acc = jnp.minimum(acc, d)
-            else:
-                raise MXNetError("unsupported all_reduce op %r" % (op,))
+    distinct = None not in devs and len(set(devs)) == len(devs)
+    if jax.process_count() == 1 and not distinct:
+        # single process, copies not on distinct devices: plain on-device
+        # reduce (multi-process must NOT take this shortcut — the local
+        # arrangement is irrelevant, the cross-process reduce still runs)
+        acc = _acc_reduce(datas, op)
         if op == "mean":
             acc = acc / len(datas)
         return acc
+    mean_unpack = None  # (shape, dtype) when mean rides a sum (see below)
     if jax.process_count() > 1:
+        # SPMD contract: branch selection must agree across processes, so
+        # either EVERY process passes exactly one copy per local device
+        # (fast path: one collective over the global device mesh) or none
+        # does (pre-reduce path). Mixed arrangements are a caller error and
+        # would run mismatched collectives.
         local = jax.local_devices()
-        if len(datas) == len(local):
+        if len(datas) == len(local) and distinct:
             mesh = Mesh(np.asarray(jax.devices()), ("dev",))
         else:
             # arbitrary number of local copies: pre-reduce them on-device,
             # then reduce the partials across processes on a one-device-per-
             # process mesh (every process computes the same global ordering)
-            acc = datas[0]
-            for d in datas[1:]:
-                acc = acc + d
+            acc = _acc_reduce(datas, op)
             if op == "mean":
-                raise MXNetError("multi-process all_reduce(mean) needs one "
-                                 "copy per local device")
+                # mean = global sum / global copy count. The local copy
+                # count rides along as one extra element through the SAME
+                # cross-process sum, so per-process copy counts may differ
+                # (within this branch — see the SPMD contract above).
+                mean_unpack = (acc.shape, acc.dtype)
+                acc = jnp.concatenate(
+                    [acc.reshape(-1).astype(jnp.float32),
+                     jnp.asarray([float(len(datas))], jnp.float32)])
+                op = "sum"
             by_proc: Dict[int, Any] = {}
             for d in jax.devices():
                 if d.process_index not in by_proc or d.id < by_proc[d.process_index].id:
@@ -154,7 +174,16 @@ def all_reduce(arrays: List[Any], op: str = "sum"):
         # The jit output is replicated over the GLOBAL mesh; a global jax.Array
         # is not addressable (asnumpy would raise) outside collectives, so hand
         # back this process's fully-replicated local shard as a plain array.
-        return reduced.addressable_shards[0].data
+        reduced = reduced.addressable_shards[0].data
+    if mean_unpack is not None:
+        out_shape, out_dtype = mean_unpack
+        # match the other mean paths' dtype promotion (acc / count, the
+        # true-divide result type) — NOT a cast back to the input dtype,
+        # which would truncate integer means
+        div_dtype = jnp.result_type(out_dtype, jnp.float32) \
+            if not jnp.issubdtype(out_dtype, jnp.floating) else out_dtype
+        reduced = (reduced[:-1] / reduced[-1]).reshape(out_shape) \
+            .astype(div_dtype)
     return reduced
 
 

@@ -1,0 +1,74 @@
+"""Per-branch exact-value checks for ``parallel.all_reduce`` across real
+OS processes (VERDICT r4 item 10): the one-copy-per-local-device path and
+the pre-reduce fallback (arbitrary local copy count) for sum / mean / max /
+min. Launched as ``python tools/launch.py -n 2 -- python
+tests/nightly/dist_allreduce_branches.py``.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from mxnet_tpu import kvstore, parallel
+
+
+def main():
+    assert kvstore.init_distributed(), "launcher env missing"
+    import jax
+
+    rank = jax.process_index()
+    nw = jax.process_count()
+    local = jax.local_devices()
+    shape = (3, 4)
+
+    # ---- branch: one copy per local device ------------------------------
+    def per_device_copies():
+        return [jax.device_put(
+            np.full(shape, float(rank + 1), np.float32), d) for d in local]
+
+    got = np.asarray(parallel.all_reduce(per_device_copies(), "sum"))
+    expect = sum((r + 1) * len(local) for r in range(nw))
+    np.testing.assert_allclose(got, np.full(shape, expect), rtol=1e-6)
+    print("rank %d: BRANCH_PER_DEVICE_SUM_OK" % rank)
+
+    n_copies = nw * len(local)
+    got = np.asarray(parallel.all_reduce(per_device_copies(), "mean"))
+    np.testing.assert_allclose(got, np.full(shape, expect / n_copies),
+                               rtol=1e-6)
+    print("rank %d: BRANCH_PER_DEVICE_MEAN_OK" % rank)
+
+    got = np.asarray(parallel.all_reduce(per_device_copies(), "max"))
+    np.testing.assert_allclose(got, np.full(shape, float(nw)), rtol=1e-6)
+    got = np.asarray(parallel.all_reduce(per_device_copies(), "min"))
+    np.testing.assert_allclose(got, np.full(shape, 1.0), rtol=1e-6)
+    print("rank %d: BRANCH_PER_DEVICE_MAXMIN_OK" % rank)
+
+    # ---- branch: pre-reduce (len(copies) != len(local_devices)) ---------
+    k = len(local) * 2 + 1  # deliberately not a multiple of local devices
+    vals = [float(rank * 10 + i) for i in range(k)]
+    copies = [np.full(shape, v, np.float32) for v in vals]
+
+    got = np.asarray(parallel.all_reduce(list(copies), "sum"))
+    expect = sum(r * 10 + i for r in range(nw) for i in range(k))
+    np.testing.assert_allclose(got, np.full(shape, expect), rtol=1e-6)
+    print("rank %d: BRANCH_PREREDUCE_SUM_OK" % rank)
+
+    got = np.asarray(parallel.all_reduce(list(copies), "mean"))
+    np.testing.assert_allclose(got, np.full(shape, expect / (nw * k)),
+                               rtol=1e-5)
+    print("rank %d: BRANCH_PREREDUCE_MEAN_OK" % rank)
+
+    got = np.asarray(parallel.all_reduce(list(copies), "max"))
+    expect_max = max(r * 10 + i for r in range(nw) for i in range(k))
+    np.testing.assert_allclose(got, np.full(shape, expect_max), rtol=1e-6)
+    print("rank %d: BRANCH_PREREDUCE_MAX_OK" % rank)
+
+    got = np.asarray(parallel.all_reduce(list(copies), "min"))
+    np.testing.assert_allclose(got, np.zeros(shape), atol=1e-6)
+    print("rank %d: BRANCH_PREREDUCE_MIN_OK" % rank)
+
+
+if __name__ == "__main__":
+    main()

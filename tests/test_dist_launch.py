@@ -47,3 +47,48 @@ def test_launch_cli_rejects_empty_command():
         [sys.executable, str(REPO / "tools" / "launch.py"), "-n", "2"],
         capture_output=True, text=True, timeout=60)
     assert out.returncode != 0
+
+
+@pytest.mark.slow
+def test_dist_sync_kvstore_four_workers():
+    """Scale the exact-value kvstore assertions past n=2 (the reference's
+    nightly runs 7 workers, ci/docker/runtime_functions.sh:805-812)."""
+    out = _launch(4, REPO / "tests" / "nightly" / "dist_sync_kvstore.py",
+                  timeout=600)
+    assert out.returncode == 0, out.stdout[-4000:] + out.stderr[-2000:]
+    for rank in range(4):
+        for marker in ("DIST_KVSTORE_OK", "DIST_TRAINER_OK",
+                       "DIST_HEARTBEAT_OK", "DIST_RING_ATTENTION_OK"):
+            assert ("rank %d: %s" % (rank, marker)) in out.stdout, \
+                out.stdout[-4000:]
+
+
+@pytest.mark.slow
+def test_all_reduce_branches_multiprocess():
+    """Every all_reduce code path (per-device and pre-reduce fallback,
+    sum/mean/max/min) with exact values across 2 OS processes."""
+    out = _launch(2, REPO / "tests" / "nightly" / "dist_allreduce_branches.py")
+    assert out.returncode == 0, out.stdout[-4000:] + out.stderr[-2000:]
+    for rank in (0, 1):
+        for marker in ("BRANCH_PER_DEVICE_SUM_OK", "BRANCH_PER_DEVICE_MEAN_OK",
+                       "BRANCH_PER_DEVICE_MAXMIN_OK",
+                       "BRANCH_PREREDUCE_SUM_OK", "BRANCH_PREREDUCE_MEAN_OK",
+                       "BRANCH_PREREDUCE_MAX_OK", "BRANCH_PREREDUCE_MIN_OK"):
+            assert ("rank %d: %s" % (rank, marker)) in out.stdout, \
+                out.stdout[-4000:]
+
+
+@pytest.mark.slow
+def test_worker_kill_detection_and_elastic_resume():
+    """Rank 2 dies hard mid-job; survivors must observe it via
+    get_dead_nodes and run_elastic must resume from the last committed
+    checkpoint (reference GetDeadNodes + is_recovery flow)."""
+    out = _launch(3, REPO / "tests" / "nightly" / "dist_elastic_kill.py",
+                  timeout=300)
+    assert out.returncode == 0, out.stdout[-4000:] + out.stderr[-2000:]
+    assert "rank 2: DYING_NOW" in out.stdout
+    for rank in (0, 1):
+        assert ("rank %d: DEAD_NODE_DETECTED" % rank) in out.stdout, \
+            out.stdout[-4000:]
+        assert ("rank %d: ELASTIC_RESUME_OK" % rank) in out.stdout, \
+            out.stdout[-4000:]
