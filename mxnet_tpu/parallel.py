@@ -152,9 +152,10 @@ def all_reduce(arrays: List[Any], op: str = "sum"):
                 # cross-process sum, so per-process copy counts may differ
                 # (within this branch — see the SPMD contract above).
                 mean_unpack = (acc.shape, acc.dtype)
+                pack_dtype = jnp.result_type(acc.dtype, jnp.float32)
                 acc = jnp.concatenate(
-                    [acc.reshape(-1).astype(jnp.float32),
-                     jnp.asarray([float(len(datas))], jnp.float32)])
+                    [acc.reshape(-1).astype(pack_dtype),
+                     jnp.asarray([float(len(datas))], pack_dtype)])
                 op = "sum"
             by_proc: Dict[int, Any] = {}
             for d in jax.devices():

@@ -197,3 +197,38 @@ def test_optimizer_class_clip_wd_ordering():
     expect = w_np - lr * (g_eff / np.sqrt(h + 1e-7) + wd * w_np)
     np.testing.assert_allclose(w.asnumpy(), expect, rtol=1e-5)
     np.testing.assert_allclose(np.asarray(st), h, rtol=1e-6)
+
+
+def test_remaining_update_ops_finite_and_consistent():
+    """ftml_update / rmspropalex_update / mp_sgd_mom_update: one step each,
+    finite outputs and hand-computed first-step values."""
+    w_np, g_np = _wg()
+    z = np.zeros_like(w_np)
+
+    w1, d1, v1, z1 = invoke("ftml_update", mx.nd.array(w_np),
+                            mx.nd.array(g_np), mx.nd.array(z),
+                            mx.nd.array(z), mx.nd.array(z), lr=0.1, t=1)
+    for o in (w1, d1, v1, z1):
+        assert np.isfinite(o.asnumpy()).all()
+    # first step: v = (1-b2) g^2; d = (1-b1)/lr (sqrt(v/(1-b2)) + eps)
+    v_e = (1 - 0.999) * g_np ** 2
+    d_e = (1 - 0.6) / 0.1 * (np.sqrt(v_e / (1 - 0.999)) + 1e-8)
+    np.testing.assert_allclose(v1.asnumpy(), v_e, rtol=1e-5)
+    np.testing.assert_allclose(d1.asnumpy(), d_e, rtol=1e-5)
+
+    w1, n1, g1, dl1 = invoke("rmspropalex_update", mx.nd.array(w_np),
+                             mx.nd.array(g_np), mx.nd.array(z),
+                             mx.nd.array(z), mx.nd.array(z), lr=0.1)
+    n_e = (1 - 0.95) * g_np ** 2
+    g_e = (1 - 0.95) * g_np
+    dl_e = -0.1 * g_np / np.sqrt(n_e - g_e ** 2 + 1e-8)
+    np.testing.assert_allclose(n1.asnumpy(), n_e, rtol=1e-5)
+    np.testing.assert_allclose(w1.asnumpy(), w_np + dl_e, rtol=1e-4)
+
+    w16 = w_np.astype(np.float16)
+    w1, m1, w32 = invoke("mp_sgd_mom_update", mx.nd.array(w16),
+                         mx.nd.array(g_np.astype(np.float16)),
+                         mx.nd.array(z), mx.nd.array(w_np),
+                         lr=0.1, momentum=0.9)
+    np.testing.assert_allclose(w32.asnumpy(), w_np - 0.1 * g_np, rtol=1e-3)
+    assert w1.asnumpy().dtype == np.float16
