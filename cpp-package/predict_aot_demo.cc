@@ -1,0 +1,121 @@
+// Standalone AOT inference runner — NO Python dependency.
+//
+// The TPU-native counterpart of the reference's amalgamation build
+// (amalgamation/README.md:1-13: a single predict-only library with zero
+// Python). Loads the SavedModel produced by mxnet_tpu.aot.export_model
+// (jax2tf-wrapped StableHLO, weights baked in) through the TensorFlow C
+// API and runs one forward pass.
+//
+// Usage: predict_aot_demo <export_dir> <in_tensor> <out_tensor>
+//                         <n_elements_in>
+//   reads float32 input from stdin (binary), writes float32 output to
+//   stdout (binary); diagnostics go to stderr.
+//
+// Build (see tests/test_aot_predict.py):
+//   g++ -std=c++17 predict_aot_demo.cc -I<tf>/include \
+//       <tf>/libtensorflow_cc.so.2 <tf>/libtensorflow_framework.so.2 \
+//       -Wl,-rpath,<tf> -o predict_aot_demo
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "tensorflow/c/c_api.h"
+
+namespace {
+
+void CheckOk(TF_Status* status, const char* what) {
+  if (TF_GetCode(status) != TF_OK) {
+    std::fprintf(stderr, "%s: %s\n", what, TF_Message(status));
+    std::exit(2);
+  }
+}
+
+// "serving_default_data:0" -> (op name, output index)
+std::pair<std::string, int> SplitTensorName(const std::string& name) {
+  auto colon = name.rfind(':');
+  if (colon == std::string::npos) return {name, 0};
+  return {name.substr(0, colon), std::atoi(name.c_str() + colon + 1)};
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 5) {
+    std::fprintf(stderr,
+                 "usage: %s <export_dir> <in_tensor> <out_tensor> <n_in>\n",
+                 argv[0]);
+    return 1;
+  }
+  const char* export_dir = argv[1];
+  const auto in_name = SplitTensorName(argv[2]);
+  const auto out_name = SplitTensorName(argv[3]);
+  const long n_in = std::atol(argv[4]);
+
+  TF_Status* status = TF_NewStatus();
+  TF_Graph* graph = TF_NewGraph();
+  TF_SessionOptions* opts = TF_NewSessionOptions();
+  const char* tags[] = {"serve"};
+  std::string sm_dir = std::string(export_dir) + "/saved_model";
+  TF_Session* session = TF_LoadSessionFromSavedModel(
+      opts, nullptr, sm_dir.c_str(), tags, 1, graph, nullptr, status);
+  CheckOk(status, "LoadSessionFromSavedModel");
+  std::fprintf(stderr, "loaded %s\n", sm_dir.c_str());
+
+  TF_Operation* in_op = TF_GraphOperationByName(graph, in_name.first.c_str());
+  TF_Operation* out_op = TF_GraphOperationByName(graph, out_name.first.c_str());
+  if (!in_op || !out_op) {
+    std::fprintf(stderr, "tensor op not found (in=%s out=%s)\n",
+                 in_name.first.c_str(), out_name.first.c_str());
+    return 2;
+  }
+  TF_Output in_port{in_op, in_name.second};
+  TF_Output out_port{out_op, out_name.second};
+
+  // input element count + shape from the graph itself — argv's count is
+  // only cross-checked, never trusted (a short buffer under a larger
+  // declared shape would make SessionRun read out of bounds)
+  int ndims = TF_GraphGetTensorNumDims(graph, in_port, status);
+  CheckOk(status, "GetTensorNumDims");
+  std::vector<int64_t> dims(ndims);
+  TF_GraphGetTensorShape(graph, in_port, dims.data(), ndims, status);
+  CheckOk(status, "GetTensorShape");
+  long graph_n = 1;
+  for (int64_t d : dims) graph_n *= (d > 0 ? d : 1);
+  if (graph_n != n_in) {
+    std::fprintf(stderr,
+                 "input element count mismatch: graph wants %ld, got %ld\n",
+                 graph_n, n_in);
+    return 1;
+  }
+
+  std::vector<float> input(n_in);
+  if (std::fread(input.data(), sizeof(float), n_in, stdin) !=
+      static_cast<size_t>(n_in)) {
+    std::fprintf(stderr, "short read on stdin (want %ld floats)\n", n_in);
+    return 1;
+  }
+  TF_Tensor* in_tensor = TF_AllocateTensor(TF_FLOAT, dims.data(), ndims,
+                                           n_in * sizeof(float));
+  std::memcpy(TF_TensorData(in_tensor), input.data(), n_in * sizeof(float));
+
+  TF_Tensor* out_tensor = nullptr;
+  TF_SessionRun(session, nullptr, &in_port, &in_tensor, 1, &out_port,
+                &out_tensor, 1, nullptr, 0, nullptr, status);
+  CheckOk(status, "SessionRun");
+
+  const size_t out_bytes = TF_TensorByteSize(out_tensor);
+  std::fwrite(TF_TensorData(out_tensor), 1, out_bytes, stdout);
+  std::fflush(stdout);
+  std::fprintf(stderr, "wrote %zu output bytes\n", out_bytes);
+
+  TF_DeleteTensor(in_tensor);
+  TF_DeleteTensor(out_tensor);
+  TF_CloseSession(session, status);
+  TF_DeleteSession(session, status);
+  TF_DeleteSessionOptions(opts);
+  TF_DeleteGraph(graph);
+  TF_DeleteStatus(status);
+  return 0;
+}

@@ -1,0 +1,142 @@
+"""AOT no-Python deployment (the reference's amalgamation, TPU-native).
+
+The reference's ``amalgamation/`` concatenates the C++ core into one
+predict-only library with zero Python dependency
+(``amalgamation/README.md:1-13``). The TPU-idiomatic equivalent exports the
+traced inference function ONCE and ships two artifacts:
+
+* ``model.stablehlo`` — a versioned, portable ``jax.export`` serialization
+  of the jitted forward. This is the TPU-serving deployment format: any
+  PJRT runtime (TPU pods included) can load and run it; Python can
+  round-trip it with :func:`load_stablehlo`.
+* ``saved_model/`` — the same StableHLO wrapped as a TF SavedModel
+  (jax2tf native lowering, weights baked in as constants), runnable from
+  plain C/C++ through the TensorFlow C API with **no libpython** —
+  ``cpp-package/predict_aot_demo.cc`` is the standalone runner.
+
+``manifest.json`` records the graph tensor names the C runner needs.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Sequence
+
+import numpy as np
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["export_model", "load_stablehlo", "predict_stablehlo"]
+
+
+def _as_jax_fn(net):
+    """Jittable forward closure over the net's current parameters.
+    Multi-output blocks export as a tuple of arrays."""
+    import jax.numpy as jnp
+
+    def fn(x):
+        from .ndarray.ndarray import NDArray as ND
+
+        out = net(ND(jnp.asarray(x), None))
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    return fn
+
+
+def export_model(net, input_shape: Sequence[int], out_dir: str,
+                 dtype="float32", save_tf: bool = True):
+    """Export an initialized Gluon block's forward for deployment.
+
+    Parameters
+    ----------
+    net : initialized (and ideally hybridized) Gluon block
+    input_shape : example input shape, e.g. ``(1, 3, 224, 224)``
+    out_dir : artifact directory (created)
+    save_tf : also write the TF SavedModel for the no-Python C runner
+
+    Returns the manifest dict.
+    """
+    import jax
+    import jax.export as jexport
+    import jax.numpy as jnp
+
+    os.makedirs(out_dir, exist_ok=True)
+    fn = _as_jax_fn(net)
+    spec = jax.ShapeDtypeStruct(tuple(input_shape), jnp.dtype(dtype))
+
+    exported = jexport.export(jax.jit(fn))(spec)
+    with open(os.path.join(out_dir, "model.stablehlo"), "wb") as f:
+        f.write(exported.serialize())
+
+    manifest = {
+        "format": "mxnet_tpu-aot-v1",
+        "input_shape": list(input_shape),
+        "input_dtype": str(dtype),
+        "outputs": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+                    for a in exported.out_avals],
+        # single-output convenience aliases
+        "output_shape": list(exported.out_avals[0].shape),
+        "output_dtype": str(exported.out_avals[0].dtype),
+    }
+
+    def _write_manifest():
+        with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=1)
+
+    # the pure-PJRT artifact is complete at this point: commit its manifest
+    # BEFORE the optional TF step so a missing tensorflow cannot leave a
+    # partial export behind
+    _write_manifest()
+
+    if save_tf:
+        import tensorflow as tf
+        from jax.experimental import jax2tf
+
+        tf_fn = jax2tf.convert(fn, with_gradient=False)
+        module = tf.Module()
+        module.f = tf.function(
+            tf_fn, autograph=False,
+            input_signature=[tf.TensorSpec(tuple(input_shape), dtype,
+                                           name="data")])
+        sm_dir = os.path.join(out_dir, "saved_model")
+        tf.saved_model.save(module, sm_dir,
+                            signatures=module.f.get_concrete_function())
+
+        from tensorflow.python.tools import saved_model_utils
+
+        meta = saved_model_utils.get_meta_graph_def(sm_dir, "serve")
+        sig = meta.signature_def["serving_default"]
+        manifest["tf_input_tensor"] = list(sig.inputs.values())[0].name
+        manifest["tf_output_tensor"] = list(sig.outputs.values())[0].name
+        manifest["tf_tags"] = "serve"
+
+        _write_manifest()
+    return manifest
+
+
+_LOADED = {}  # (path, mtime) -> Exported
+
+
+def load_stablehlo(out_dir: str):
+    """Deserialize the exported function (jax.export round-trip).
+    Memoized on (path, mtime) so a serving loop pays the load once."""
+    import jax.export as jexport
+
+    path = os.path.join(out_dir, "model.stablehlo")
+    key = (path, os.path.getmtime(path))
+    cached = _LOADED.get(key)
+    if cached is None:
+        with open(path, "rb") as f:
+            cached = jexport.deserialize(f.read())
+        _LOADED.clear()  # one live artifact per process is the common case
+        _LOADED[key] = cached
+    return cached
+
+
+def predict_stablehlo(out_dir: str, x) -> np.ndarray:
+    """Run the portable artifact in-process (the TPU-serving path)."""
+    exported = load_stablehlo(out_dir)
+    data = x._data if isinstance(x, NDArray) else np.asarray(x)
+    return np.asarray(exported.call(data))
