@@ -78,11 +78,23 @@ int main(int argc, char** argv) {
   // declared shape would make SessionRun read out of bounds)
   int ndims = TF_GraphGetTensorNumDims(graph, in_port, status);
   CheckOk(status, "GetTensorNumDims");
+  if (ndims < 0) {
+    std::fprintf(stderr, "input tensor has unknown rank; re-export with a "
+                         "fully static input_signature\n");
+    return 1;
+  }
   std::vector<int64_t> dims(ndims);
   TF_GraphGetTensorShape(graph, in_port, dims.data(), ndims, status);
   CheckOk(status, "GetTensorShape");
   long graph_n = 1;
-  for (int64_t d : dims) graph_n *= (d > 0 ? d : 1);
+  for (int64_t d : dims) {
+    if (d <= 0) {
+      std::fprintf(stderr, "input tensor has a dynamic dim; re-export with "
+                           "a fully static input_signature\n");
+      return 1;
+    }
+    graph_n *= d;
+  }
   if (graph_n != n_in) {
     std::fprintf(stderr,
                  "input element count mismatch: graph wants %ld, got %ld\n",

@@ -135,8 +135,12 @@ def load_stablehlo(out_dir: str):
     return cached
 
 
-def predict_stablehlo(out_dir: str, x) -> np.ndarray:
-    """Run the portable artifact in-process (the TPU-serving path)."""
+def predict_stablehlo(out_dir: str, x):
+    """Run the portable artifact in-process (the TPU-serving path).
+    Single-output models return one ndarray; multi-output models a list."""
     exported = load_stablehlo(out_dir)
     data = x._data if isinstance(x, NDArray) else np.asarray(x)
-    return np.asarray(exported.call(data))
+    out = exported.call(data)
+    if isinstance(out, (list, tuple)):
+        return [np.asarray(o) for o in out]
+    return np.asarray(out)

@@ -8,12 +8,13 @@
     data -> quantize -> quantized_op -> requantize -> dequantize -> ...
 
 Weights/biases are quantized OFFLINE into the returned arg dict (their
-ranges embedded as constants); activations use either in-graph dynamic
-min/max (``calib_mode='none'``) or ranges collected from calibration
-batches (``calib_mode='naive'``, baked into quantize consts and the
-requantize calib attrs — the reference's entropy mode reduces to better
-thresholds for the same plumbing and is accepted as an alias of naive
-here).
+ranges embedded as constants); activations use in-graph dynamic min/max
+(``calib_mode='none'``), ranges collected from calibration batches
+(``calib_mode='naive'``), or KL-divergence-optimal clipping thresholds
+(``calib_mode='entropy'`` — the reference's algorithm,
+contrib/quantization.py:244-317: histogram the activations, scan
+candidate thresholds, pick the one whose 255-bin quantized distribution
+minimizes KL(P||Q) against the clipped reference distribution).
 """
 from __future__ import annotations
 
@@ -40,11 +41,86 @@ def _quantize_params_int8(arr):
     return nd_mod.array(q, dtype="int8"), r
 
 
+_MAX_CALIB_SAMPLES = 1 << 20  # per-tensor cap for the entropy histogram
+
+
+def _smooth_distribution(p, eps=0.0001):
+    """Spread eps mass onto zero bins so KL is defined (reference
+    contrib/quantization.py:_smooth_distribution)."""
+    is_zeros = (p == 0).astype(np.float32)
+    is_nonzeros = (p != 0).astype(np.float32)
+    n_zeros = is_zeros.sum()
+    n_nonzeros = p.size - n_zeros
+    if not n_nonzeros:
+        raise MXNetError("all-zero calibration distribution")
+    eps1 = eps * n_zeros / n_nonzeros
+    hist = p.astype(np.float32)
+    hist += eps * is_zeros + (-eps1) * is_nonzeros
+    return hist
+
+
+def _kl_divergence(p, q):
+    p = p / p.sum()
+    q = q / q.sum()
+    mask = p > 0
+    return float(np.sum(p[mask] * np.log(p[mask] / q[mask])))
+
+
+def _get_optimal_threshold(arr, num_bins=8001, num_quantized_bins=255):
+    """KL-optimal symmetric clipping threshold (reference
+    contrib/quantization.py:244-317 _get_optimal_threshold): histogram the
+    samples over (-th, th); for every candidate threshold, form the clipped
+    reference distribution P (outliers folded into the edge bins) and its
+    255-bin quantization Q expanded back to P's support; minimize KL(P||Q).
+    Returns (min_val, max_val, opt_min, opt_max)."""
+    arr = np.asarray(arr)
+    min_val = float(arr.min())
+    max_val = float(arr.max())
+    th = max(abs(min_val), abs(max_val), 1e-30)
+    hist, hist_edges = np.histogram(arr, bins=num_bins, range=(-th, th))
+    zero_bin = num_bins // 2
+    half_q = num_quantized_bins // 2
+
+    best_div = np.inf
+    best_th = th
+    for i in range(half_q, zero_bin + 1):
+        start, stop = zero_bin - i, zero_bin + i + 1
+        sliced = hist[start:stop].astype(np.float64)
+        p = sliced.copy()
+        p[0] += hist[:start].sum()
+        p[-1] += hist[stop:].sum()
+        if p.sum() == 0:
+            continue
+        is_nonzero = (sliced != 0)
+        # quantize the 2i+1 bins into num_quantized_bins, then expand back
+        num_merged = sliced.size // num_quantized_bins
+        q = np.zeros(sliced.size, np.float64)
+        for j in range(num_quantized_bins):
+            a = j * num_merged
+            b = sliced.size if j == num_quantized_bins - 1                 else (j + 1) * num_merged
+            seg = sliced[a:b]
+            nz = is_nonzero[a:b].sum()
+            if nz:
+                q[a:b] = is_nonzero[a:b] * (seg.sum() / nz)
+        p = _smooth_distribution(p)
+        try:
+            q = _smooth_distribution(q)
+        except MXNetError:
+            continue  # fully-zero candidate window
+        div = _kl_divergence(p, q)
+        if div < best_div:
+            best_div = div
+            best_th = (i + 0.5) * (2.0 * th / num_bins)
+    return min_val, max_val, -best_th, best_th
+
+
 def _collect_thresholds(sym, arg_params, aux_params, calib_data,
-                        collect_names, num_calib_examples, ctx):
-    """Run calibration batches through the FLOAT graph and record min/max
-    of every tensor in ``collect_names`` (reference _LayerOutputCollector /
-    calib_mode='naive')."""
+                        collect_names, num_calib_examples, ctx,
+                        mode="naive"):
+    """Run calibration batches through the FLOAT graph. ``naive`` records
+    min/max of every tensor in ``collect_names`` (reference
+    _LayerOutputCollector); ``entropy`` additionally keeps a (capped)
+    sample of each tensor and computes the KL-optimal threshold."""
     from .. import symbol as sym_mod
 
     internals = sym.get_internals()
@@ -53,6 +129,11 @@ def _collect_thresholds(sym, arg_params, aux_params, calib_data,
     group = sym_mod.Group([internals[n] for n in wanted])
 
     stats: Dict[str, List[float]] = {n: [np.inf, -np.inf] for n in wanted}
+    samples: Dict[str, np.ndarray] = {
+        n: np.empty(_MAX_CALIB_SAMPLES, np.float32) for n in wanted}
+    counts: Dict[str, int] = {n: 0 for n in wanted}     # filled slots
+    seen_elems: Dict[str, int] = {n: 0 for n in wanted}  # stream length
+    rng = np.random.RandomState(0)
     seen = 0
     executors = {}  # bind once per input shape (a rebind per batch would
     #                 recompile the whole float graph every iteration)
@@ -75,10 +156,44 @@ def _collect_thresholds(sym, arg_params, aux_params, calib_data,
             a = o.asnumpy()
             stats[name][0] = min(stats[name][0], float(a.min()))
             stats[name][1] = max(stats[name][1], float(a.max()))
+            if mode == "entropy":
+                # reservoir sampling over the whole calibration stream:
+                # every element of every batch has ~cap/seen probability of
+                # being in the histogram, so later batches keep
+                # contributing after the buffer fills (first-batch-only
+                # sampling would bias the KL threshold)
+                flat = np.asarray(a.reshape(-1), np.float32)
+                buf = samples[name]
+                n = counts[name]
+                room = _MAX_CALIB_SAMPLES - n
+                if room > 0:
+                    take = min(room, flat.size)
+                    buf[n:n + take] = flat[:take]
+                    counts[name] = n + take
+                    rest = flat[take:]
+                else:
+                    rest = flat
+                if rest.size:
+                    total = seen_elems[name] + flat.size
+                    n_repl = rng.binomial(
+                        rest.size, _MAX_CALIB_SAMPLES / max(total, 1))
+                    if n_repl:
+                        n_repl = min(n_repl, rest.size)
+                        slots = rng.randint(0, _MAX_CALIB_SAMPLES, n_repl)
+                        vals = rest[rng.randint(0, rest.size, n_repl)]
+                        buf[slots] = vals
+                seen_elems[name] += flat.size
         seen += batch.data[0].shape[0]
         if num_calib_examples is not None and seen >= num_calib_examples:
             break
-    return {n: (mn, mx) for n, (mn, mx) in stats.items()}
+    if mode != "entropy":
+        return {n: (mn, mx) for n, (mn, mx) in stats.items()}
+    out = {}
+    for n, (mn, mx) in stats.items():
+        arr = samples[n][:counts[n]] if counts[n] else np.zeros(1)
+        _, _, opt_mn, opt_mx = _get_optimal_threshold(arr)
+        out[n] = (opt_mn, opt_mx)
+    return out
 
 
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),
@@ -117,7 +232,7 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             collect.append("%s_output" % node.name)
         thresholds = _collect_thresholds(
             sym, arg_params, aux_params, calib_data, set(collect),
-            num_calib_examples, ctx)
+            num_calib_examples, ctx, mode=calib_mode)
 
     qarg_params = dict(arg_params)
     new_syms: Dict[int, Symbol] = {}

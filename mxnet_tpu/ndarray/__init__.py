@@ -27,6 +27,9 @@ from .ndarray import (
     modulo,
     true_divide,
     imdecode,
+    to_dlpack_for_read,
+    to_dlpack_for_write,
+    from_dlpack,
 )
 from . import random  # noqa: F401
 from . import sparse  # noqa: F401

@@ -116,6 +116,34 @@ class NDArray:
 
     wait_to_write = wait_to_read
 
+    # ------------------------------------------------------------------
+    # DLPack interop (reference c_api.cc MXNDArrayToDLPack /
+    # MXNDArrayFromDLPack; SURVEY §2.2 keeps dlpack as the interop ABI)
+    # ------------------------------------------------------------------
+    def __dlpack__(self, **kwargs):
+        self._data.block_until_ready()
+        return self._data.__dlpack__(**kwargs)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
+    def to_dlpack_for_read(self):
+        """Zero-copy DLPack capsule of this array (reference
+        mx.nd.to_dlpack_for_read). ``__dlpack__`` syncs first, so the
+        consumer sees completed data."""
+        return self.__dlpack__()
+
+    def to_dlpack_for_write(self):
+        """NOT supported: XLA buffers are immutable and may be shared, so
+        an external in-place write through a capsule would corrupt every
+        alias invisibly. Known deviation from the reference (which hands
+        out mutable views); consumers should write into their own tensor
+        and re-import via from_dlpack."""
+        raise MXNetError(
+            "to_dlpack_for_write is not supported on immutable XLA "
+            "buffers; use to_dlpack_for_read and re-import the modified "
+            "tensor with from_dlpack")
+
     def astype(self, dtype, copy=True) -> "NDArray":
         d = np_dtype(dtype) if isinstance(dtype, str) else dtype
         if not copy and self._data.dtype == d:
@@ -825,3 +853,20 @@ def imdecode(buf, **kwargs):
     from .. import image as _image
 
     return _image.imdecode(buf, **kwargs)
+
+
+def to_dlpack_for_read(data: "NDArray"):
+    """Module-level form (reference mx.nd.to_dlpack_for_read)."""
+    return data.to_dlpack_for_read()
+
+
+def to_dlpack_for_write(data: "NDArray"):
+    return data.to_dlpack_for_write()
+
+
+def from_dlpack(obj, ctx: Optional[Context] = None) -> "NDArray":
+    """Wrap a DLPack-compatible external tensor (a capsule or any object
+    with __dlpack__, e.g. a torch tensor) as an NDArray without a host
+    round-trip (reference mx.nd.from_dlpack)."""
+    arr = jax.dlpack.from_dlpack(obj)
+    return NDArray(arr, ctx or current_context())

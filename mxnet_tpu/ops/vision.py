@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..base import MXNetError
 from .registry import REQUIRED, register
 from . import pallas_kernels
 
@@ -579,6 +580,116 @@ def _psroi_pooling(attrs, data, rois):
         return jnp.stack(outs, axis=-2)  # (odim, P, P)
 
     return jax.vmap(one)(rois)
+
+
+@register("_contrib_DeformablePSROIPooling",
+          params={"spatial_scale": (float, REQUIRED),
+                  "output_dim": (int, REQUIRED),
+                  "group_size": (int, REQUIRED),
+                  "pooled_size": (int, REQUIRED),
+                  "part_size": (int, 0),
+                  "sample_per_part": (int, 1),
+                  "trans_std": (float, 0.0),
+                  "no_trans": (bool, False)},
+          inputs=lambda a: ["data", "rois"]
+          + ([] if a.get("no_trans") else ["trans"]),
+          aliases=("DeformablePSROIPooling",))
+def _deformable_psroi_pooling(attrs, data, rois, trans=None):
+    """Deformable position-sensitive RoI pooling (reference
+    src/operator/contrib/deformable_psroi_pooling.cc, Deformable R-FCN):
+    PSROIPooling whose bin (py, px) is shifted by a learned offset
+    ``trans[r, 2k:2k+2, py', px'] * trans_std * (roi w, h)`` — class-aware
+    when trans carries ``2*num_classes`` channels (class k owns output
+    channels ``[k*output_dim/num_classes, ...)``) — and sampled bilinearly
+    at ``sample_per_part``² points. All static loops, so the whole op
+    lowers to one fused XLA module of gathers."""
+    p = attrs.pooled_size
+    group = attrs.group_size or p
+    part = attrs.part_size or p
+    spp = attrs.sample_per_part
+    odim = attrs.output_dim
+    _b, c, h, w = data.shape
+
+    def bilinear(img, y, x):
+        """img (C,H,W); y,x per-channel vectors (C,) — bilinear sample.
+        Valid window is [-0.5, size-0.5] with edge clamping, matching the
+        reference kernel (deformable_psroi_pooling.cc: continue outside,
+        clamp inside)."""
+        ok = (y >= -0.5) & (y <= h - 0.5) & (x >= -0.5) & (x <= w - 0.5)
+        y = jnp.clip(y, 0.0, h - 1.0)
+        x = jnp.clip(x, 0.0, w - 1.0)
+        y0 = jnp.floor(y)
+        x0 = jnp.floor(x)
+        wy1 = y - y0
+        wx1 = x - x0
+        y0i = y0.astype(jnp.int32)
+        x0i = x0.astype(jnp.int32)
+        y1i = jnp.clip(y0i + 1, 0, h - 1)
+        x1i = jnp.clip(x0i + 1, 0, w - 1)
+        ci = jnp.arange(img.shape[0])
+        v = (img[ci, y0i, x0i] * (1 - wy1) * (1 - wx1)
+             + img[ci, y1i, x0i] * wy1 * (1 - wx1)
+             + img[ci, y0i, x1i] * (1 - wy1) * wx1
+             + img[ci, y1i, x1i] * wy1 * wx1)
+        return jnp.where(ok, v, 0.0), ok
+
+    # class-aware offsets (reference: num_classes = trans_ch/2,
+    # channels_each_class = output_dim/num_classes)
+    if trans is not None:
+        n_cls = max(1, trans.shape[1] // 2)
+        if odim % n_cls:
+            raise MXNetError(
+                "DeformablePSROIPooling: output_dim %d not divisible by "
+                "num_classes %d (trans has %d channels)"
+                % (odim, n_cls, trans.shape[1]))
+        class_of = jnp.arange(odim) // (odim // n_cls)  # (odim,)
+
+    def one(roi, tr):
+        bi = roi[0].astype(jnp.int32)
+        # reference uses half-pixel roi corners (round - 0.5 semantics)
+        x1 = jnp.round(roi[1]) * attrs.spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * attrs.spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * attrs.spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * attrs.spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bw, bh = rw / p, rh / p
+        sub_w, sub_h = bw / spp, bh / spp
+        img = data[bi]
+
+        rows = []
+        for py in range(p):
+            cols = []
+            for px in range(p):
+                part_y = min(py * part // p, part - 1)
+                part_x = min(px * part // p, part - 1)
+                if tr is None:
+                    dy = dx = jnp.zeros((odim,))
+                else:
+                    dx = tr[class_of * 2, part_y, part_x] \
+                        * attrs.trans_std * rw
+                    dy = tr[class_of * 2 + 1, part_y, part_x] \
+                        * attrs.trans_std * rh
+                gy = min(py * group // p, group - 1)
+                gx = min(px * group // p, group - 1)
+                chans = jnp.arange(odim) * group * group + gy * group + gx
+                maps = img[chans]
+                acc = jnp.zeros((odim,), data.dtype)
+                cnt = jnp.zeros((), data.dtype)
+                for iy in range(spp):
+                    for ix in range(spp):
+                        sy = y1 + py * bh + dy + (iy + 0.5) * sub_h
+                        sx = x1 + px * bw + dx + (ix + 0.5) * sub_w
+                        val, ok = bilinear(maps, sy, sx)
+                        acc = acc + val
+                        cnt = cnt + ok.astype(data.dtype)
+                cols.append(acc / jnp.maximum(cnt, 1.0))
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)  # (odim, P, P)
+
+    if attrs.no_trans or trans is None:
+        return jax.vmap(lambda r: one(r, None))(rois)
+    return jax.vmap(one)(rois, trans)
 
 
 @register("_contrib_count_sketch",

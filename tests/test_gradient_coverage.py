@@ -193,6 +193,12 @@ GRAD = {
         [A(1, 2, 6, 6)], c=[(1, np.array([[0, 0.5, 0.5, 4, 4]], np.float32))],
         attrs={"pooled_size": (2, 2), "spatial_scale": 1.0},
         rtol=3e-2, atol=5e-3),
+    "_contrib_DeformablePSROIPooling": spec(
+        [A(1, 4, 4, 4), A(1, 2, 2, 2) * 0.1],
+        c=[(1, np.array([[0, 1, 1, 3, 3]], np.float32))],
+        attrs={"spatial_scale": 1.0, "output_dim": 1, "group_size": 2,
+               "pooled_size": 2, "sample_per_part": 1, "trans_std": 0.1},
+        rtol=3e-2, atol=5e-3),
     "_contrib_PSROIPooling": spec(
         [A(1, 8, 6, 6)], c=[(1, np.array([[0, 0, 0, 4, 4]], np.float32))],
         attrs={"spatial_scale": 1.0, "output_dim": 2, "pooled_size": 2},

@@ -129,3 +129,42 @@ def test_quantize_model_excluded_and_errors():
     with pytest.raises(mx.MXNetError):
         mx.contrib.quantization.quantize_model(net, args, {},
                                                quantized_dtype="uint4")
+
+
+def test_entropy_calibration_beats_naive_on_outliers():
+    """KL threshold search (reference contrib/quantization.py:244-317):
+    on a distribution with rare extreme outliers, the entropy threshold
+    must clip well inside the absolute max, and quantizing with it must
+    reconstruct the bulk of the distribution with lower MSE than the
+    naive (min/max) threshold."""
+    from mxnet_tpu.contrib.quantization import _get_optimal_threshold
+
+    rs = np.random.RandomState(0)
+    bulk = rs.randn(200_000).astype(np.float32)     # ~N(0,1)
+    outliers = rs.choice([-60.0, 60.0], 32).astype(np.float32)
+    arr = np.concatenate([bulk, outliers])
+
+    mn, mx, opt_mn, opt_mx = _get_optimal_threshold(arr)
+    assert abs(mx) >= 59.0                      # naive range sees outliers
+    assert opt_mx < 15.0, opt_mx                # KL clips them away
+    assert opt_mn == -opt_mx                    # symmetric
+
+    def int8_roundtrip_mse(x, th):
+        q = np.clip(np.round(np.clip(x, -th, th) * (127.0 / th)), -127, 127)
+        return float(np.mean((q * (th / 127.0) - np.clip(x, -th, th)) ** 2))
+
+    naive_th = max(abs(mn), abs(mx))
+    mse_naive = int8_roundtrip_mse(bulk, naive_th)
+    mse_kl = int8_roundtrip_mse(bulk, opt_mx)
+    assert mse_kl < mse_naive / 10, (mse_kl, mse_naive)
+
+
+def test_entropy_calibration_no_outliers_close_to_naive():
+    """On a clean bounded distribution the KL threshold stays near the
+    true range (no over-clipping)."""
+    from mxnet_tpu.contrib.quantization import _get_optimal_threshold
+
+    rs = np.random.RandomState(1)
+    arr = rs.uniform(-2.0, 2.0, 100_000).astype(np.float32)
+    _, _, _, opt_mx = _get_optimal_threshold(arr)
+    assert 1.6 < opt_mx <= 2.01, opt_mx

@@ -124,3 +124,56 @@ def test_sync_batch_norm_global_stats_under_sharding():
     per_shard = np.concatenate([np.asarray(bn(jnp.asarray(x[i:i + 2])))
                                 for i in range(0, 16, 2)])
     assert not np.allclose(per_shard, np.asarray(ref), atol=1e-2)
+
+
+def test_deformable_psroi_pooling():
+    """reference src/operator/contrib/deformable_psroi_pooling.cc: with
+    zero offsets each output bin pools its own position-sensitive score
+    map; a positive x-offset on a horizontal ramp increases the sample."""
+    import numpy as np
+
+    from mxnet_tpu.ndarray.ndarray import invoke
+
+    p, group, odim = 2, 2, 2
+    c = odim * group * group  # 8 channels
+    # channel k is constant k -> bin value must equal mapped channel index
+    data = np.zeros((1, c, 8, 8), np.float32)
+    for k in range(c):
+        data[0, k] = k
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    trans = np.zeros((1, 2, p, p), np.float32)
+    out = invoke("_contrib_DeformablePSROIPooling",
+                 mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans),
+                 spatial_scale=1.0, output_dim=odim, group_size=group,
+                 pooled_size=p, trans_std=0.1, sample_per_part=2)
+    assert out.shape == (1, odim, p, p)
+    got = out.asnumpy()[0]
+    for ch in range(odim):
+        for py in range(p):
+            for px in range(p):
+                expect = ch * group * group + py * group + px
+                np.testing.assert_allclose(got[ch, py, px], expect,
+                                           rtol=1e-5)
+
+    # horizontal ramp: a positive x offset must increase the pooled value
+    ramp = np.tile(np.arange(8, dtype=np.float32), (8, 1))
+    data2 = np.broadcast_to(ramp, (1, c, 8, 8)).copy()
+    t0 = invoke("_contrib_DeformablePSROIPooling",
+                mx.nd.array(data2), mx.nd.array(rois), mx.nd.array(trans),
+                spatial_scale=1.0, output_dim=odim, group_size=group,
+                pooled_size=p, trans_std=0.1, sample_per_part=2).asnumpy()
+    trans_px = trans.copy()
+    trans_px[0, 0] = 1.0  # dx = trans_std * rw = 0.8 pixels
+    t1 = invoke("_contrib_DeformablePSROIPooling",
+                mx.nd.array(data2), mx.nd.array(rois),
+                mx.nd.array(trans_px),
+                spatial_scale=1.0, output_dim=odim, group_size=group,
+                pooled_size=p, trans_std=0.1, sample_per_part=2).asnumpy()
+    assert (t1 > t0 + 0.4).all(), (t0, t1)
+
+    # no_trans mode drops the trans input entirely
+    nt = invoke("_contrib_DeformablePSROIPooling",
+                mx.nd.array(data), mx.nd.array(rois),
+                spatial_scale=1.0, output_dim=odim, group_size=group,
+                pooled_size=p, no_trans=True, sample_per_part=2)
+    np.testing.assert_allclose(nt.asnumpy(), got[None], rtol=1e-5)
