@@ -88,8 +88,31 @@ def _time_iters(run_one, budget_s=30.0, max_iters=20):
 
 _PARTIAL = {"train": None, "infer_fp32": None, "infer_bf16": None,
             "train_bf16": None, "batch": None, "device": None,
-            "phase": "backend-init"}
+            "device_kind": None, "phase": "backend-init"}
 _PRINTED = threading.Event()
+
+# ResNet-50 v1 224x224 forward ≈ 3.86 GFLOPs/image (multiply-add counted
+# as 2); training step ≈ 3x forward (fwd + 2x bwd). Peak bf16 TFLOP/s by
+# chip; keys are substrings of the LOWERCASED jax device_kind, which reads
+# like "TPU v5 lite" / "TPU v5p" / "TPU v6 lite". Unknown chips fall back
+# to v5e so the number is at least comparable across runs.
+_RESNET50_FWD_GFLOP = 3.86
+_PEAK_TFLOPS = [("v6 lite", 918.0), ("v6e", 918.0),
+                ("v5 lite", 197.0), ("v5e", 197.0), ("v5litepod", 197.0),
+                ("v5p", 459.0), ("v4", 275.0)]
+
+
+def _mfu(img_per_sec, train, device_kind, fp32=False):
+    """Model FLOPs utilization: achieved model FLOP/s over chip peak.
+    fp32 runs divide by the fp32 peak (~half the bf16 MXU rate)."""
+    if not img_per_sec or QUICK:  # quick mode runs resnet18: not comparable
+        return None
+    kind = (device_kind or "").lower()
+    peak = next((v for k, v in _PEAK_TFLOPS if k in kind), 197.0)
+    if fp32:
+        peak *= 0.5
+    flops = _RESNET50_FWD_GFLOP * 1e9 * (3.0 if train else 1.0)
+    return round(img_per_sec * flops / (peak * 1e12), 6)
 
 
 def _emit(error=None):
@@ -115,6 +138,16 @@ def _emit(error=None):
             "train_bf16_img_s": _PARTIAL["train_bf16"],
             "batch": _PARTIAL["batch"],
             "device": _PARTIAL["device"],
+            "mfu_train_fp32": _mfu(train, True, _PARTIAL["device_kind"],
+                                   fp32=True),
+            "mfu_train_bf16": _mfu(_PARTIAL["train_bf16"], True,
+                                   _PARTIAL["device_kind"]),
+            "mfu_infer_bf16": _mfu(_PARTIAL["infer_bf16"], False,
+                                   _PARTIAL["device_kind"]),
+            "device_kind": _PARTIAL["device_kind"],
+            "mfu_note": "ResNet-50 3.86 GFLOP/img fwd, 3x for train; "
+                        "peak TFLOP/s by chip kind (v5e bf16 197, fp32 "
+                        "runs use half)",
             "baseline": "V100 train 298.51 / infer 1076.81 img/s "
                         "(docs/faq/perf.md:214,156)",
         },
@@ -164,6 +197,7 @@ def main():
     dev = devices[0]
     _PARTIAL["batch"] = batch
     _PARTIAL["device"] = str(dev)
+    _PARTIAL["device_kind"] = getattr(dev, "device_kind", str(dev))
     rng = np.random.RandomState(0)
     x_np = rng.rand(batch, 3, side, side).astype(np.float32)
     y_np = rng.randint(0, classes, (batch,))

@@ -1,0 +1,87 @@
+"""Inference throughput sweep across the model zoo — the counterpart of the
+reference's headline scoring benchmark
+(``example/image-classification/benchmark_score.py``, the script behind
+BASELINE.md's inference tables, docs/faq/perf.md:113-115).
+
+Measures img/s for each (network, batch size) after one compile, hybridized
+so each forward is a single cached XLA module. Run on the TPU chip for real
+numbers; on CPU it is a smoke/plumbing check.
+
+Run:  python example/image-classification/benchmark_score.py
+          [--networks resnet50_v1,mobilenet1_0] [--batch-sizes 1,32]
+          [--image-shape 3,224,224] [--dtype float32|bfloat16]
+
+Note: inception_v3 expects 3,299,299 — pass it via --image-shape.
+"""
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+from mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
+
+DEFAULT_NETS = ("resnet18_v1", "resnet50_v1", "mobilenet1_0",
+                "densenet121", "inception_v3")
+
+
+def score(network, batch, shape, dtype, budget_s):
+    import jax.numpy as jnp
+
+    net = getattr(vision, network)(classes=1000)
+    net.initialize()
+    rs = np.random.RandomState(0)
+    x_np = rs.rand(batch, *shape).astype(np.float32)
+    if dtype == "bfloat16":
+        net(nd.array(x_np))  # materialize params before the cast
+        net.cast("bfloat16")
+        x = mx.nd.NDArray(jnp.asarray(x_np, jnp.bfloat16), mx.cpu())
+    else:
+        x = nd.array(x_np)
+    net.hybridize()
+    # probe once to size the iteration count (dispatch is async: an
+    # unbounded enqueue loop would queue far past the time budget)
+    t0 = time.perf_counter()
+    net(x)._data.block_until_ready()  # compile
+    t0 = time.perf_counter()
+    net(x)._data.block_until_ready()
+    probe = time.perf_counter() - t0
+    iters = max(3, min(1000, int(budget_s / max(probe, 1e-6))))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = net(x)
+    out._data.block_until_ready()
+    return iters * batch / (time.perf_counter() - t0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--networks", default=",".join(DEFAULT_NETS))
+    ap.add_argument("--batch-sizes", default="1,32")
+    ap.add_argument("--image-shape", default="3,224,224")
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--seconds", type=float, default=5.0)
+    args = ap.parse_args()
+
+    shape = tuple(int(v) for v in args.image_shape.split(","))
+    nets = [n.strip() for n in args.networks.split(",") if n.strip()]
+    batches = [int(b) for b in args.batch_sizes.split(",")]
+
+    print("network, batch, %s img/s" % args.dtype)
+    for network in nets:
+        for batch in batches:
+            rate = score(network, batch, shape, args.dtype, args.seconds)
+            print("%s, %d, %.2f" % (network, batch, rate), flush=True)
+    print("BENCHMARK_SCORE_DONE")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,3 +72,10 @@ def test_sparse_fm_example():
     out = _run("sparse/fm.py", "--epochs", "12", "--num-samples", "192",
                "--feature-dim", "300", "--optimizer", "adagrad")
     assert "IMPROVED" in out
+
+
+def test_benchmark_score_example():
+    out = _run("image-classification/benchmark_score.py",
+               "--networks", "resnet18_v1", "--batch-sizes", "2",
+               "--image-shape", "3,32,32", "--seconds", "1")
+    assert "BENCHMARK_SCORE_DONE" in out
