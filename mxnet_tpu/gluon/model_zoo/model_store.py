@@ -1,0 +1,67 @@
+"""Pretrained-weight cache (reference
+``python/mxnet/gluon/model_zoo/model_store.py``).
+
+The reference downloads ``{name}-{sha1[:8]}.params`` into
+``~/.mxnet/models`` and verifies the digest before loading. This
+environment has no network egress, so the DOWNLOAD step is out of scope —
+the rest of the contract (cache location, file naming, sha1 verification,
+purge) is implemented so locally-provisioned zoo artifacts load exactly
+like the reference's:
+
+    mx.gluon.model_zoo.vision.resnet18_v1(pretrained=True, root=dir)
+
+finds ``resnet18_v1-<hash>.params`` (or plain ``resnet18_v1.params``) in
+``root``, verifies the embedded short hash when present, and loads it.
+"""
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+
+from ...base import MXNetError
+
+__all__ = ["get_model_file", "purge"]
+
+_DEFAULT_ROOT = os.path.join("~", ".mxnet", "models")
+
+
+def _sha1(path: str) -> str:
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def get_model_file(name: str, root: str = _DEFAULT_ROOT) -> str:
+    """Locate (and verify) a pretrained parameter file in the local cache.
+
+    Accepts the reference's ``{name}-{short_hash}.params`` naming (the
+    short hash is checked against the file's sha1) or a plain
+    ``{name}.params``. Raises with provisioning instructions when absent —
+    this build performs no downloads (zero-egress environment).
+    """
+    root = os.path.expanduser(root)
+    plain = os.path.join(root, name + ".params")
+    if os.path.exists(plain):
+        return plain
+    for cand in sorted(glob.glob(os.path.join(root, name + "-*.params"))):
+        short = os.path.basename(cand)[len(name) + 1:-len(".params")]
+        if _sha1(cand).startswith(short.lower()):
+            return cand
+        raise MXNetError(
+            "pretrained file %s is corrupted (sha1 does not start with "
+            "%r); delete it and re-provision" % (cand, short))
+    raise MXNetError(
+        "no pretrained weights for %r in %s and this build performs no "
+        "downloads; provision %s.params (e.g. converted from the reference "
+        "zoo with net.save_parameters) into that directory"
+        % (name, root, name))
+
+
+def purge(root: str = _DEFAULT_ROOT) -> None:
+    """Delete all cached parameter files (reference model_store.purge)."""
+    root = os.path.expanduser(root)
+    for f in glob.glob(os.path.join(root, "*.params")):
+        os.remove(f)

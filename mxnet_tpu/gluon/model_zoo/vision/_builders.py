@@ -3,7 +3,7 @@ from __future__ import annotations
 
 from ... import nn
 
-__all__ = ["seq", "named_factory"]
+__all__ = ["seq", "named_factory", "load_pretrained"]
 
 
 def seq(*layers, prefix=""):
@@ -28,3 +28,13 @@ def named_factory(builder, name, doc, *bound_args):
     make.__module__ = sys._getframe(1).f_globals.get("__name__", __name__)
     make.__doc__ = doc
     return make
+
+
+def load_pretrained(net, name, root=None):
+    """Load locally-cached pretrained weights by the REFERENCE zoo's
+    artifact name (model_store contract); root=None uses the default
+    cache directory."""
+    from ..model_store import _DEFAULT_ROOT, get_model_file
+
+    net.load_parameters(get_model_file(name, root or _DEFAULT_ROOT))
+    return net

@@ -1,8 +1,8 @@
 """AlexNet (reference ``python/mxnet/gluon/model_zoo/vision/alexnet.py``)."""
 from __future__ import annotations
 
-from ....base import MXNetError
 from ...block import HybridBlock
+from ._builders import load_pretrained
 from ... import nn
 
 __all__ = ["AlexNet", "alexnet"]
@@ -45,7 +45,5 @@ class AlexNet(HybridBlock):
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
     net = AlexNet(**kwargs)
     if pretrained:
-        raise MXNetError(
-            "pretrained weights require network access; load local .params "
-            "with net.load_parameters instead")
+        load_pretrained(net, "alexnet", root)
     return net

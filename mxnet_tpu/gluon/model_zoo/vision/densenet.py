@@ -8,7 +8,7 @@ from __future__ import annotations
 from ....base import MXNetError
 from ... import nn
 from ...block import HybridBlock
-from ._builders import named_factory, seq as _pipeline
+from ._builders import load_pretrained, named_factory, seq as _pipeline
 
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201"]
@@ -97,11 +97,10 @@ def get_densenet(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
         raise MXNetError("Invalid DenseNet depth %d; options: %s"
                          % (num_layers, sorted(densenet_spec)))
     stem, growth, config = densenet_spec[num_layers]
+    net = DenseNet(stem, growth, config, **kwargs)
     if pretrained:
-        raise MXNetError(
-            "pretrained weights require network access; load local .params "
-            "with net.load_parameters instead")
-    return DenseNet(stem, growth, config, **kwargs)
+        load_pretrained(net, "densenet%d" % num_layers, root)
+    return net
 
 
 def _factory(depth):

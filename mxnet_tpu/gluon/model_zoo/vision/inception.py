@@ -6,10 +6,10 @@ optional leading pool token ("avg"/"max"); one builder expands the tables.
 """
 from __future__ import annotations
 
-from ....base import MXNetError
 from ... import nn
 from ...block import HybridBlock
 from ...contrib.nn import HybridConcurrent
+from ._builders import load_pretrained
 
 __all__ = ["Inception3", "inception_v3"]
 
@@ -147,8 +147,7 @@ class Inception3(HybridBlock):
 
 
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
+    net = Inception3(**kwargs)
     if pretrained:
-        raise MXNetError(
-            "pretrained weights require network access; load local .params "
-            "with net.load_parameters instead")
-    return Inception3(**kwargs)
+        load_pretrained(net, "inceptionv3", root)
+    return net

@@ -10,10 +10,9 @@ V2 — expanded by one conv-unit builder.
 """
 from __future__ import annotations
 
-from ....base import MXNetError
 from ... import nn
 from ...block import HybridBlock
-from ._builders import named_factory
+from ._builders import load_pretrained, named_factory
 
 __all__ = [
     "MobileNet", "MobileNetV2",
@@ -141,22 +140,24 @@ class MobileNetV2(HybridBlock):
         return self.output(self.features(x))
 
 
-def _checked(net, pretrained):
+def _checked(net, pretrained, name, root):
     if pretrained:
-        raise MXNetError(
-            "pretrained weights require network access; load local .params "
-            "with net.load_parameters instead")
+        load_pretrained(net, name, root)
     return net
 
 
 def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
                   **kwargs):
-    return _checked(MobileNet(multiplier, **kwargs), pretrained)
+    # reference zoo artifact naming: mobilenet1.0, mobilenet0.25, ...
+    return _checked(MobileNet(multiplier, **kwargs), pretrained,
+                    "mobilenet%s" % multiplier, root)
 
 
 def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
                      **kwargs):
-    return _checked(MobileNetV2(multiplier, **kwargs), pretrained)
+    # reference zoo artifact naming: mobilenetv2_1.0, ...
+    return _checked(MobileNetV2(multiplier, **kwargs), pretrained,
+                    "mobilenetv2_%s" % multiplier, root)
 
 
 def _factory(maker, multiplier, name):

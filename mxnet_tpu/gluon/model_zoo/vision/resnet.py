@@ -14,7 +14,7 @@ from __future__ import annotations
 from ....base import MXNetError
 from ... import nn
 from ...block import HybridBlock
-from ._builders import named_factory, seq as _seq
+from ._builders import load_pretrained, named_factory, seq as _seq
 
 __all__ = [
     "ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
@@ -246,9 +246,7 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     block_cls = resnet_block_versions[version - 1][kind]
     net = net_cls(block_cls, layers, channels, **kwargs)
     if pretrained:
-        raise MXNetError(
-            "pretrained weights require network access; load local .params "
-            "with net.load_parameters instead")
+        load_pretrained(net, "resnet%d_v%d" % (num_layers, version), root)
     return net
 
 

@@ -1,8 +1,8 @@
 """SqueezeNet 1.0/1.1 (reference ``python/mxnet/gluon/model_zoo/vision/squeezenet.py``)."""
 from __future__ import annotations
 
-from ....base import MXNetError
 from ...block import HybridBlock
+from ._builders import load_pretrained
 from ... import nn
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
@@ -76,9 +76,7 @@ class SqueezeNet(HybridBlock):
 def get_squeezenet(version, pretrained=False, ctx=None, root=None, **kwargs):
     net = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise MXNetError(
-            "pretrained weights require network access; load local .params "
-            "with net.load_parameters instead")
+        load_pretrained(net, "squeezenet%s" % version, root)
     return net
 
 

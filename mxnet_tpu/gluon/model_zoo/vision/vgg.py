@@ -3,6 +3,7 @@ from __future__ import annotations
 
 from ....base import MXNetError
 from ...block import HybridBlock
+from ._builders import load_pretrained
 from ... import nn
 
 __all__ = ["VGG", "get_vgg", "vgg11", "vgg13", "vgg16", "vgg19",
@@ -61,9 +62,8 @@ def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     layers, filters = vgg_spec[num_layers]
     net = VGG(layers, filters, **kwargs)
     if pretrained:
-        raise MXNetError(
-            "pretrained weights require network access; load local .params "
-            "with net.load_parameters instead")
+        load_pretrained(net, "vgg%d%s" % (num_layers,
+                        "_bn" if kwargs.get("batch_norm") else ""), root)
     return net
 
 

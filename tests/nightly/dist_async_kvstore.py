@@ -1,0 +1,56 @@
+"""kvstore=dist_async across real OS processes (counterpart of reference
+tests/nightly/dist_async_kvstore.py).
+
+This runtime is PS-free (weights live in HBM, SURVEY §5.8), so the
+multi-process contract is: plain push/pull aggregates exactly like
+dist_sync, and the server-side-updater form — whose reference semantics
+need a parameter-server process — fails LOUDLY with the documented error
+instead of silently diverging. Both halves are asserted on every rank.
+Launched as ``python tools/launch.py -n 2 -- python
+tests/nightly/dist_async_kvstore.py``.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore
+from mxnet_tpu.base import MXNetError
+
+
+def main():
+    assert kvstore.init_distributed(), "launcher env missing"
+    import jax
+
+    kv = mx.kvstore.create("dist_async")
+    rank, nw = kv.rank, kv.num_workers
+    assert "async" in kv.type
+
+    # plain push/pull: every worker's contribution aggregates exactly
+    shape = (4, 3)
+    kv.init("w", mx.nd.zeros(shape))
+    kv.push("w", mx.nd.full(shape, float(rank + 1)))
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    expect = sum(r + 1 for r in range(nw))
+    np.testing.assert_allclose(out.asnumpy(), np.full(shape, expect),
+                               rtol=1e-6)
+    print("rank %d: ASYNC_PUSHPULL_OK" % rank, flush=True)
+
+    # updater form: rejected with the documented error on every rank
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    try:
+        kv.push("w", mx.nd.full(shape, 1.0))
+    except MXNetError as e:
+        assert "single-process" in str(e), e
+        print("rank %d: ASYNC_UPDATER_REJECTED_OK" % rank, flush=True)
+    else:
+        raise AssertionError("multi-process async updater push did not "
+                             "raise")
+
+
+if __name__ == "__main__":
+    main()

@@ -92,3 +92,15 @@ def test_worker_kill_detection_and_elastic_resume():
             out.stdout[-4000:]
         assert ("rank %d: ELASTIC_RESUME_OK" % rank) in out.stdout, \
             out.stdout[-4000:]
+
+
+@pytest.mark.slow
+def test_dist_async_kvstore_two_workers():
+    """Cross-process dist_async contract: aggregation works, the
+    PS-requiring updater form fails loudly on every rank (reference
+    tests/nightly/dist_async_kvstore.py counterpart)."""
+    out = _launch(2, REPO / "tests" / "nightly" / "dist_async_kvstore.py")
+    assert out.returncode == 0, out.stdout[-4000:] + out.stderr[-2000:]
+    for rank in (0, 1):
+        assert ("rank %d: ASYNC_PUSHPULL_OK" % rank) in out.stdout
+        assert ("rank %d: ASYNC_UPDATER_REJECTED_OK" % rank) in out.stdout

@@ -383,3 +383,43 @@ def test_lambda_layers():
     x = mx.nd.array([-1.0, 1.0])
     assert np.allclose(lam(x).asnumpy(), np.tanh([-1, 1]), rtol=1e-5)
     assert np.allclose(hl(x).asnumpy(), [0, 1])
+
+
+def test_model_store_pretrained_contract(tmp_path):
+    """model_store locate/verify/load contract (reference
+    model_store.py): a provisioned {name}-{sha1[:8]}.params artifact loads
+    through pretrained=True; corrupted hashes and missing files fail
+    loudly. No downloads — zero-egress build."""
+    import hashlib
+
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.gluon.model_zoo import model_store, vision
+
+    root = str(tmp_path)
+    # provision: save a trained-elsewhere artifact under the zoo naming
+    src = vision.resnet18_v1(classes=10)
+    src.initialize()
+    src(mx.nd.array(np.zeros((1, 3, 32, 32), np.float32)))
+    tmp = tmp_path / "w.params"
+    src.save_parameters(str(tmp))
+    digest = hashlib.sha1(tmp.read_bytes()).hexdigest()
+    artifact = tmp_path / ("resnet18_v1-%s.params" % digest[:8])
+    tmp.rename(artifact)
+
+    assert model_store.get_model_file("resnet18_v1", root) == str(artifact)
+    net = vision.resnet18_v1(classes=10, pretrained=True, root=root)
+    x = mx.nd.array(np.random.RandomState(0).rand(1, 3, 32, 32)
+                    .astype(np.float32))
+    np.testing.assert_allclose(net(x).asnumpy(), src(x).asnumpy(),
+                               rtol=1e-5)
+
+    # corrupted: hash prefix no longer matches the content
+    artifact.write_bytes(artifact.read_bytes() + b"x")
+    with pytest.raises(MXNetError, match="corrupted"):
+        model_store.get_model_file("resnet18_v1", root)
+
+    # missing: informative provisioning error
+    with pytest.raises(MXNetError, match="no pretrained weights"):
+        model_store.get_model_file("resnet999", root)
+    model_store.purge(root)
+    assert not list(tmp_path.glob("*.params"))

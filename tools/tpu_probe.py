@@ -72,6 +72,18 @@ def run_bench():
     return last
 
 
+def run_entry_check():
+    """__graft_entry__.entry() compile check on the real chip."""
+    log("running entry() compile check on real chip")
+    src = ("import __graft_entry__ as g, jax; fn, args = g.entry(); "
+           "out = jax.jit(fn)(*args); jax.block_until_ready(out); "
+           "print('ENTRY_OK', getattr(out, 'shape', None))")
+    out = subprocess.run([sys.executable, "-c", src], capture_output=True,
+                         text=True, timeout=900, cwd=REPO)
+    log("entry check rc=%d out=%s" % (
+        out.returncode, (out.stdout or out.stderr).strip()[-200:]))
+
+
 def run_tpu_tests():
     log("running tests/test_operator_tpu.py on real chip")
     out = subprocess.run(
@@ -100,12 +112,20 @@ def main():
         else:
             log("probe OK: %s" % json.dumps(info))
             if not benched:
+                # independent steps: one crashing must not skip the others
+                # (and only a SUCCESSFUL bench stops future attempts)
                 try:
-                    if run_bench():
-                        benched = True
+                    benched = bool(run_bench())
+                except Exception as e:  # noqa: BLE001
+                    log("bench crashed: %r" % e)
+                try:
+                    run_entry_check()
+                except Exception as e:  # noqa: BLE001
+                    log("entry check crashed: %r" % e)
+                try:
                     run_tpu_tests()
                 except Exception as e:  # noqa: BLE001
-                    log("bench/tests crashed: %r" % e)
+                    log("tpu tests crashed: %r" % e)
         if args.once:
             break
         time.sleep(args.interval)
