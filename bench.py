@@ -202,6 +202,11 @@ def main():
     x_np = rng.rand(batch, 3, side, side).astype(np.float32)
     y_np = rng.randint(0, classes, (batch,))
 
+    # optional device-trace capture (MXNET_BENCH_PROFILE=dir): the
+    # steady-state train phase runs inside a jax profiler trace so a real
+    # TPU run leaves an inspectable timeline next to the JSON result
+    profile_dir = os.environ.get("MXNET_BENCH_PROFILE", "")
+
     # ---- fused training step FIRST: it is the headline metric ------------
     _PARTIAL["phase"] = "train-compile"
     net_t = make_net(classes=classes)
@@ -213,7 +218,12 @@ def main():
     xt, yt = nd.array(x_np), nd.array(y_np)
     step(xt, yt)._data.block_until_ready()  # compile
     _PARTIAL["phase"] = "train-steady"
-    _PARTIAL["train"] = batch * _time_iters(lambda: step(xt, yt), budget)
+    if profile_dir:
+        with jax.profiler.trace(profile_dir):
+            _PARTIAL["train"] = batch * _time_iters(
+                lambda: step(xt, yt), min(budget, 10.0))
+    else:
+        _PARTIAL["train"] = batch * _time_iters(lambda: step(xt, yt), budget)
 
     # ---- inference fp32 --------------------------------------------------
     _PARTIAL["phase"] = "infer-fp32"

@@ -46,13 +46,16 @@ def get_model_file(name: str, root: str = _DEFAULT_ROOT) -> str:
     plain = os.path.join(root, name + ".params")
     if os.path.exists(plain):
         return plain
+    corrupt = []
     for cand in sorted(glob.glob(os.path.join(root, name + "-*.params"))):
         short = os.path.basename(cand)[len(name) + 1:-len(".params")]
         if _sha1(cand).startswith(short.lower()):
             return cand
+        corrupt.append(cand)  # keep scanning: a valid sibling may exist
+    if corrupt:
         raise MXNetError(
-            "pretrained file %s is corrupted (sha1 does not start with "
-            "%r); delete it and re-provision" % (cand, short))
+            "pretrained file(s) %s corrupted (sha1 does not start with the "
+            "embedded hash); delete and re-provision" % ", ".join(corrupt))
     raise MXNetError(
         "no pretrained weights for %r in %s and this build performs no "
         "downloads; provision %s.params (e.g. converted from the reference "

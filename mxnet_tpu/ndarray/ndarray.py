@@ -526,7 +526,20 @@ def invoke(op_name: str, *inputs, out=None, **kwargs):
         from . import sparse as _sp
         if (opdef.dispatch_ex_always
                 or any(isinstance(i, _sp.BaseSparseNDArray) for i in inputs)):
-            return _invoke_ex(opdef, attrs, inputs, out)
+            from .. import autograd as _ag
+
+            # a non-differentiable ex kernel must not swallow the tape:
+            # when recording with a dense in-graph operand, fall through to
+            # the dense FCompute (sparse inputs densify via their _data
+            # cache) so jax.vjp tapes the op as before
+            needs_tape = (not opdef.ex_differentiable
+                          and not opdef.dispatch_ex_always
+                          and _ag.is_recording()
+                          and any(isinstance(i, NDArray)
+                                  and not isinstance(i, _sp.BaseSparseNDArray)
+                                  and i._in_graph for i in inputs))
+            if not needs_tape:
+                return _invoke_ex(opdef, attrs, inputs, out)
     nd_inputs: List[Optional[NDArray]] = []
     datas = []
     for i in inputs:

@@ -45,6 +45,14 @@ class RowSparseNDArray(BaseSparseNDArray):
         ctx = ctx or current_context()
         self._values = values if not isinstance(values, NDArray) else values._data
         self._indices = indices if not isinstance(indices, NDArray) else indices._data
+        # canonical form: ascending row ids (the reference keeps rsp
+        # indices sorted; the sparse ex kernels binary-search them)
+        idx_np = np.asarray(self._indices)
+        if idx_np.size > 1 and np.any(np.diff(idx_np) < 0):
+            order = np.argsort(idx_np, kind="stable")
+            self._indices = jnp.asarray(idx_np[order])
+            self._values = jnp.take(jnp.asarray(self._values),
+                                    jnp.asarray(order), axis=0)
         self._full_shape = tuple(shape)
         dense = jnp.zeros(shape, dtype=self._values.dtype).at[self._indices.astype(jnp.int32)].set(self._values)
         super().__init__(dense, ctx)

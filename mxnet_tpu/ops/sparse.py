@@ -222,3 +222,50 @@ def _sparse_embedding(attrs, data, weight):
     row_sparse update path skips the rest; reference _contrib_SparseEmbedding
     + sparse sgd/adagrad kernels)."""
     return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# sparse elemwise add/sub — reference elemwise_binary_op_basic.cc FComputeEx
+# (rsp+rsp stays rsp: the gradient-accumulation path for sparse grads)
+# ---------------------------------------------------------------------------
+
+
+def _rsp_union_addsub(lhs: SparseRep, rhs: SparseRep, sign: float):
+    """Union-of-rows add/sub on two row_sparse inputs (eager: the output
+    nnz is data-dependent, like the reference's FComputeEx kernels)."""
+    li = np.asarray(lhs.indices).astype(np.int64)
+    ri = np.asarray(rhs.indices).astype(np.int64)
+    if ri.size == 0:
+        return lhs
+    if li.size == 0:
+        return SparseRep("row_sparse", sign * rhs.data, rhs.indices, None,
+                         rhs.shape)
+    union = np.union1d(li, ri)
+    lpos = np.minimum(np.searchsorted(li, union), li.size - 1)
+    rpos = np.minimum(np.searchsorted(ri, union), ri.size - 1)
+    lhit = li[lpos] == union
+    rhit = ri[rpos] == union
+    lv = jnp.take(lhs.data, jnp.asarray(lpos), axis=0) \
+        * jnp.asarray(lhit, lhs.data.dtype).reshape(
+            (-1,) + (1,) * (lhs.data.ndim - 1))
+    rv = jnp.take(rhs.data, jnp.asarray(rpos), axis=0) \
+        * jnp.asarray(rhit, rhs.data.dtype).reshape(
+            (-1,) + (1,) * (rhs.data.ndim - 1))
+    return SparseRep("row_sparse", lv + sign * rv,
+                     jnp.asarray(union), None, lhs.shape)
+
+
+def _binary_ex(sign):
+    def ex(attrs, lhs, rhs):
+        if isinstance(lhs, SparseRep) and isinstance(rhs, SparseRep) \
+                and lhs.stype == rhs.stype == "row_sparse":
+            return _rsp_union_addsub(lhs, rhs, sign)
+        l = _densify(lhs) if isinstance(lhs, SparseRep) else lhs
+        r = _densify(rhs) if isinstance(rhs, SparseRep) else rhs
+        return l + sign * r
+
+    return ex
+
+
+register_ex("elemwise_add")(_binary_ex(1.0))
+register_ex("elemwise_sub")(_binary_ex(-1.0))

@@ -213,3 +213,65 @@ def test_out_with_sparse_storage_rejected():
     rsp = mxs.cast_storage(nd.array(a), "row_sparse")
     with pytest.raises(MXNetError, match="sparse"):
         invoke("cast_storage", nd.array(a), stype="row_sparse", out=rsp)
+
+
+def test_sparse_elemwise_add_sub():
+    """rsp +/- rsp stays row_sparse over the row union (reference
+    elemwise FComputeEx); mixed storage densifies."""
+    a = np.zeros((6, 3), np.float32)
+    b = np.zeros((6, 3), np.float32)
+    a[[0, 2]] = RS.randn(2, 3)
+    b[[2, 5]] = RS.randn(2, 3)
+    ra = mxs.cast_storage(nd.array(a), "row_sparse")
+    rb = mxs.cast_storage(nd.array(b), "row_sparse")
+    s = invoke("elemwise_add", ra, rb)
+    assert s.stype == "row_sparse"
+    assert sorted(s.indices.asnumpy().tolist()) == [0, 2, 5]
+    np.testing.assert_allclose(s.asnumpy(), a + b, rtol=1e-6)
+    d = invoke("elemwise_sub", ra, rb)
+    assert d.stype == "row_sparse"
+    np.testing.assert_allclose(d.asnumpy(), a - b, rtol=1e-6)
+    # mixed: rsp + dense -> dense
+    m = invoke("elemwise_add", ra, nd.array(b))
+    assert m.stype == "default"
+    np.testing.assert_allclose(m.asnumpy(), a + b, rtol=1e-6)
+    # empty rsp operand
+    z = mxs.cast_storage(nd.array(np.zeros((6, 3), np.float32)),
+                         "row_sparse")
+    s2 = invoke("elemwise_add", ra, z)
+    np.testing.assert_allclose(s2.asnumpy(), a, rtol=1e-6)
+
+
+def test_sparse_elemwise_add_taped_dense_grad():
+    """When recording with a dense in-graph operand, the non-differentiable
+    ex kernel must NOT swallow the tape: the call falls back to the dense
+    FCompute path and gradients flow."""
+    a = rand_sparse(5, 3)
+    rsp = mxs.cast_storage(nd.array(a), "row_sparse")
+    w = nd.array(RS.randn(5, 3).astype(np.float32))
+    w.attach_grad()
+    with autograd.record():
+        y = invoke("elemwise_add", w, rsp)
+        loss = (y * y).sum()
+    loss.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(),
+                               2 * (w.asnumpy() + a), rtol=1e-5)
+
+
+def test_row_sparse_array_unsorted_indices_canonicalized():
+    """User-supplied unsorted rsp indices are canonicalized (sorted with
+    values reordered), as the binary-searching ex kernels require."""
+    vals = np.array([[5., 5.], [1., 1.]], np.float32)
+    rsp = mx.nd.sparse.row_sparse_array((vals, [5, 1]), shape=(6, 2))
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 5])
+    np.testing.assert_allclose(rsp.data.asnumpy(),
+                               [[1., 1.], [5., 5.]], rtol=1e-6)
+    other = mxs.cast_storage(nd.array(np.zeros((6, 2), np.float32)
+                                      + np.eye(6, 2, dtype=np.float32)),
+                             "row_sparse")
+    s = invoke("elemwise_add", rsp, other)
+    dense = np.zeros((6, 2), np.float32)
+    dense[5] = 5; dense[1] = 1
+    np.testing.assert_allclose(s.asnumpy(),
+                               dense + np.eye(6, 2, dtype=np.float32),
+                               rtol=1e-6)
