@@ -531,8 +531,10 @@ def invoke(op_name: str, *inputs, out=None, **kwargs):
             # a non-differentiable ex kernel must not swallow the tape:
             # when recording with a dense in-graph operand, fall through to
             # the dense FCompute (sparse inputs densify via their _data
-            # cache) so jax.vjp tapes the op as before
-            needs_tape = (not opdef.ex_differentiable
+            # cache) so jax.vjp tapes the op as before. Only ops whose
+            # dense FCompute is a full equivalent opt in (ex_grad_fallback)
+            needs_tape = (opdef.ex_grad_fallback
+                          and not opdef.ex_differentiable
                           and not opdef.dispatch_ex_always
                           and _ag.is_recording()
                           and any(isinstance(i, NDArray)

@@ -43,16 +43,12 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def __init__(self, values, indices, shape, ctx: Optional[Context] = None):
         ctx = ctx or current_context()
+        # indices must be in canonical (ascending) form: the sparse ex
+        # kernels binary-search them. row_sparse_array() sorts user input
+        # on the host; internal producers emit sorted indices by
+        # construction, so no device sync happens here.
         self._values = values if not isinstance(values, NDArray) else values._data
         self._indices = indices if not isinstance(indices, NDArray) else indices._data
-        # canonical form: ascending row ids (the reference keeps rsp
-        # indices sorted; the sparse ex kernels binary-search them)
-        idx_np = np.asarray(self._indices)
-        if idx_np.size > 1 and np.any(np.diff(idx_np) < 0):
-            order = np.argsort(idx_np, kind="stable")
-            self._indices = jnp.asarray(idx_np[order])
-            self._values = jnp.take(jnp.asarray(self._values),
-                                    jnp.asarray(order), axis=0)
         self._full_shape = tuple(shape)
         dense = jnp.zeros(shape, dtype=self._values.dtype).at[self._indices.astype(jnp.int32)].set(self._values)
         super().__init__(dense, ctx)
@@ -137,8 +133,16 @@ def _csr_to_dense(data, indices, indptr, shape):
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, (tuple, list)) and len(arg1) == 2:
         values, indices = arg1
-        v = array(values, ctx=ctx, dtype=dtype)._data
-        i = array(indices, ctx=ctx, dtype="int64")._data
+        # canonicalize on the host BEFORE device upload (ascending rows —
+        # the ex kernels binary-search; no device round-trip this way)
+        idx_np = np.asarray(indices, np.int64)
+        val_np = np.asarray(values)
+        if idx_np.size > 1 and np.any(np.diff(idx_np) < 0):
+            order = np.argsort(idx_np, kind="stable")
+            idx_np = idx_np[order]
+            val_np = val_np[order]
+        v = array(val_np, ctx=ctx, dtype=dtype)._data
+        i = array(idx_np, ctx=ctx, dtype="int64")._data
         return RowSparseNDArray(v, i, shape, ctx)
     dense = array(arg1, ctx=ctx, dtype=dtype)
     return cast_storage(dense, "row_sparse")

@@ -83,6 +83,10 @@ class OpDef:
         self.fcompute_ex: Optional[Callable] = None
         self.dispatch_ex_always = False
         self.ex_differentiable = False
+        # True when the dense FCompute is a full equivalent, so autograd
+        # recording may fall back to it for taping (ops whose dense stub
+        # raises, e.g. _sparse_retain, must never take that fallback)
+        self.ex_grad_fallback = False
 
     # ------------------------------------------------------------------
     def input_names(self, attrs: Optional[AttrDict] = None) -> List[str]:
@@ -211,16 +215,20 @@ def register(
     return deco
 
 
-def register_ex(name: str, always: bool = False, differentiable: bool = False):
+def register_ex(name: str, always: bool = False, differentiable: bool = False,
+                grad_fallback: bool = False):
     """Attach an FComputeEx kernel to an already-registered op (the
     reference registers FCompute and FComputeEx as separate attributes on
-    one NNVM op, e.g. dot's DotForwardEx in dot-inl.h)."""
+    one NNVM op, e.g. dot's DotForwardEx in dot-inl.h). ``grad_fallback``
+    marks ops whose dense FCompute is a full equivalent, letting autograd
+    recording tape through the dense path instead."""
 
     def deco(fn: Callable) -> Callable:
         opdef = get_op(name)
         opdef.fcompute_ex = fn
         opdef.dispatch_ex_always = always
         opdef.ex_differentiable = differentiable
+        opdef.ex_grad_fallback = grad_fallback
         return fn
 
     return deco

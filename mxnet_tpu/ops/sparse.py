@@ -238,8 +238,8 @@ def _rsp_union_addsub(lhs: SparseRep, rhs: SparseRep, sign: float):
     if ri.size == 0:
         return lhs
     if li.size == 0:
-        return SparseRep("row_sparse", sign * rhs.data, rhs.indices, None,
-                         rhs.shape)
+        rv = rhs.data if sign > 0 else -rhs.data
+        return SparseRep("row_sparse", rv, rhs.indices, None, rhs.shape)
     union = np.union1d(li, ri)
     lpos = np.minimum(np.searchsorted(li, union), li.size - 1)
     rpos = np.minimum(np.searchsorted(ri, union), ri.size - 1)
@@ -251,8 +251,8 @@ def _rsp_union_addsub(lhs: SparseRep, rhs: SparseRep, sign: float):
     rv = jnp.take(rhs.data, jnp.asarray(rpos), axis=0) \
         * jnp.asarray(rhit, rhs.data.dtype).reshape(
             (-1,) + (1,) * (rhs.data.ndim - 1))
-    return SparseRep("row_sparse", lv + sign * rv,
-                     jnp.asarray(union), None, lhs.shape)
+    out = lv + rv if sign > 0 else lv - rv   # keeps integer dtypes intact
+    return SparseRep("row_sparse", out, jnp.asarray(union), None, lhs.shape)
 
 
 def _binary_ex(sign):
@@ -262,10 +262,10 @@ def _binary_ex(sign):
             return _rsp_union_addsub(lhs, rhs, sign)
         l = _densify(lhs) if isinstance(lhs, SparseRep) else lhs
         r = _densify(rhs) if isinstance(rhs, SparseRep) else rhs
-        return l + sign * r
+        return l + r if sign > 0 else l - r
 
     return ex
 
 
-register_ex("elemwise_add")(_binary_ex(1.0))
-register_ex("elemwise_sub")(_binary_ex(-1.0))
+register_ex("elemwise_add", grad_fallback=True)(_binary_ex(1.0))
+register_ex("elemwise_sub", grad_fallback=True)(_binary_ex(-1.0))

@@ -79,3 +79,9 @@ def test_benchmark_score_example():
                "--networks", "resnet18_v1", "--batch-sizes", "2",
                "--image-shape", "3,32,32", "--seconds", "1")
     assert "BENCHMARK_SCORE_DONE" in out
+
+
+def test_sparse_linear_classification_example():
+    out = _run("sparse/linear_classification.py", "--epochs", "12",
+               "--num-samples", "256", "--feature-dim", "500")
+    assert "IMPROVED" in out

@@ -275,3 +275,28 @@ def test_row_sparse_array_unsorted_indices_canonicalized():
     np.testing.assert_allclose(s.asnumpy(),
                                dense + np.eye(6, 2, dtype=np.float32),
                                rtol=1e-6)
+
+
+def test_sparse_retain_works_under_record():
+    """_sparse_retain has no dense equivalent: it must keep dispatching its
+    ex kernel even while autograd records (no grad-fallback regression)."""
+    a = rand_sparse(6, 3)
+    rsp = mxs.cast_storage(nd.array(a), "row_sparse")
+    w = nd.array(RS.randn(2, 2).astype(np.float32))
+    w.attach_grad()
+    with autograd.record():
+        _ = (w * w).sum()      # recording is genuinely active
+        ret = mxs.retain(rsp, [0, 2])
+    assert ret.stype == "row_sparse"
+
+
+def test_sparse_elemwise_add_int_dtype_preserved():
+    a = np.zeros((4, 2), np.int32); a[1] = 3
+    b = np.zeros((4, 2), np.int32); b[2] = 4
+    ra = mxs.cast_storage(nd.array(a, dtype="int32"), "row_sparse")
+    rb = mxs.cast_storage(nd.array(b, dtype="int32"), "row_sparse")
+    s = invoke("elemwise_add", ra, rb)
+    assert s.asnumpy().dtype == np.int32
+    d = invoke("elemwise_sub", ra, rb)
+    assert d.asnumpy().dtype == np.int32
+    np.testing.assert_array_equal(d.asnumpy(), a - b)
