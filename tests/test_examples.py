@@ -85,3 +85,8 @@ def test_sparse_linear_classification_example():
     out = _run("sparse/linear_classification.py", "--epochs", "12",
                "--num-samples", "256", "--feature-dim", "500")
     assert "IMPROVED" in out
+
+
+def test_quantize_model_example():
+    out = _run("quantization/quantize_model.py", "--num-calib", "128")
+    assert "ENTROPY_BEATS_NAIVE" in out
