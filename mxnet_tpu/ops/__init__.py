@@ -13,6 +13,7 @@ from . import quantization  # noqa: F401
 from . import vision  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import sparse  # noqa: F401
+from . import misc_tail  # noqa: F401
 
 __all__ = ["OP_REGISTRY", "OpDef", "AttrDict", "get_op", "list_ops", "register", "REQUIRED"]
 

@@ -1,0 +1,235 @@
+"""Registry tail: the last reference registration sites without a
+counterpart here — transformer scaling (``src/operator/contrib/
+transformer.cc:33 _contrib_div_sqrt_dim``), the tutorial op
+(``contrib/quadratic_op.cc``), functional slice assignment
+(``src/operator/tensor/matrix_op.cc _slice_assign``), storage-preserving
+scatter scalar ops, copy aliases, and the opencv-named image ops
+(``src/operator/image/image_random.cc``, ``src/io/image_io.cc``) —
+implemented on PIL/jax.image since OpenCV is not in the image.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import OP_REGISTRY, REQUIRED, register, register_ex
+from .sparse import SparseRep
+
+__all__ = []
+
+
+@register("_contrib_div_sqrt_dim")
+def _div_sqrt_dim(attrs, x):
+    """out = data / sqrt(data.shape[-1]) — attention-score scaling
+    (reference transformer.cc:33)."""
+    return x / jnp.sqrt(jnp.asarray(x.shape[-1], x.dtype))
+
+
+@register("_contrib_quadratic",
+          params={"a": (float, 0.0), "b": (float, 0.0), "c": (float, 0.0)},
+          aliases=("_contrib_quadratic_function",))
+def _quadratic(attrs, x):
+    """a*x^2 + b*x + c (reference contrib/quadratic_op.cc — the custom-op
+    tutorial's example operator)."""
+    return attrs.a * x * x + attrs.b * x + attrs.c
+
+
+def _assign_index(attrs, ndim):
+    begin = tuple(attrs.begin)
+    end = tuple(attrs.end)
+    step = tuple(attrs.step) if attrs.step else (1,) * len(begin)
+    if len(begin) != len(end) or len(step) != len(begin):
+        raise MXNetError(
+            "_slice_assign: begin/end/step lengths differ (%d/%d/%d)"
+            % (len(begin), len(end), len(step)))
+    idx = tuple(slice(b, e, s or 1) for b, e, s in zip(begin, end, step))
+    return idx + (slice(None),) * (ndim - len(idx))
+
+
+@register("_slice_assign",
+          params={"begin": (tuple, REQUIRED), "end": (tuple, REQUIRED),
+                  "step": (tuple, ())},
+          inputs=("lhs", "rhs"), aliases=("_crop_assign",))
+def _slice_assign(attrs, lhs, rhs):
+    """Functional slice write: lhs with lhs[begin:end:step] = rhs
+    (reference matrix_op.cc _slice_assign — the __setitem__ kernel)."""
+    return lhs.at[_assign_index(attrs, lhs.ndim)].set(rhs)
+
+
+@register("_slice_assign_scalar",
+          params={"scalar": (float, 0.0), "begin": (tuple, REQUIRED),
+                  "end": (tuple, REQUIRED), "step": (tuple, ())},
+          inputs=("data",), aliases=("_crop_assign_scalar",))
+def _slice_assign_scalar(attrs, data):
+    return data.at[_assign_index(attrs, data.ndim)].set(attrs.scalar)
+
+
+# copy aliases: one functional copy serves _copyto and the cross-device
+# copy node the reference inserts for group2ctx placement (placement is
+# jax.device_put at the executor layer here)
+for _alias in ("_copyto", "_CrossDeviceCopy"):
+    OP_REGISTRY.setdefault(_alias, OP_REGISTRY["_copy"])
+# _subgraph_op registers later (mxnet_tpu.subgraph); alias added there
+
+
+# ---------------------------------------------------------------------------
+# storage-preserving scatter scalar ops (reference elemwise_scatter_op.cc):
+# like the plain scalar ops on dense input, but on sparse storage they
+# touch only the STORED elements, keeping the result sparse
+# ---------------------------------------------------------------------------
+
+def _scatter_scalar(name, fn):
+    @register(name, params={"scalar": (float, 0.0)})
+    def _dense(attrs, x, _fn=fn):
+        return _fn(x, attrs.scalar)
+
+    @register_ex(name)
+    def _ex(attrs, x, _fn=fn):
+        if not isinstance(x, SparseRep):
+            return _fn(x, attrs.scalar)
+        return SparseRep(x.stype, _fn(x.data, attrs.scalar), x.indices,
+                         x.indptr, x.shape)
+
+
+_scatter_scalar("_scatter_plus_scalar", lambda x, s: x + s)
+_scatter_scalar("_scatter_minus_scalar", lambda x, s: x - s)
+
+
+@register("_scatter_elemwise_div", inputs=("lhs", "rhs"))
+def _scatter_elemwise_div_dense(attrs, lhs, rhs):
+    return lhs / rhs
+
+
+@register_ex("_scatter_elemwise_div")
+def _scatter_elemwise_div_ex(attrs, lhs, rhs):
+    """lhs(sparse) / rhs(dense): divides only the stored elements, result
+    keeps lhs's storage (reference elemwise_scatter_op.cc)."""
+    from .sparse import _densify
+
+    if not isinstance(lhs, SparseRep):
+        rhs_d = _densify(rhs) if isinstance(rhs, SparseRep) else rhs
+        return lhs / rhs_d
+    if isinstance(rhs, SparseRep):
+        raise MXNetError("_scatter_elemwise_div expects a dense divisor")
+    if lhs.stype == "row_sparse":
+        denom = jnp.take(rhs, lhs.indices.astype(jnp.int32), axis=0)
+    else:
+        from .sparse import csr_row_ids
+
+        rows = csr_row_ids(lhs)
+        denom = rhs[rows, lhs.indices.astype(jnp.int32)]
+    return SparseRep(lhs.stype, lhs.data / denom, lhs.indices, lhs.indptr,
+                     lhs.shape)
+
+
+# ---------------------------------------------------------------------------
+# image ops (reference image_random.cc / image_io.cc; cv-prefixed names
+# match the reference's OpenCV-backed registrations)
+# ---------------------------------------------------------------------------
+
+@register("_image_to_tensor", aliases=("to_tensor",))
+def _image_to_tensor(attrs, x):
+    """HWC [0,255] -> CHW float32 [0,1] (reference image_random.cc
+    ToTensor)."""
+    chw = jnp.moveaxis(x.astype(jnp.float32) / 255.0, -1, -3)
+    return chw
+
+
+def _float_tuple(v):
+    if isinstance(v, str):
+        body = v.strip().lstrip("([").rstrip(")]")
+        return tuple(float(x) for x in body.split(",") if x.strip())
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+@register("_image_normalize",
+          params={"mean": (_float_tuple, (0.0,)),
+                  "std": (_float_tuple, (1.0,))},
+          aliases=("image_normalize",))
+def _image_normalize(attrs, x):
+    """Per-channel (CHW) normalize: (x - mean) / std (reference
+    image_random.cc Normalize)."""
+    c = x.shape[-3]
+    mean = jnp.asarray(attrs.mean, x.dtype)
+    std = jnp.asarray(attrs.std, x.dtype)
+    if mean.size == 1:
+        mean = jnp.broadcast_to(mean, (c,))
+    if std.size == 1:
+        std = jnp.broadcast_to(std, (c,))
+    shape = (c,) + (1,) * (2)
+    return (x - mean.reshape(shape)) / std.reshape(shape)
+
+
+@register("_cvimresize", params={"w": (int, REQUIRED), "h": (int, REQUIRED),
+                                 "interp": (int, 1)},
+          aliases=("imresize",))
+def _cvimresize(attrs, x):
+    """HWC resize (reference image_io.cc _cvimresize; jax.image in place
+    of OpenCV). Integer inputs round back to the input dtype."""
+    method = {0: "nearest", 1: "bilinear", 2: "cubic", 3: "bilinear",
+              4: "lanczos3"}.get(attrs.interp, "bilinear")
+    out = jax.image.resize(x.astype(jnp.float32),
+                           (attrs.h, attrs.w, x.shape[2]), method=method)
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        info = jnp.iinfo(x.dtype)
+        out = jnp.clip(jnp.round(out), info.min, info.max).astype(x.dtype)
+    return out
+
+
+@register("_cvcopyMakeBorder",
+          params={"top": (int, REQUIRED), "bot": (int, REQUIRED),
+                  "left": (int, REQUIRED), "right": (int, REQUIRED),
+                  "type": (int, 0), "value": (float, 0.0)},
+          aliases=("copyMakeBorder",))
+def _cv_copy_make_border(attrs, x):
+    """Pad an HWC image (reference image_io.cc _cvcopyMakeBorder;
+    type 0 = constant border)."""
+    pads = ((attrs.top, attrs.bot), (attrs.left, attrs.right), (0, 0))
+    if attrs.type == 0:  # cv2.BORDER_CONSTANT
+        return jnp.pad(x, pads, constant_values=attrs.value)
+    mode = {1: "edge",        # BORDER_REPLICATE
+            2: "symmetric",   # BORDER_REFLECT
+            3: "wrap",        # BORDER_WRAP
+            4: "reflect"}.get(attrs.type)  # BORDER_REFLECT_101
+    if mode is None:
+        raise MXNetError("_cvcopyMakeBorder: unsupported border type %d"
+                         % attrs.type)
+    return jnp.pad(x, pads, mode=mode)
+
+
+@register("_cvimdecode", params={"flag": (int, 1), "to_rgb": (bool, True)},
+          inputs=("buf",), aliases=("imdecode_op",))
+def _cvimdecode(attrs, buf):
+    """JPEG/PNG decode (reference image_io.cc Imdecode). HOST op — decodes
+    a uint8 byte buffer via PIL; eager-only like every decode kernel."""
+    import io as _io
+
+    from PIL import Image
+
+    raw = bytes(np.asarray(buf).astype(np.uint8).tobytes())
+    img = Image.open(_io.BytesIO(raw))
+    if attrs.flag == 0:
+        img = img.convert("L")
+    elif img.mode != "RGB":
+        img = img.convert("RGB")
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if not attrs.to_rgb and arr.shape[2] == 3:
+        arr = arr[:, :, ::-1]
+    return jnp.asarray(arr)
+
+
+@register("_cvimread",
+          params={"filename": (str, REQUIRED), "flag": (int, 1),
+                  "to_rgb": (bool, True)}, inputs=())
+def _cvimread(attrs, ):
+    """Read + decode an image file (reference image_io.cc Imread) — host
+    op, eager-only."""
+    with open(attrs.filename, "rb") as f:
+        raw = np.frombuffer(f.read(), np.uint8).copy()
+    return _cvimdecode(attrs, jnp.asarray(raw))

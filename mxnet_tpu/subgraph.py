@@ -324,3 +324,10 @@ def partition_graph(sym: Symbol, prop) -> Symbol:
             new_of[p] = (fused, j)
 
     return Symbol([new_of[(id(s), k)] for s, k in sym._outputs])
+
+
+# the reference's default_subgraph_op.cc registers the same executor under
+# this name; alias for symbol-JSON compatibility
+from .ops.registry import OP_REGISTRY as _REG  # noqa: E402
+
+_REG.setdefault("_default_subgraph_op", _REG["_subgraph_op"])

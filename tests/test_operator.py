@@ -338,3 +338,98 @@ def test_makediag_offset():
 def test_random_ctx_honored():
     u = mx.nd.random.uniform(0, 1, shape=(2,), ctx=mx.cpu())
     assert u.context.device_type == "cpu"
+
+
+def test_registry_tail_ops():
+    """Round-5 registry tail (misc_tail.py): div_sqrt_dim, quadratic,
+    slice_assign, scatter-scalar storage preservation, image ops, aliases."""
+    from mxnet_tpu.ndarray import sparse as mxs
+    from mxnet_tpu.ndarray.ndarray import invoke
+    from mxnet_tpu.ops.registry import OP_REGISTRY
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 8).astype(np.float32)
+    assert_almost_equal(invoke("_contrib_div_sqrt_dim", mx.nd.array(x)),
+                        x / np.sqrt(8), rtol=1e-6)
+    assert_almost_equal(
+        invoke("_contrib_quadratic", mx.nd.array(x), a=2.0, b=-1.0, c=0.5),
+        2 * x * x - x + 0.5, rtol=1e-5)
+
+    a = rs.randn(5, 4).astype(np.float32)
+    r = rs.randn(2, 4).astype(np.float32)
+    e = a.copy()
+    e[1:3] = r
+    assert_almost_equal(invoke("_slice_assign", mx.nd.array(a),
+                               mx.nd.array(r), begin=(1,), end=(3,)), e)
+    e = a.copy()
+    e[0:2] = 7
+    assert_almost_equal(invoke("_slice_assign_scalar", mx.nd.array(a),
+                               scalar=7.0, begin=(0,), end=(2,)), e)
+
+    s0 = np.zeros((6, 2), np.float32)
+    s0[[1, 4]] = rs.randn(2, 2)
+    rsp = mxs.cast_storage(mx.nd.array(s0), "row_sparse")
+    out = invoke("_scatter_plus_scalar", rsp, scalar=5.0)
+    assert out.stype == "row_sparse"
+    e = s0.copy()
+    e[[1, 4]] += 5.0
+    assert_almost_equal(out, e)
+    out = invoke("_scatter_minus_scalar", rsp, scalar=5.0)
+    assert out.stype == "row_sparse"
+    e = s0.copy()
+    e[[1, 4]] -= 5.0
+    assert_almost_equal(out, e)
+    div = invoke("_scatter_elemwise_div", rsp,
+                 mx.nd.array(np.full((6, 2), 2.0, np.float32)))
+    assert div.stype == "row_sparse"
+    assert_almost_equal(div, s0 / 2.0)
+
+    img = (rs.rand(10, 12, 3) * 255).astype(np.uint8)
+    t = invoke("_image_to_tensor", mx.nd.array(img))
+    assert t.shape == (3, 10, 12)
+    norm = invoke("_image_normalize", t, mean=(0.4, 0.5, 0.6),
+                  std=(0.2, 0.2, 0.2))
+    e = (t.asnumpy() - np.array([0.4, 0.5, 0.6],
+                                np.float32).reshape(3, 1, 1)) / 0.2
+    assert_almost_equal(norm, e, rtol=1e-4, atol=1e-6)
+    rz = invoke("_cvimresize", mx.nd.array(img), w=6, h=5)
+    assert rz.shape == (5, 6, 3) and rz.asnumpy().dtype == np.uint8
+    pad = invoke("_cvcopyMakeBorder", mx.nd.array(img), top=1, bot=2,
+                 left=3, right=4)
+    assert pad.shape == (13, 19, 3)
+
+    from mxnet_tpu import image as im
+    jpg = im.imencode(img)
+    dec = invoke("_cvimdecode",
+                 mx.nd.array(np.frombuffer(jpg, np.uint8).copy()))
+    assert dec.shape[2] == 3 and dec.asnumpy().dtype == np.uint8
+
+    # _cvimread: file-based decode with its reference signature
+    import tempfile
+
+    fn = tempfile.mktemp(suffix=".jpg")
+    with open(fn, "wb") as f:
+        f.write(jpg)
+    rd = invoke("_cvimread", filename=fn)
+    assert rd.shape[2] == 3 and rd.asnumpy().dtype == np.uint8
+
+    # reflect border + step mismatch error
+    bordered = invoke("_cvcopyMakeBorder", mx.nd.array(img), top=2, bot=0,
+                      left=0, right=0, type=2)
+    np.testing.assert_array_equal(bordered.asnumpy()[0], img[1])
+    import pytest as _pytest
+    from mxnet_tpu.base import MXNetError as _Err
+    with _pytest.raises(_Err, match="lengths differ"):
+        invoke("_slice_assign_scalar", mx.nd.array(a), scalar=1.0,
+               begin=(1, 0), end=(3, 2), step=(1,))
+
+    # dense lhs / sparse rhs divisor densifies (all rows stored => finite)
+    dens = np.full((6, 2), 2.0, np.float32)
+    sp_div = mxs.cast_storage(mx.nd.array(dens), "row_sparse")
+    dl = invoke("_scatter_elemwise_div",
+                mx.nd.array(np.ones((6, 2), np.float32)), sp_div)
+    assert_almost_equal(dl, np.full((6, 2), 0.5, np.float32))
+
+    for n in ("_copyto", "_CrossDeviceCopy", "_default_subgraph_op",
+              "_cvimread"):
+        assert n in OP_REGISTRY, n
