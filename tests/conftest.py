@@ -4,10 +4,16 @@ reference's `--launcher local` single-host distributed tests, SURVEY.md §4.2).
 Must set env before jax initializes."""
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: kernel env pins axon otherwise
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# MXNET_TEST_DEVICE=tpu opts OUT of the CPU forcing so the TPU-context
+# rerun suite (test_operator_tpu.py) can execute on the real chip — the
+# reference's test_operator_gpu.py pattern needs the accelerator visible
+_WANT_TPU = os.environ.get("MXNET_TEST_DEVICE", "").lower() == "tpu"
+
+if not _WANT_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"  # force: kernel env pins axon otherwise
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 # site hooks may have pre-imported jax and overridden jax_platforms via
 # config.update (which beats the env var); override it back before any
@@ -17,7 +23,7 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 from jax._src import xla_bridge
 
-if not xla_bridge.backends_are_initialized():
+if not _WANT_TPU and not xla_bridge.backends_are_initialized():
     jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
