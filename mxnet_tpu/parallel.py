@@ -39,7 +39,7 @@ from .context import Context, cpu
 from .ndarray.ndarray import NDArray
 
 __all__ = ["device_mesh", "all_reduce", "all_reduce_multi",
-           "broadcast_to_devices", "TrainStep"]
+           "broadcast_to_devices", "TrainStep", "pipeline_apply"]
 
 
 # ---------------------------------------------------------------------------
@@ -237,6 +237,70 @@ def all_reduce_multi(groups: List[List[Any]], op: str = "sum"):
         stacked.append(jax.make_array_from_single_device_arrays(
             shape, sharding, shards))
     return _multi_reduce_fn(mesh, op)(stacked)
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, mesh,
+                   axis: str = "pp"):
+    """GPipe-style pipeline parallelism over a mesh axis.
+
+    Beyond the reference's scope (SURVEY §2.5: MXNet 1.3 has no true
+    pipeline parallelism — its overlap is async-engine scheduling), but
+    first-class on TPU: stages are laid out along ``axis``, activations
+    hop stage-to-stage over ICI via ``lax.ppermute``, and microbatches
+    keep every stage busy after the fill phase (the GPipe schedule:
+    M + S - 1 ticks for M microbatches over S stages).
+
+    Parameters
+    ----------
+    stage_fn : callable(params_s, x) -> y — one stage's computation;
+        activations must keep one shape across stages.
+    stage_params : pytree whose leaves have a leading stage axis (S, ...)
+        — sharded over ``axis``, one stage per device.
+    microbatches : (M, B, ...) array, replicated.
+    mesh : Mesh containing ``axis`` with S devices.
+
+    Returns (M, B, ...) outputs (the last stage's results, in microbatch
+    order), fully replicated.
+    """
+    from jax import shard_map
+
+    n_stage = mesh.shape[axis]
+    n_micro = microbatches.shape[0]
+    bad = [l.shape for l in jax.tree_util.tree_leaves(stage_params)
+           if l.shape[0] != n_stage]
+    if bad:
+        raise MXNetError(
+            "pipeline_apply: every stage_params leaf needs leading dim %d "
+            "(one stage per '%s' device); got %s" % (n_stage, axis, bad))
+    ticks = n_micro + n_stage - 1
+    ring = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+    def per_device(params_blk, x_all):
+        # params_blk leaves: (1, ...) — this device's stage
+        my_params = jax.tree_util.tree_map(lambda a: a[0], params_blk)
+        stage = jax.lax.axis_index(axis)
+
+        def tick(act_in, t):
+            # stage 0 feeds itself from the microbatch stream; later
+            # stages consume what the previous stage sent last tick
+            my_in = jnp.where(stage == 0,
+                              x_all[jnp.clip(t, 0, n_micro - 1)], act_in)
+            out = stage_fn(my_params, my_in)
+            act_next = jax.lax.ppermute(out, axis, ring)
+            return act_next, out
+
+        # the carry crosses ppermute, which makes it device-varying along
+        # the pp axis; the initial zeros must carry the same varying type
+        zero = jax.lax.pvary(jnp.zeros_like(x_all[0]), (axis,))
+        _, outs = jax.lax.scan(tick, zero, jnp.arange(ticks))
+        return outs[None]  # (1, ticks, B, ...) — stacked over axis
+
+    spec_p = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    fn = shard_map(per_device, mesh=mesh,
+                   in_specs=(spec_p, P()), out_specs=P(axis))
+    outs = fn(stage_params, microbatches)  # (S, ticks, B, ...)
+    # microbatch m leaves the last stage at tick (S-1) + m
+    return outs[n_stage - 1, n_stage - 1:n_stage - 1 + n_micro]
 
 
 def shard_for_device(array, device):
