@@ -106,3 +106,166 @@ def nms_keep(boxes, cls_ids, valid, overlap_thresh, force_suppress):
         interpret=_interpret(),
     )(packed)
     return out[0, :n] > 0.5
+
+
+# ---------------------------------------------------------------------------
+# Flash attention (TPU fused attention kernel)
+# ---------------------------------------------------------------------------
+#
+# The MXU-resident attention kernel: one pallas_call computes
+# softmax(q k^T / sqrt(d)) v without materializing the (S, S) score matrix
+# in HBM. Grid (batch*heads, q-blocks, kv-blocks); the kv axis is the
+# innermost ("arbitrary") dimension and carries the online-softmax state
+# (running max m, normalizer l, weighted accumulator acc) in VMEM scratch.
+# Interpret mode runs the same kernel on the CPU test mesh.
+
+_NEG_BIG = -1e30  # -inf would turn exp(m_prev - m_new) into nan on an
+#                   all-masked first block; a large-negative sentinel keeps
+#                   the online-softmax algebra finite
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                  *, scale, causal, bq, bk, n_kv, seq_len):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32)          # (bq, d)
+    k = k_ref[0].astype(jnp.float32)          # (bk, d)
+    v = v_ref[0].astype(jnp.float32)          # (bk, d)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+
+    rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    valid = cols < seq_len                    # sequence-padding mask
+    if causal:
+        valid = valid & (cols <= rows)
+    s = jnp.where(valid, s, _NEG_BIG)
+
+    m_prev = m_scr[:, :1]                     # (bq, 1)
+    l_prev = l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = alpha * l_prev + p.sum(axis=-1, keepdims=True)
+    acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == n_kv - 1)
+    def _finish():
+        o_ref[0] = (acc_scr[...]
+                    / jnp.maximum(l_scr[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, scale, causal, block_q=128, block_k=128):
+    """q/k/v: (B, H, S, D) -> (B, H, S, D)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    import math
+
+    b, h, s_len, d = q.shape
+    bq = min(block_q, _pad_up(s_len, 8))
+    bk = min(block_k, _pad_up(s_len, 128))
+    # pad to a common multiple of BOTH block sizes — padding to only the
+    # larger one truncates the other axis's grid and silently drops tail
+    # blocks when custom block sizes don't divide it
+    sp = _pad_up(s_len, math.lcm(bq, bk))
+    pad = ((0, 0), (0, 0), (0, sp - s_len), (0, 0))
+    qp = jnp.pad(q, pad).reshape(b * h, sp, d)
+    kp = jnp.pad(k, pad).reshape(b * h, sp, d)
+    vp = jnp.pad(v, pad).reshape(b * h, sp, d)
+    n_q, n_kv = sp // bq, sp // bk
+
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, n_kv=n_kv, seq_len=s_len)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sp, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(qp, kp, vp)
+    return out.reshape(b, h, sp, d)[:, :, :s_len]
+
+
+def _attention_reference(q, k, v, scale, causal):
+    """Pure-jnp attention — the backward recompute path."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        n = s.shape[-1]
+        mask = jnp.tril(jnp.ones((n, n), bool))
+        s = jnp.where(mask[None, None], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, scale=None, causal=False):
+    """Fused multi-head attention, (B, H, S, D) layout.
+
+    Forward runs the Pallas kernel (flash/online-softmax: O(S) memory, MXU
+    matmuls, no (S, S) HBM tensor). Backward differentiates a dense jnp
+    recompute, which DOES materialize the (S, S) score matrix — O(S^2)
+    memory. The flash memory bound therefore holds for inference and for
+    forward-only use; long-sequence TRAINING should shard S first (ring /
+    Ulysses in sequence_parallel.py) so each device's S is modest.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _flash_forward(q, k, v, scale, causal)
+
+
+def _fa_fwd(q, k, v, scale, causal):
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _flash_forward(q, k, v, scale, causal), (q, k, v)
+
+
+def _fa_bwd(scale, causal, res, g):
+    q, k, v = res
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    _, vjp = jax.vjp(lambda a, b, c:
+                     _attention_reference(a, b, c, scale, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def _register_flash_attention_op():
+    """Expose the kernel through the op registry:
+    ``_contrib_flash_attention(query, key, value)`` on (B, H, S, D)."""
+    from .registry import register
+
+    @register("_contrib_flash_attention",
+              params={"scale": (float, None), "causal": (bool, False)},
+              inputs=("query", "key", "value"),
+              aliases=("flash_attention",))
+    def _op(attrs, q, k, v):
+        return flash_attention(q, k, v, attrs.scale, attrs.causal)
+
+
+_register_flash_attention_op()

@@ -447,6 +447,8 @@ EXEMPT = {
 
 # ops verified by dedicated closed-form/oracle tests in THIS module
 CUSTOM_TESTED = {
+    "_contrib_flash_attention":
+        "Pallas kernel: oracle + gradient tests in test_sequence_parallel.py",
     "SoftmaxOutput": "closed-form custom-backward test",
     "LinearRegressionOutput": "closed-form custom-backward test",
     "LogisticRegressionOutput": "closed-form custom-backward test",
