@@ -1,0 +1,113 @@
+"""Neural style transfer by input optimization (reference
+``example/neural-style/``): freeze a conv feature extractor, then optimize
+the INPUT image so its deep features match a content image while its Gram
+matrices match a style image — gradients flow to the data, not the weights,
+exercising the ``attach_grad``-on-input autograd path end-to-end.
+
+Zero-egress fallback: with no pretrained weights or images on disk, a
+randomly-initialized extractor and synthetic images are used — the
+optimization dynamics (both losses falling through input gradients) are
+what the example certifies.
+
+Run:  python example/gluon/neural_style.py [--iters 40]
+"""
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd  # noqa: E402
+from mxnet_tpu import gluon  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+def feature_net(channels=(16, 32, 64)):
+    """Small VGG-style extractor returning features at every scale."""
+    blocks = []
+    for ch in channels:
+        seq = nn.HybridSequential(prefix="")
+        seq.add(nn.Conv2D(ch, 3, padding=1, activation="relu"))
+        seq.add(nn.Conv2D(ch, 3, padding=1, activation="relu"))
+        seq.add(nn.MaxPool2D(2, 2))
+        blocks.append(seq)
+    net = nn.HybridSequential(prefix="style_")
+    for b in blocks:
+        net.add(b)
+    return net, blocks
+
+
+def gram(feat):
+    b, c, h, w = feat.shape
+    flat = feat.reshape((b, c, h * w))
+    return mx.nd.batch_dot(flat, flat, transpose_b=True) / (c * h * w)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=40)
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--style-weight", type=float, default=50.0)
+    args = ap.parse_args()
+
+    rs = np.random.RandomState(0)
+    net, blocks = feature_net()
+    net.initialize(init=mx.initializer.Xavier())
+
+    content = nd.array(rs.rand(1, 3, args.size, args.size)
+                       .astype(np.float32))
+    style = nd.array(rs.rand(1, 3, args.size, args.size).astype(np.float32))
+
+    def features(x):
+        outs = []
+        h = x
+        for b in blocks:
+            h = b(h)
+            outs.append(h)
+        return outs
+
+    with autograd.pause():
+        content_feats = [f.detach() for f in features(content)]
+        style_grams = [gram(f).detach() for f in features(style)]
+
+    # the optimized variable is the IMAGE
+    img = nd.array(rs.rand(1, 3, args.size, args.size).astype(np.float32))
+    img.attach_grad()
+    opt = mx.optimizer.create("adam", learning_rate=args.lr)
+    state = opt.create_state(0, img)
+
+    first = None
+    recent = []
+    for it in range(args.iters):
+        with autograd.record():
+            feats = features(img)
+            content_loss = ((feats[-1] - content_feats[-1]) ** 2).mean()
+            style_loss = sum(((gram(f) - g) ** 2).sum()
+                             for f, g in zip(feats, style_grams))
+            loss = content_loss + args.style_weight * style_loss
+        loss.backward()
+        state = opt.update(0, img, img.grad, state)
+        img[:] = img.clip(0.0, 1.0)
+        v = float(loss.asnumpy())
+        recent.append(v)
+        first = v if first is None else first
+        if it % 10 == 0:
+            print("iter %3d  loss %.3e (content %.3e style %.3e)"
+                  % (it, v, float(content_loss.asnumpy()),
+                     float(style_loss.asnumpy())))
+
+    # the weighted style term is noisy iterate-to-iterate: judge on the
+    # trailing-5 average, not a single (possibly spiky) final iterate
+    last = sum(recent[-5:]) / len(recent[-5:])
+    print("loss %.3e -> %.3e (trailing-5 avg)" % (first, last))
+    improved = last < first * 0.5
+    print("IMPROVED" if improved else "NOT IMPROVED")
+    return 0 if improved else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

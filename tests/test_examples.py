@@ -90,3 +90,8 @@ def test_sparse_linear_classification_example():
 def test_quantize_model_example():
     out = _run("quantization/quantize_model.py", "--num-calib", "128")
     assert "ENTROPY_BEATS_NAIVE" in out
+
+
+def test_neural_style_example():
+    out = _run("gluon/neural_style.py", "--iters", "40", "--size", "48")
+    assert "IMPROVED" in out
