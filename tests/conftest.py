@@ -40,3 +40,13 @@ def _seeded():
     mx.random.seed(seed)
     yield
     # seed printed by pytest on failure via -l; keep quiet otherwise
+
+
+def subprocess_env(**extra):
+    """Env for driving a repo script in a subprocess: CPU-only jax, no
+    accelerator-relay dial-out. The ONE copy of this recipe — example and
+    driver-artifact tests import it from here."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(extra)
+    return env

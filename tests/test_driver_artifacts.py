@@ -1,0 +1,59 @@
+"""The driver's three contact points must never rot: ``bench.py`` (one
+JSON line), ``__graft_entry__.entry()`` (jittable forward), and
+``dryrun_multichip`` (full SPMD step over a virtual mesh). Each runs in a
+subprocess exactly the way the driver invokes it."""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.slow
+
+
+from tests.conftest import subprocess_env as _env
+
+
+def test_bench_quick_emits_valid_json():
+    out = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True, text=True, timeout=420,
+        env=_env(BENCH_QUICK="1", MXNET_BENCH_DEADLINE_S="300"),
+        cwd=str(REPO))
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-1000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.startswith("{")]
+    assert lines, out.stdout[-2000:] + out.stderr[-1000:]
+    result = json.loads(lines[-1])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in result, result
+    assert result["value"] and result["value"] > 0, result
+    assert result["extra"]["infer_fp32_img_s"] > 0, result
+
+
+def test_graft_entry_compiles():
+    src = ("import __graft_entry__ as g, jax; fn, args = g.entry(); "
+           "out = jax.jit(fn)(*args); jax.block_until_ready(out); "
+           "print('ENTRY_OK', out.shape)")
+    out = subprocess.run([sys.executable, "-c", src], capture_output=True,
+                         text=True, timeout=600, env=_env(), cwd=str(REPO))
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "ENTRY_OK" in out.stdout
+
+
+def test_dryrun_multichip_eight_devices():
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        capture_output=True, text=True, timeout=900,
+        env=_env(XLA_FLAGS="--xla_force_host_platform_device_count=8"),
+        cwd=str(REPO))
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    for marker in ("all_reduce OK", "TrainStep parity OK",
+                   "kvstore=tpu push/pull OK", "ring-attention(sp) OK",
+                   "tp(mp-sharded matmul) OK", "pp(GPipe ppermute) OK",
+                   "ep(expert-sharded einsum) OK"):
+        assert marker in out.stdout, out.stdout[-1500:]

@@ -18,8 +18,9 @@ pytestmark = pytest.mark.slow
 
 
 def _run(script, *args, timeout=420, env_extra=None, allow_not_improved=False):
-    env = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_TPU_FAKE_DATA="1")
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    from tests.conftest import subprocess_env
+
+    env = subprocess_env(MXNET_TPU_FAKE_DATA="1")
     out = subprocess.run(
         [sys.executable, str(REPO / "example" / script), *args],
         capture_output=True, text=True, timeout=timeout,
