@@ -7,6 +7,7 @@ The analogue of the reference's single-host distributed tests
 DISTINCT devices, not N aliases of device 0.
 """
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -166,6 +167,85 @@ def test_trainstep_loss_decreases():
     for _ in range(20):
         last = float(step(nd.array(xs), nd.array(ys)).asnumpy())
     assert last < first
+
+
+@pytest.mark.parametrize("opt,opt_params,dtype", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}, "float32"),
+    # adam exercises the t-dependent path: the fused scan must advance the
+    # 1-based step counter exactly like sequential calls (t=0 would zero
+    # Adam's bias correction -> NaN on the very first fused step).
+    # epsilon is raised so near-zero grads (conv bias behind BN) don't
+    # amplify scan-vs-straight-line fusion rounding into update diffs
+    ("adam", {"learning_rate": 0.01, "epsilon": 1e-3}, "float32"),
+    # bf16 params with f32 master optimizer state: the scan carry must stay
+    # dtype-stable (weights cast back to bf16, state kept f32) — the dtype
+    # combination bench.py's train_bf16 phase runs on real hardware
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}, "bfloat16"),
+])
+def test_trainstep_multi_call_matches_sequential_steps(opt, opt_params,
+                                                       dtype):
+    """K steps fused in one lax.scan module (multi_call) must produce the
+    same per-step losses and final params as K sequential step() calls —
+    the engine-bulking analogue (threaded_engine.cc:289) must not change
+    the math."""
+    K = 3
+    bf16 = dtype == "bfloat16"
+    xs = np.random.RandomState(11).rand(K, 2 * N, 2, 8, 8).astype(np.float32)
+    ys = np.random.RandomState(12).randint(0, 3, (K, 2 * N))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = parallel.device_mesh(N, devices=DEVICES)
+
+    tag = opt + dtype[:2]
+    net_seq = _make_net("ms_" + tag)
+    _materialize(net_seq, xs[0])
+    net_fused = _make_net("mf_" + tag)
+    _materialize(net_fused, xs[0])
+    _copy_params(net_seq, net_fused)
+    if bf16:
+        net_seq.cast(dtype)
+        net_fused.cast(dtype)
+        xs = xs.astype(jnp.bfloat16)
+
+    step_seq = parallel.TrainStep(net_seq, loss_fn, opt, mesh,
+                                  optimizer_params=dict(opt_params))
+    step_fused = parallel.TrainStep(net_fused, loss_fn, opt, mesh,
+                                    optimizer_params=dict(opt_params))
+
+    seq_losses = [float(step_seq(nd.array(xs[i]), nd.array(ys[i])).asnumpy())
+                  for i in range(K)]
+    fused_losses = step_fused.multi_call(nd.array(xs), nd.array(ys)).asnumpy()
+    assert fused_losses.shape == (K,)
+    np.testing.assert_allclose(fused_losses.astype(np.float32), seq_losses,
+                               rtol=1e-2 if bf16 else 1e-5,
+                               atol=1e-3 if bf16 else 1e-6)
+    assert step_fused._t == step_seq._t == K
+
+    for name, v_fused in step_fused.params.items():
+        tail = name.split("_", 1)[1]
+        v_seq = next(v for n, v in step_seq.params.items()
+                     if n.split("_", 1)[1] == tail)
+        assert v_fused.dtype == v_seq.dtype, name  # carry dtype stability
+        np.testing.assert_allclose(
+            np.asarray(v_fused, np.float32), np.asarray(v_seq, np.float32),
+            rtol=1e-1 if bf16 else 1e-4, atol=1e-2 if bf16 else 1e-5,
+            err_msg=name)
+
+
+def test_inferstep_single_and_multi_match_net_forward():
+    """InferStep output == the net's own (predict-mode) forward, and the
+    K-batch scanned path == K single calls stacked."""
+    K = 3
+    xs = np.random.RandomState(21).rand(K, N, 2, 8, 8).astype(np.float32)
+    net = _make_net("is_")
+    _materialize(net, xs[0])
+    expect = np.stack([net(nd.array(xs[i])).asnumpy() for i in range(K)])
+
+    infer = parallel.InferStep(net, parallel.device_mesh(N, devices=DEVICES))
+    single = infer(nd.array(xs[0])).asnumpy()
+    np.testing.assert_allclose(single, expect[0], rtol=1e-5, atol=1e-6)
+    fused = infer.multi_call(nd.array(xs)).asnumpy()
+    assert fused.shape == expect.shape
+    np.testing.assert_allclose(fused, expect, rtol=1e-5, atol=1e-6)
 
 
 def test_trainstep_copy_to_net_roundtrip():

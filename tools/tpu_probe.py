@@ -62,21 +62,28 @@ def run_bench(profile=False):
     tag = "profiled " if profile else ""
     log("TPU UP — running %sbench.py" % tag)
     env = dict(os.environ, MXNET_BENCH_DEADLINE_S="600" if profile
-               else "1500")
+               else "3300")  # remote compiles run minutes each; six phases
     if profile:
         env["MXNET_BENCH_PROFILE"] = os.path.join(REPO, "tpu_trace")
     out = subprocess.run([sys.executable, "bench.py"], capture_output=True,
-                         text=True, timeout=1800, cwd=REPO, env=env)
+                         text=True, timeout=3600, cwd=REPO, env=env)
     last = ""
     for ln in out.stdout.strip().splitlines():
         if ln.startswith("{"):
             last = ln
     log("%sbench rc=%d result=%s" % (tag, out.returncode, last[:400]))
+    ok = False
     if last:
+        try:
+            ok = json.loads(last).get("value") is not None
+        except Exception:
+            ok = False
+    if ok:  # only persist/settle on a run with a real number — a
+        # backend-init failure line must not stop future attempts
         name = "BENCH_TPU_PROFILED.json" if profile else "BENCH_TPU_LIVE.json"
         with open(os.path.join(REPO, name), "w") as f:
             f.write(last + "\n")
-    return last
+    return last if ok else ""
 
 
 def run_entry_check():

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-5 live-TPU evidence sequence (runs in tmux; relay is finally up).
+cd /root/repo
+set -x
+date -u
+# 1. headline bench (unprofiled, generous deadline for fresh remote compiles)
+MXNET_BENCH_DEADLINE_S=3300 timeout 3600 python bench.py > /tmp/bench_live_raw.txt 2>/tmp/bench_live.err
+grep '^{' /tmp/bench_live_raw.txt | tail -1 > BENCH_TPU_LIVE.json
+date -u
+# 2. profiled short rerun (server compile cache now warm)
+rm -rf tpu_trace; MXNET_BENCH_PROFILE=/root/repo/tpu_trace MXNET_BENCH_DEADLINE_S=1500 timeout 1700 python bench.py > /tmp/bench_prof_raw.txt 2>/tmp/bench_prof.err
+grep '^{' /tmp/bench_prof_raw.txt | tail -1 > BENCH_TPU_PROFILED.json
+date -u
+# 3. entry() compile check on the real chip
+timeout 900 python -c "import __graft_entry__ as g, jax; fn, args = g.entry(); out = jax.jit(fn)(*args); jax.block_until_ready(out); print('ENTRY_OK', getattr(out, 'shape', None))" > /tmp/entry_check.txt 2>&1
+date -u
+# 4. on-chip operator suite rerun
+MXNET_TEST_DEVICE=tpu timeout 3600 python -m pytest tests/test_operator_tpu.py -q --no-header > /tmp/tpu_tests.txt 2>&1
+tail -5 /tmp/tpu_tests.txt
+date -u
+echo SEQUENCE_DONE
