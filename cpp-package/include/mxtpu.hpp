@@ -1,0 +1,374 @@
+/*
+ * mxtpu.hpp — header-only C++ frontend over the native C ABIs.
+ *
+ * The TPU-native counterpart of the reference's cpp-package
+ * (cpp-package/include/mxnet-cpp *.hpp, which wraps c_api.h /
+ * c_predict_api.h in RAII classes): everything here is a thin,
+ * exception-safe wrapper over src/mxtpu.h (storage pool, dependency
+ * engine, recordio) and src/predict/predict.cc (the 6-function predict
+ * ABI). Compute itself is XLA-compiled — a C++ caller drives inference
+ * through Predictor (embedded-interpreter path) or through the AOT
+ * StableHLO artifact (docs/deploy_aot.md); there is deliberately no
+ * per-op C++ math API, that role belongs to the compiler.
+ *
+ * Link: -lmxtpu (engine/storage/recordio) and/or -lmxtpu_predict.
+ */
+#ifndef MXTPU_HPP_
+#define MXTPU_HPP_
+
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+extern "C" {
+/* src/mxtpu.h — redeclared so the header is self-contained for users
+ * installing only cpp-package/include. */
+const char *MXTPUGetLastError(void);
+int MXTPUGetVersion(int *out);
+int MXTPUStorageAlloc(size_t size, void **out);
+int MXTPUStorageFree(void *ptr);
+int MXTPUStorageDirectFree(void *ptr);
+int MXTPUStorageReleaseAll(void);
+int MXTPUStorageStats(uint64_t *bytes_in_use, uint64_t *bytes_pooled,
+                      uint64_t *peak_bytes, uint64_t *num_allocs,
+                      uint64_t *num_pool_hits);
+typedef uint64_t MXTPUVarHandle;
+typedef int (*MXTPUEngineFn)(void *arg);
+int MXTPUEngineNewVar(MXTPUVarHandle *out);
+int MXTPUEngineDeleteVar(MXTPUVarHandle var);
+int MXTPUEnginePushAsync(MXTPUEngineFn fn, void *arg,
+                         const MXTPUVarHandle *const_vars, int num_const,
+                         const MXTPUVarHandle *mutable_vars, int num_mutable,
+                         int priority, uint64_t *out_opr_id);
+int MXTPUEngineWaitForVar(MXTPUVarHandle var);
+int MXTPUEngineWaitForAll(void);
+int MXTPUEngineNumWorkers(int *out);
+int MXTPUEngineIsNaive(int *out);
+int MXTPURecordIOWriterCreate(const char *path, void **out);
+int MXTPURecordIOWriterWrite(void *handle, const char *buf, size_t size,
+                             uint64_t *out_pos);
+int MXTPURecordIOWriterTell(void *handle, uint64_t *out_pos);
+int MXTPURecordIOWriterClose(void *handle);
+int MXTPURecordIOReaderCreate(const char *path, void **out);
+int MXTPURecordIOReaderSeek(void *handle, uint64_t pos);
+int MXTPURecordIOReaderNext(void *handle, const char **out, size_t *out_size);
+int MXTPURecordIOReaderTell(void *handle, uint64_t *out_pos);
+int MXTPURecordIOReaderClose(void *handle);
+}
+
+namespace mxtpu {
+
+/* Every failing ABI call raises this with MXTPUGetLastError's text —
+ * the C++ analogue of python's base.check_call -> MXNetError. */
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string &what) : std::runtime_error(what) {}
+};
+
+inline void check(int rc, const char *ctx) {
+  if (rc != 0) {
+    const char *msg = MXTPUGetLastError();
+    throw Error(std::string(ctx) + ": " + (msg && *msg ? msg : "unknown"));
+  }
+}
+
+inline int version() {
+  int v = 0;
+  check(MXTPUGetVersion(&v), "MXTPUGetVersion");
+  return v;
+}
+
+/* ---------------- storage: RAII buffer from the size-bucketed pool ---- */
+
+class Buffer {
+ public:
+  Buffer() = default;
+  explicit Buffer(size_t size) : size_(size) {
+    check(MXTPUStorageAlloc(size, &ptr_), "MXTPUStorageAlloc");
+  }
+  ~Buffer() { reset(); }
+  Buffer(Buffer &&o) noexcept : ptr_(o.ptr_), size_(o.size_) {
+    o.ptr_ = nullptr;
+    o.size_ = 0;
+  }
+  Buffer &operator=(Buffer &&o) noexcept {
+    if (this != &o) {
+      reset();
+      std::swap(ptr_, o.ptr_);
+      std::swap(size_, o.size_);
+    }
+    return *this;
+  }
+  Buffer(const Buffer &) = delete;
+  Buffer &operator=(const Buffer &) = delete;
+
+  void *data() const { return ptr_; }
+  size_t size() const { return size_; }
+  void reset() {
+    if (ptr_) MXTPUStorageFree(ptr_);  /* back to the pool */
+    ptr_ = nullptr;
+    size_ = 0;
+  }
+
+ private:
+  void *ptr_ = nullptr;
+  size_t size_ = 0;
+};
+
+struct StorageStats {
+  uint64_t bytes_in_use, bytes_pooled, peak_bytes, num_allocs, num_pool_hits;
+};
+
+inline StorageStats storage_stats() {
+  StorageStats s{};
+  check(MXTPUStorageStats(&s.bytes_in_use, &s.bytes_pooled, &s.peak_bytes,
+                          &s.num_allocs, &s.num_pool_hits),
+        "MXTPUStorageStats");
+  return s;
+}
+
+inline void storage_release_all() {
+  check(MXTPUStorageReleaseAll(), "MXTPUStorageReleaseAll");
+}
+
+/* ---------------- dependency engine ----------------------------------- */
+
+class Var {
+ public:
+  Var() { check(MXTPUEngineNewVar(&h_), "MXTPUEngineNewVar"); }
+  ~Var() {
+    if (h_) MXTPUEngineDeleteVar(h_);
+  }
+  Var(Var &&o) noexcept : h_(o.h_) { o.h_ = 0; }
+  Var &operator=(Var &&o) noexcept {
+    if (this != &o) std::swap(h_, o.h_);
+    return *this;
+  }
+  Var(const Var &) = delete;
+  Var &operator=(const Var &) = delete;
+
+  MXTPUVarHandle handle() const { return h_; }
+  void wait() const { check(MXTPUEngineWaitForVar(h_), "WaitForVar"); }
+
+ private:
+  MXTPUVarHandle h_ = 0;
+};
+
+namespace detail {
+inline int trampoline(void *arg) {
+  auto *fn = static_cast<std::function<void()> *>(arg);
+  int rc = 0;
+  try {
+    (*fn)();
+  } catch (...) {
+    rc = -1;  /* engine records the failure against the opr id */
+  }
+  delete fn;
+  return rc;
+}
+}  // namespace detail
+
+class Engine {
+ public:
+  /* Push an arbitrary C++ callable with read (const) / write (mutable)
+   * dependencies — the reference's Engine::PushAsync contract. */
+  static uint64_t push(std::function<void()> fn,
+                       const std::vector<const Var *> &const_vars = {},
+                       const std::vector<const Var *> &mutable_vars = {},
+                       int priority = 0) {
+    std::vector<MXTPUVarHandle> cv, mv;
+    for (const Var *v : const_vars) cv.push_back(v->handle());
+    for (const Var *v : mutable_vars) mv.push_back(v->handle());
+    auto *heap_fn = new std::function<void()>(std::move(fn));
+    uint64_t id = 0;
+    int rc = MXTPUEnginePushAsync(
+        detail::trampoline, heap_fn, cv.empty() ? nullptr : cv.data(),
+        static_cast<int>(cv.size()), mv.empty() ? nullptr : mv.data(),
+        static_cast<int>(mv.size()), priority, &id);
+    if (rc != 0) {
+      delete heap_fn;
+      check(rc, "MXTPUEnginePushAsync");
+    }
+    return id;
+  }
+  static void wait_all() { check(MXTPUEngineWaitForAll(), "WaitForAll"); }
+  static int num_workers() {
+    int n = 0;
+    check(MXTPUEngineNumWorkers(&n), "NumWorkers");
+    return n;
+  }
+  static bool is_naive() {
+    int b = 0;
+    check(MXTPUEngineIsNaive(&b), "IsNaive");
+    return b != 0;
+  }
+};
+
+/* ---------------- recordio -------------------------------------------- */
+
+class RecordIOWriter {
+ public:
+  explicit RecordIOWriter(const std::string &path) {
+    check(MXTPURecordIOWriterCreate(path.c_str(), &h_), "RecordIOWriterCreate");
+  }
+  ~RecordIOWriter() { close(); }
+  RecordIOWriter(const RecordIOWriter &) = delete;
+  RecordIOWriter &operator=(const RecordIOWriter &) = delete;
+
+  /* Returns the record's seek position (for building .idx files). */
+  uint64_t write(const void *buf, size_t size) {
+    uint64_t pos = 0;
+    check(MXTPURecordIOWriterWrite(h_, static_cast<const char *>(buf), size,
+                                   &pos),
+          "RecordIOWriterWrite");
+    return pos;
+  }
+  uint64_t write(const std::string &s) { return write(s.data(), s.size()); }
+  uint64_t tell() const {
+    uint64_t pos = 0;
+    check(MXTPURecordIOWriterTell(h_, &pos), "RecordIOWriterTell");
+    return pos;
+  }
+  void close() {
+    if (h_) {
+      MXTPURecordIOWriterClose(h_);
+      h_ = nullptr;
+    }
+  }
+
+ private:
+  void *h_ = nullptr;
+};
+
+class RecordIOReader {
+ public:
+  explicit RecordIOReader(const std::string &path) {
+    check(MXTPURecordIOReaderCreate(path.c_str(), &h_), "RecordIOReaderCreate");
+  }
+  ~RecordIOReader() { close(); }
+  RecordIOReader(const RecordIOReader &) = delete;
+  RecordIOReader &operator=(const RecordIOReader &) = delete;
+
+  /* False at EOF; the string_view-ish pair stays valid until next(). */
+  bool next(std::string *out) {
+    const char *buf = nullptr;
+    size_t size = 0;
+    check(MXTPURecordIOReaderNext(h_, &buf, &size), "RecordIOReaderNext");
+    if (buf == nullptr) return false;
+    out->assign(buf, size);
+    return true;
+  }
+  void seek(uint64_t pos) {
+    check(MXTPURecordIOReaderSeek(h_, pos), "RecordIOReaderSeek");
+  }
+  uint64_t tell() const {
+    uint64_t pos = 0;
+    check(MXTPURecordIOReaderTell(h_, &pos), "RecordIOReaderTell");
+    return pos;
+  }
+  void close() {
+    if (h_) {
+      MXTPURecordIOReaderClose(h_);
+      h_ = nullptr;
+    }
+  }
+
+ private:
+  void *h_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+/* ---------------- predict (separate library: -lmxtpu_predict) ---------- */
+
+extern "C" {
+const char *MXPredGetLastError(void);
+int MXPredCreate(const char *symbol_json, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char **input_keys,
+                 const uint32_t *input_shape_indptr,
+                 const uint32_t *input_shape_data, void **out);
+int MXPredSetInput(void *handle, const char *key, const float *data,
+                   uint32_t size);
+int MXPredForward(void *handle);
+int MXPredGetOutputShape(void *handle, uint32_t index, uint32_t **shape_data,
+                         uint32_t *shape_ndim);
+int MXPredGetOutput(void *handle, uint32_t index, float *data, uint32_t size);
+int MXPredFree(void *handle);
+}
+
+namespace mxtpu {
+
+/* RAII over the reference-compatible 6-function predict ABI
+ * (reference include/mxnet/c_predict_api.h consumers). */
+class Predictor {
+ public:
+  struct Input {
+    std::string name;
+    std::vector<uint32_t> shape;
+  };
+
+  Predictor(const std::string &symbol_json, const std::string &param_bytes,
+            const std::vector<Input> &inputs, int dev_type = 1,
+            int dev_id = 0) {
+    std::vector<const char *> keys;
+    std::vector<uint32_t> indptr{0};
+    std::vector<uint32_t> shapes;
+    for (const Input &in : inputs) {
+      keys.push_back(in.name.c_str());
+      for (uint32_t d : in.shape) shapes.push_back(d);
+      indptr.push_back(static_cast<uint32_t>(shapes.size()));
+    }
+    int rc = MXPredCreate(symbol_json.c_str(), param_bytes.data(),
+                          static_cast<int>(param_bytes.size()), dev_type,
+                          dev_id, static_cast<uint32_t>(keys.size()),
+                          keys.data(), indptr.data(), shapes.data(), &h_);
+    if (rc != 0) throw Error(std::string("MXPredCreate: ") +
+                             MXPredGetLastError());
+  }
+  ~Predictor() {
+    if (h_) MXPredFree(h_);
+  }
+  Predictor(const Predictor &) = delete;
+  Predictor &operator=(const Predictor &) = delete;
+
+  void set_input(const std::string &key, const float *data, size_t size) {
+    if (MXPredSetInput(h_, key.c_str(), data,
+                       static_cast<uint32_t>(size)) != 0)
+      throw Error(std::string("MXPredSetInput: ") + MXPredGetLastError());
+  }
+  void set_input(const std::string &key, const std::vector<float> &v) {
+    set_input(key, v.data(), v.size());
+  }
+  void forward() {
+    if (MXPredForward(h_) != 0)
+      throw Error(std::string("MXPredForward: ") + MXPredGetLastError());
+  }
+  std::vector<uint32_t> output_shape(uint32_t index = 0) const {
+    uint32_t *dims = nullptr, ndim = 0;
+    if (MXPredGetOutputShape(h_, index, &dims, &ndim) != 0)
+      throw Error(std::string("MXPredGetOutputShape: ") +
+                  MXPredGetLastError());
+    return std::vector<uint32_t>(dims, dims + ndim);
+  }
+  std::vector<float> output(uint32_t index = 0) const {
+    size_t n = 1;
+    for (uint32_t d : output_shape(index)) n *= d;
+    std::vector<float> out(n);
+    if (MXPredGetOutput(h_, index, out.data(),
+                        static_cast<uint32_t>(n)) != 0)
+      throw Error(std::string("MXPredGetOutput: ") + MXPredGetLastError());
+    return out;
+  }
+
+ private:
+  void *h_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_HPP_

@@ -1,0 +1,122 @@
+"""LSTM + CTC sequence recognition (OCR-style).
+
+Reproduces the reference's ``example/ctc/lstm_ocr.py`` workload
+(captcha OCR with warpctc): columns of a synthetic 'image' are fed as a
+time series to an LSTM, CTC loss aligns the unsegmented label sequence,
+and decoding is best-path (argmax + collapse-repeats + drop-blank).
+
+TPU-idiomatic notes: CTC's alpha recursion is a ``lax.scan`` over time in
+log space (one XLA while loop, batched over the (B, 2L+1) lattice —
+ops/nn.py ctc_loss), the LSTM is the scan-RNN, so the whole
+forward+loss+backward step is a single compiled module; no per-timestep
+Python, no warpctc-style external kernel.
+
+Run:  python example/ctc/lstm_ocr.py [--epochs 4]
+"""
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd  # noqa: E402
+from mxnet_tpu.gluon import Trainer, loss as gloss, nn, rnn  # noqa: E402
+
+NUM_CLASSES = 11       # blank=0 + digits 1..10
+LABEL_LEN = 4
+SEQ_LEN = 16           # image width = LSTM time steps
+IMG_H = 12
+
+
+def render(digits, rs):
+    """Each digit occupies ~4 columns with a distinct vertical stripe
+    pattern; noise everywhere. Unsegmented: the net must find boundaries."""
+    img = rs.rand(SEQ_LEN, IMG_H).astype(np.float32) * 0.2
+    for i, d in enumerate(digits):
+        c0 = i * 4 + rs.randint(0, 2)
+        rows = slice(1 + (d - 1) % 6, 1 + (d - 1) % 6 + 4)
+        img[c0:c0 + 3, rows] += 0.8
+        if d > 6:  # distinguish 7..10 with a top marker
+            img[c0:c0 + 3, 0:2] += 0.8
+    return np.clip(img, 0, 1)
+
+
+def make_data(n, rs):
+    y = rs.randint(1, NUM_CLASSES, size=(n, LABEL_LEN))
+    x = np.stack([render(row, rs) for row in y])
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+class OCRNet(mx.gluon.HybridBlock):
+    def __init__(self, hidden=64, **kw):
+        super().__init__(**kw)
+        self.lstm = rnn.LSTM(hidden, num_layers=1, bidirectional=True,
+                             layout="NTC")
+        self.head = nn.Dense(NUM_CLASSES, flatten=False)
+
+    def hybrid_forward(self, F, x):
+        return self.head(self.lstm(x))      # (n, t, classes)
+
+
+def best_path_decode(logits):
+    """argmax per frame -> collapse repeats -> drop blanks (class 0)."""
+    ids = logits.argmax(axis=2)
+    out = []
+    for row in ids:
+        seq, prev = [], -1
+        for t in row:
+            if t != prev and t != 0:
+                seq.append(int(t))
+            prev = t
+        out.append(seq)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--train-size", type=int, default=4096)
+    args = ap.parse_args()
+
+    rs = np.random.RandomState(21)
+    xtr, ytr = make_data(args.train_size, rs)
+    xte, yte = make_data(256, rs)
+
+    net = OCRNet()
+    net.initialize(mx.initializer.Xavier())
+    lossfn = gloss.CTCLoss(layout="NTC", label_layout="NT")
+    trainer = Trainer(net.collect_params(), "adam", {"learning_rate": 3e-3})
+
+    t0 = time.time()
+    for epoch in range(args.epochs):
+        perm = rs.permutation(len(xtr))
+        tot = 0.0
+        for i in range(0, len(xtr), args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            data, label = nd.array(xtr[idx]), nd.array(ytr[idx])
+            with autograd.record():
+                loss = lossfn(net(data), label)
+            loss.backward()
+            trainer.step(len(idx))
+            tot += float(loss.mean().asscalar()) * len(idx)
+        print("epoch %d ctc-loss %.4f (%.1fs)"
+              % (epoch, tot / len(xtr), time.time() - t0))
+
+    decoded = best_path_decode(net(nd.array(xte)).asnumpy())
+    truth = [[int(v) for v in row] for row in yte]
+    exact = np.mean([d == t for d, t in zip(decoded, truth)])
+    char_hits = np.mean([sum(a == b for a, b in zip(d, t)) / LABEL_LEN
+                         for d, t in zip(decoded, truth)])
+    print("test: %.3f exact sequences, %.3f per-char" % (exact, char_hits))
+    ok = char_hits > 0.5
+    print("ocr %s" % ("LEARNED" if ok else "failed"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

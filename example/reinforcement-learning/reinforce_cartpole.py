@@ -1,0 +1,137 @@
+"""REINFORCE policy gradient on a self-contained CartPole.
+
+Reproduces the reference's ``example/reinforcement-learning`` family
+(a3c / dqn / policy-gradient parity): an MLP policy trained with the
+score-function estimator and a running-mean baseline, on a
+dependency-free CartPole-v0 physics clone (no gym in the image — the env
+is the standard 4-state pole dynamics, same termination rules).
+
+TPU-idiomatic notes: rollouts happen on the host (tiny, sequential,
+branchy — the wrong shape for an accelerator), but the *learning* step
+batches every timestep of every episode into one (T_total, 4) forward and
+one weighted softmax-CE backward: a single XLA module per update, with
+the per-step returns folded in as ``sample_weight``. That split —
+host for simulation, one fused module for learning — is the TPU answer
+to the reference's per-step NDArray updates.
+
+Run:  python example/reinforcement-learning/reinforce_cartpole.py
+"""
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd  # noqa: E402
+from mxnet_tpu.gluon import Trainer, loss as gloss, nn  # noqa: E402
+
+
+class CartPole:
+    """Classic cart-pole dynamics (Barto-Sutton-Anderson constants, the
+    same ones gym's CartPole-v0 uses); episode ends at |x|>2.4,
+    |theta|>12deg, or 200 steps."""
+
+    def __init__(self, rs):
+        self.rs = rs
+        self.g, self.mc, self.mp = 9.8, 1.0, 0.1
+        self.l, self.fmag, self.dt = 0.5, 10.0, 0.02
+        self.reset()
+
+    def reset(self):
+        self.s = self.rs.uniform(-0.05, 0.05, size=4).astype(np.float64)
+        self.t = 0
+        return self.s.copy()
+
+    def step(self, action):
+        x, xd, th, thd = self.s
+        f = self.fmag if action == 1 else -self.fmag
+        cos, sin = np.cos(th), np.sin(th)
+        total = self.mc + self.mp
+        tmp = (f + self.mp * self.l * thd * thd * sin) / total
+        thacc = (self.g * sin - cos * tmp) / (
+            self.l * (4.0 / 3.0 - self.mp * cos * cos / total))
+        xacc = tmp - self.mp * self.l * thacc * cos / total
+        self.s = np.array([x + self.dt * xd, xd + self.dt * xacc,
+                           th + self.dt * thd, thd + self.dt * thacc])
+        self.t += 1
+        done = (abs(self.s[0]) > 2.4 or abs(self.s[2]) > 0.2095
+                or self.t >= 200)
+        return self.s.copy(), 1.0, done
+
+
+def discount(rewards, gamma):
+    out, run = np.empty(len(rewards), dtype=np.float32), 0.0
+    for i in range(len(rewards) - 1, -1, -1):
+        run = rewards[i] + gamma * run
+        out[i] = run
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--updates", type=int, default=60)
+    ap.add_argument("--episodes-per-update", type=int, default=8)
+    ap.add_argument("--gamma", type=float, default=0.99)
+    ap.add_argument("--target", type=float, default=120.0)
+    args = ap.parse_args()
+
+    rs = np.random.RandomState(17)
+    env = CartPole(rs)
+
+    policy = nn.HybridSequential()
+    policy.add(nn.Dense(32, activation="relu"), nn.Dense(2))
+    policy.initialize(mx.initializer.Xavier())
+    lossfn = gloss.SoftmaxCrossEntropyLoss(sparse_label=True)
+    trainer = Trainer(policy.collect_params(), "adam",
+                      {"learning_rate": 1e-2})
+
+    t0, first_len, avg_len = time.time(), None, 0.0
+    for upd in range(args.updates):
+        obs_all, act_all, ret_all, lens = [], [], [], []
+        for _ in range(args.episodes_per_update):
+            s, obs, acts, rews = env.reset(), [], [], []
+            done = False
+            while not done:
+                logits = policy(nd.array(s[None].astype(np.float32)))
+                p = np.exp(logits.asnumpy()[0] - logits.asnumpy()[0].max())
+                p /= p.sum()
+                a = int(rs.rand() < p[1])
+                obs.append(s.astype(np.float32))
+                acts.append(a)
+                s, r, done = env.step(a)
+                rews.append(r)
+            obs_all.extend(obs)
+            act_all.extend(acts)
+            ret_all.extend(discount(rews, args.gamma))
+            lens.append(len(rews))
+        rets = np.asarray(ret_all, dtype=np.float32)
+        adv = (rets - rets.mean()) / (rets.std() + 1e-6)
+        data = nd.array(np.stack(obs_all))
+        actions = nd.array(np.asarray(act_all, dtype=np.int32))
+        weights = nd.array(adv)
+        # one fused policy-gradient step over every timestep collected
+        with autograd.record():
+            loss = lossfn(policy(data), actions, weights.reshape(-1, 1))
+        loss.backward()
+        trainer.step(1)
+        avg_len = float(np.mean(lens))
+        if first_len is None:
+            first_len = avg_len
+        if upd % 10 == 0 or avg_len >= args.target:
+            print("update %3d  mean episode length %6.1f  (%.1fs)"
+                  % (upd, avg_len, time.time() - t0))
+        if avg_len >= args.target:
+            break
+
+    ok = avg_len >= args.target or avg_len > 2.5 * first_len
+    print("policy %s (%.1f -> %.1f steps/episode)"
+          % ("IMPROVED" if ok else "did not improve", first_len, avg_len))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -465,7 +465,18 @@ class NDArray:
         return key
 
     def __getitem__(self, key):
-        return NDArray(self._data[self._conv_index(key)], self._ctx)
+        jkey = self._conv_index(key)
+        from .. import autograd as _ag
+        if (_ag.is_recording() and self._in_graph
+                and jnp.issubdtype(self._data.dtype, jnp.inexact)):
+            # basic/advanced indexing is differentiable (reference: slice /
+            # gather ops with FGradient -> scatter-add); tape a vjp closure
+            # so x[...] inside record doesn't silently detach the graph
+            def _compute(attrs, x, _k=jkey):
+                return x[_k]
+            return _taped_call("getitem", None, [self._data], [self], [0],
+                               _compute, self._ctx)
+        return NDArray(self._data[jkey], self._ctx)
 
     def __setitem__(self, key, value):
         if isinstance(value, NDArray):

@@ -214,3 +214,32 @@ def test_grad_create_graph_with_head_grads():
         g = autograd.grad(y, [x], head_grads=nd.array(np.array([3.0])),
                           create_graph=True, retain_graph=True)[0]
     np.testing.assert_allclose(g.asnumpy(), [12.0], rtol=1e-5)  # 3 * 2x
+
+
+def test_getitem_is_differentiable():
+    """x[...] inside record must tape (reference basic indexing = slice op
+    with FGradient); regression for the detached-graph bug found by the
+    nce-loss example."""
+    import numpy as np
+    from mxnet_tpu import autograd, nd
+
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    x.attach_grad()
+    with autograd.record():
+        loss = (2 * x[:, 1:3]).sum() + (x[0] * 3).sum()
+    loss.backward()
+    expect = np.zeros((3, 4), dtype=np.float32)
+    expect[:, 1:3] += 2
+    expect[0] += 3
+    np.testing.assert_allclose(x.grad.asnumpy(), expect)
+
+    # advanced (array) indexing scatter-adds duplicate rows
+    y = nd.array(np.ones((4, 2), dtype=np.float32))
+    y.attach_grad()
+    idx = nd.array(np.array([0, 0, 3], dtype=np.int32))
+    with autograd.record():
+        loss = y[idx].sum()
+    loss.backward()
+    np.testing.assert_allclose(y.grad.asnumpy(),
+                               np.array([[2, 2], [0, 0], [0, 0], [1, 1]],
+                                        dtype=np.float32))

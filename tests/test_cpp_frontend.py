@@ -1,0 +1,37 @@
+"""Build + run the C++ frontend smoke binary (cpp-package/api_demo.cc).
+
+The reference's cpp-package wraps its C API in RAII classes
+(cpp-package/include/mxnet-cpp); our counterpart is
+cpp-package/include/mxtpu.hpp over src/mxtpu.h. The demo exercises the
+storage pool (alloc/free/pool-hit/stats), the dependency engine
+(writer->readers->writer ordering through Var deps, C++ exception
+containment in the trampoline), and recordio (100-record round trip +
+seek), asserting its own invariants and printing API_DEMO_OK.
+"""
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("make") is None,
+    reason="C++ toolchain unavailable")
+
+
+@pytest.mark.slow
+def test_cpp_api_demo(tmp_path):
+    env = dict(os.environ)
+    build = subprocess.run(["make", "-C", str(REPO / "cpp-package"),
+                            "api_demo"], capture_output=True, text=True,
+                           timeout=300, env=env)
+    assert build.returncode == 0, build.stderr[-2000:]
+    run = subprocess.run([str(REPO / "cpp-package" / "api_demo"),
+                          str(tmp_path / "demo.rec")],
+                         capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, run.stdout + run.stderr[-2000:]
+    assert "API_DEMO_OK" in run.stdout
+    assert "readers_ok=1" in run.stdout

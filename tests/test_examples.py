@@ -96,3 +96,63 @@ def test_quantize_model_example():
 def test_neural_style_example():
     out = _run("gluon/neural_style.py", "--iters", "40", "--size", "48")
     assert "IMPROVED" in out
+
+
+def test_fgsm_adversary_example():
+    out = _run("adversary/fgsm_mnist.py", "--epochs", "1",
+               "--train-size", "1024", "--batch-size", "64", timeout=600)
+    assert "attack SUCCEEDED" in out
+
+
+def test_vae_example():
+    out = _run("autoencoder/vae.py", "--epochs", "2",
+               "--train-size", "2048", timeout=600)
+    assert "ELBO improved" in out
+
+
+def test_text_cnn_example():
+    out = _run("cnn_text_classification/text_cnn.py", "--epochs", "2",
+               "--train-size", "1024", timeout=600)
+    assert "LEARNED" in out
+
+
+def test_bi_lstm_sort_example():
+    out = _run("bi-lstm-sort/sort_lstm.py", "--epochs", "3",
+               "--train-size", "2048", timeout=600)
+    assert "LEARNED" in out
+
+
+def test_multitask_example():
+    out = _run("multi-task/multitask_mnist.py", "--epochs", "2",
+               "--train-size", "1024", timeout=600)
+    assert "LEARNED BOTH" in out
+
+
+def test_ctc_ocr_example():
+    out = _run("ctc/lstm_ocr.py", "--epochs", "3",
+               "--train-size", "2048", timeout=600)
+    assert "ocr LEARNED" in out
+
+
+def test_reinforce_cartpole_example():
+    out = _run("reinforcement-learning/reinforce_cartpole.py",
+               "--updates", "50", timeout=600)
+    assert "IMPROVED" in out
+
+
+def test_svm_mnist_example():
+    out = _run("svm_mnist/svm_mnist.py", "--epochs", "1",
+               "--train-size", "1024", timeout=600)
+    assert "ALL LEARNED" in out
+
+
+def test_rbm_example():
+    out = _run("restricted-boltzmann-machine/binary_rbm.py", "--epochs", "3",
+               "--train-size", "1024", timeout=600)
+    assert "IMPROVED" in out
+
+
+def test_nce_lm_example():
+    out = _run("nce-loss/nce_lm.py", "--epochs", "2",
+               "--train-size", "4096", timeout=600)
+    assert "LEARNED" in out
