@@ -94,11 +94,33 @@ def _time_iters(run_one, budget_s=30.0, max_iters=20):
     return iters / (time.perf_counter() - t0)
 
 
-_PARTIAL = {"train": None, "infer_fp32": None, "infer_bf16": None,
-            "train_bf16": None, "train_percall": None,
-            "infer_fp32_percall": None, "steps_per_call": None,
-            "batch": None, "device": None,
-            "device_kind": None, "phase": "backend-init"}
+class _Partial(dict):
+    """Phase-state dict that checkpoints itself to disk on every write:
+    a relay drop can kill the process at any moment (r5: 23 min of TPU
+    uptime died with zero evidence), so each completed phase must leave a
+    crash-surviving trace (MXNET_BENCH_PARTIAL_PATH, default
+    bench_partial.json next to this script)."""
+
+    _path = os.environ.get(
+        "MXNET_BENCH_PARTIAL_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "bench_partial.json"))
+
+    def __setitem__(self, k, v):
+        super().__setitem__(k, v)
+        try:
+            with open(self._path + ".tmp", "w") as f:
+                json.dump(dict(self, ts=time.time()), f)
+            os.replace(self._path + ".tmp", self._path)
+        except OSError:
+            pass  # read-only fs must not break the bench itself
+
+
+_PARTIAL = _Partial({"train": None, "infer_fp32": None, "infer_bf16": None,
+                     "train_bf16": None, "train_percall": None,
+                     "infer_fp32_percall": None, "steps_per_call": None,
+                     "batch": None, "device": None,
+                     "device_kind": None, "phase": "backend-init"})
 _PRINTED = threading.Event()
 
 # ResNet-50 v1 224x224 forward ≈ 3.86 GFLOPs/image (multiply-add counted
@@ -197,119 +219,131 @@ def main():
     threading.Thread(target=watchdog, daemon=True).start()
 
     devices = _acquire_backend()
+    try:
 
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
 
-    import mxnet_tpu as mx
-    from mxnet_tpu import gluon, nd, parallel
-    from mxnet_tpu.gluon.model_zoo import vision
+        import mxnet_tpu as mx
+        from mxnet_tpu import gluon, nd, parallel
+        from mxnet_tpu.gluon.model_zoo import vision
 
-    if QUICK:
-        batch, side, classes = 4, 32, 10
-        make_net = vision.resnet18_v1
-        budget = 10.0
-    else:
-        batch, side, classes = 32, 224, 1000
-        make_net = vision.resnet50_v1
-        budget = 30.0
+        if QUICK:
+            batch, side, classes = 4, 32, 10
+            make_net = vision.resnet18_v1
+            budget = 10.0
+        else:
+            batch, side, classes = 32, 224, 1000
+            make_net = vision.resnet50_v1
+            budget = 30.0
 
-    dev = devices[0]
-    K = int(os.environ.get("MXNET_BENCH_STEPS_PER_CALL", "4" if QUICK
-                           else "16"))
-    _PARTIAL["batch"] = batch
-    _PARTIAL["steps_per_call"] = K
-    _PARTIAL["device"] = str(dev)
-    _PARTIAL["device_kind"] = getattr(dev, "device_kind", str(dev))
-    rng = np.random.RandomState(0)
-    # distinct data per fused step: (K, batch, ...) stacks
-    xs_np = rng.rand(K, batch, 3, side, side).astype(np.float32)
-    ys_np = rng.randint(0, classes, (K, batch))
-    x_np, y_np = xs_np[0], ys_np[0]
+        dev = devices[0]
+        K = int(os.environ.get("MXNET_BENCH_STEPS_PER_CALL", "4" if QUICK
+                               else "16"))
+        _PARTIAL["batch"] = batch
+        _PARTIAL["steps_per_call"] = K
+        _PARTIAL["device"] = str(dev)
+        _PARTIAL["device_kind"] = getattr(dev, "device_kind", str(dev))
+        rng = np.random.RandomState(0)
+        # distinct data per fused step: (K, batch, ...) stacks
+        xs_np = rng.rand(K, batch, 3, side, side).astype(np.float32)
+        ys_np = rng.randint(0, classes, (K, batch))
+        x_np, y_np = xs_np[0], ys_np[0]
 
-    # optional device-trace capture (MXNET_BENCH_PROFILE=dir): the
-    # steady-state train phase runs inside a jax profiler trace so a real
-    # TPU run leaves an inspectable timeline next to the JSON result
-    profile_dir = os.environ.get("MXNET_BENCH_PROFILE", "")
+        # optional device-trace capture (MXNET_BENCH_PROFILE=dir): the
+        # steady-state train phase runs inside a jax profiler trace so a real
+        # TPU run leaves an inspectable timeline next to the JSON result
+        profile_dir = os.environ.get("MXNET_BENCH_PROFILE", "")
 
-    mesh = parallel.device_mesh(1, devices=[dev])
-    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-    sgd = {"learning_rate": 0.05, "momentum": 0.9}
+        mesh = parallel.device_mesh(1, devices=[dev])
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        sgd = {"learning_rate": 0.05, "momentum": 0.9}
 
-    # ---- fused multi-step training, fp32: THE headline -------------------
-    # K steps per XLA call via lax.scan (TrainStep.multi_call): parameter
-    # I/O and per-call dispatch amortized K-fold — the scan-over-steps
-    # training loop TPU programs actually run in steady state.
-    _PARTIAL["phase"] = "train-fp32-compile"
-    net_t = make_net(classes=classes)
-    net_t.initialize()
-    step = parallel.TrainStep(net_t, loss_fn, "sgd", mesh,
-                              optimizer_params=dict(sgd))
-    xs, ys = nd.array(xs_np), nd.array(ys_np)
-    step.multi_call(xs, ys)._data.block_until_ready()  # compile
-    _PARTIAL["phase"] = "train-fp32-steady"
-    if profile_dir:
-        with jax.profiler.trace(profile_dir):
-            rate = _time_iters(lambda: step.multi_call(xs, ys),
-                               min(budget, 10.0))
-    else:
-        rate = _time_iters(lambda: step.multi_call(xs, ys), budget)
-    _PARTIAL["train"] = K * batch * rate
+        # ---- fused multi-step training, fp32: THE headline -------------------
+        # K steps per XLA call via lax.scan (TrainStep.multi_call): parameter
+        # I/O and per-call dispatch amortized K-fold — the scan-over-steps
+        # training loop TPU programs actually run in steady state.
+        _PARTIAL["phase"] = "train-fp32-compile"
+        net_t = make_net(classes=classes)
+        net_t.initialize()
+        step = parallel.TrainStep(net_t, loss_fn, "sgd", mesh,
+                                  optimizer_params=dict(sgd))
+        xs, ys = nd.array(xs_np), nd.array(ys_np)
+        step.multi_call(xs, ys)._data.block_until_ready()  # compile
+        _PARTIAL["phase"] = "train-fp32-steady"
+        if profile_dir:
+            with jax.profiler.trace(profile_dir):
+                rate = _time_iters(lambda: step.multi_call(xs, ys),
+                                   min(budget, 10.0))
+        else:
+            rate = _time_iters(lambda: step.multi_call(xs, ys), budget)
+        _PARTIAL["train"] = K * batch * rate
 
-    # ---- fused multi-step training, bf16 (the TPU-native precision) ------
-    _PARTIAL["phase"] = "train-bf16-compile"
-    net_tb = make_net(classes=classes)
-    net_tb.initialize()
-    net_tb(nd.array(x_np))  # materialize deferred params (fp32), then cast
-    net_tb.cast("bfloat16")
-    step_bf = parallel.TrainStep(net_tb, loss_fn, "sgd", mesh,
-                                 optimizer_params=dict(sgd))
-    xs_bf = mx.nd.NDArray(jnp.asarray(xs_np, jnp.bfloat16), mx.cpu())
-    step_bf.multi_call(xs_bf, ys)._data.block_until_ready()
-    _PARTIAL["phase"] = "train-bf16-steady"
-    _PARTIAL["train_bf16"] = round(
-        K * batch * _time_iters(lambda: step_bf.multi_call(xs_bf, ys),
-                                budget), 2)
+        # ---- fused multi-step training, bf16 (the TPU-native precision) ------
+        _PARTIAL["phase"] = "train-bf16-compile"
+        net_tb = make_net(classes=classes)
+        net_tb.initialize()
+        net_tb(nd.array(x_np))  # materialize deferred params (fp32), then cast
+        net_tb.cast("bfloat16")
+        step_bf = parallel.TrainStep(net_tb, loss_fn, "sgd", mesh,
+                                     optimizer_params=dict(sgd))
+        xs_bf = mx.nd.NDArray(jnp.asarray(xs_np, jnp.bfloat16), mx.cpu())
+        step_bf.multi_call(xs_bf, ys)._data.block_until_ready()
+        _PARTIAL["phase"] = "train-bf16-steady"
+        _PARTIAL["train_bf16"] = round(
+            K * batch * _time_iters(lambda: step_bf.multi_call(xs_bf, ys),
+                                    budget), 2)
 
-    # ---- fused multi-batch inference, fp32 & bf16 -------------------------
-    _PARTIAL["phase"] = "infer-fp32-compile"
-    net = make_net(classes=classes)
-    net.initialize()
-    net(nd.array(x_np))  # materialize params
-    infer = parallel.InferStep(net, mesh)
-    infer.multi_call(xs)._data.block_until_ready()
-    _PARTIAL["phase"] = "infer-fp32-steady"
-    _PARTIAL["infer_fp32"] = round(
-        K * batch * _time_iters(lambda: infer.multi_call(xs), budget), 2)
+        # ---- fused multi-batch inference, fp32 & bf16 -------------------------
+        _PARTIAL["phase"] = "infer-fp32-compile"
+        net = make_net(classes=classes)
+        net.initialize()
+        net(nd.array(x_np))  # materialize params
+        infer = parallel.InferStep(net, mesh)
+        infer.multi_call(xs)._data.block_until_ready()
+        _PARTIAL["phase"] = "infer-fp32-steady"
+        _PARTIAL["infer_fp32"] = round(
+            K * batch * _time_iters(lambda: infer.multi_call(xs), budget), 2)
 
-    _PARTIAL["phase"] = "infer-bf16-compile"
-    net_bf = make_net(classes=classes)
-    net_bf.initialize()
-    net_bf(nd.array(x_np))
-    net_bf.cast("bfloat16")
-    infer_bf = parallel.InferStep(net_bf, mesh)
-    infer_bf.multi_call(xs_bf)._data.block_until_ready()
-    _PARTIAL["phase"] = "infer-bf16-steady"
-    _PARTIAL["infer_bf16"] = round(
-        K * batch * _time_iters(lambda: infer_bf.multi_call(xs_bf), budget), 2)
+        _PARTIAL["phase"] = "infer-bf16-compile"
+        net_bf = make_net(classes=classes)
+        net_bf.initialize()
+        net_bf(nd.array(x_np))
+        net_bf.cast("bfloat16")
+        infer_bf = parallel.InferStep(net_bf, mesh)
+        infer_bf.multi_call(xs_bf)._data.block_until_ready()
+        _PARTIAL["phase"] = "infer-bf16-steady"
+        _PARTIAL["infer_bf16"] = round(
+            K * batch * _time_iters(lambda: infer_bf.multi_call(xs_bf), budget), 2)
 
-    # ---- per-call (single-step) numbers: the reference's own protocol ----
-    # (benchmark_score.py / train_imagenet.py time one dispatch per batch;
-    # kept as extras so dispatch-bound vs fused throughput is visible)
-    _PARTIAL["phase"] = "train-fp32-percall"
-    xt, yt = nd.array(x_np), nd.array(y_np)
-    step(xt, yt)._data.block_until_ready()
-    _PARTIAL["train_percall"] = round(
-        batch * _time_iters(lambda: step(xt, yt), min(budget, 15.0)), 2)
+        # ---- per-call (single-step) numbers: the reference's own protocol ----
+        # (benchmark_score.py / train_imagenet.py time one dispatch per batch;
+        # kept as extras so dispatch-bound vs fused throughput is visible)
+        _PARTIAL["phase"] = "train-fp32-percall"
+        xt, yt = nd.array(x_np), nd.array(y_np)
+        step(xt, yt)._data.block_until_ready()
+        _PARTIAL["train_percall"] = round(
+            batch * _time_iters(lambda: step(xt, yt), min(budget, 15.0)), 2)
 
-    _PARTIAL["phase"] = "infer-fp32-percall"
-    x1 = nd.array(x_np)
-    infer(x1)._data.block_until_ready()
-    _PARTIAL["infer_fp32_percall"] = round(
-        batch * _time_iters(lambda: infer(x1), min(budget, 15.0)), 2)
+        _PARTIAL["phase"] = "infer-fp32-percall"
+        x1 = nd.array(x_np)
+        infer(x1)._data.block_until_ready()
+        _PARTIAL["infer_fp32_percall"] = round(
+            batch * _time_iters(lambda: infer(x1), min(budget, 15.0)), 2)
 
-    _emit()
+        _emit()
+
+    except (KeyboardInterrupt, SystemExit):
+        raise  # an aborted run must NOT look like a settled result
+    except Exception as e:  # noqa: BLE001 - report, don't vanish
+        import traceback
+        traceback.print_exc()
+        sys.stderr.flush()
+        _emit(error="exception during phase %r: %r"
+              % (_PARTIAL["phase"], e))
+        return 0 if _PARTIAL["train"] else 2
+    return 0
 
 
 if __name__ == "__main__":

@@ -33,14 +33,16 @@ CONTEXT = 3
 
 
 def make_data(n, rs):
-    """Skip-gram-ish synthetic corpus: the target is a deterministic-ish
-    function of the context (mod-sum with noise), giving the model real
-    structure to learn while the unigram distribution stays non-uniform
-    (zipf), which is what NCE's noise draw is about."""
+    """Skip-gram-ish synthetic corpus: the target is a fixed permutation
+    of the head context word (plus occasional noise), giving the model
+    real structure to learn while the unigram distribution stays
+    non-uniform (zipf), which is what NCE's noise draw is about."""
     zipf = 1.0 / np.arange(1, VOCAB + 1)
     zipf /= zipf.sum()
     ctx = rs.choice(VOCAB, size=(n, CONTEXT), p=zipf)
-    tgt = (ctx.sum(axis=1) + rs.randint(0, 3, size=n)) % VOCAB
+    tgt = (3 * ctx[:, 0] + 7) % VOCAB
+    flip = rs.rand(n) < 0.05  # 5% label noise
+    tgt[flip] = rs.choice(VOCAB, size=int(flip.sum()), p=zipf)
     return ctx.astype(np.int32), tgt.astype(np.int32), zipf
 
 
@@ -48,11 +50,14 @@ class NCEModel(mx.gluon.HybridBlock):
     def __init__(self, embed=64, **kw):
         super().__init__(**kw)
         self.in_embed = nn.Embedding(VOCAB, embed)
+        self.mix = nn.Dense(embed, activation="relu")  # position-aware mixer
+        self.proj = nn.Dense(embed)
         self.out_embed = nn.Embedding(VOCAB, embed)  # output word vectors
         self.out_bias = nn.Embedding(VOCAB, 1)
 
     def context_vec(self, F, ctx):
-        return self.in_embed(ctx).mean(axis=1)            # (n, d)
+        flat = F.reshape(self.in_embed(ctx), (0, -1))     # (n, C*d)
+        return self.proj(self.mix(flat))                  # (n, d)
 
     def hybrid_forward(self, F, ctx, cand):
         """Scores of candidate words: (n, K+1)."""

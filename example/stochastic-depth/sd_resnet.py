@@ -1,0 +1,145 @@
+"""Stochastic-depth ResNet (Huang et al. 2016).
+
+Reproduces the reference's ``example/stochastic-depth`` workload: residual
+blocks are randomly dropped during training (block i survives with
+probability 1 - i/L * (1-pL)) and always kept — scaled by their survival
+probability — at inference.
+
+TPU-idiomatic notes: data-dependent "skip this block" control flow would
+defeat XLA's single-trace compilation, so death is expressed as a
+per-block Bernoulli *mask broadcast over the batch*: out = shortcut +
+mask * survive_scale * F(x). The mask comes from the host RNG as a tiny
+input array each step — the compiled module is identical every step (one
+fixed graph, MXU convs always execute; a dead block contributes zeros).
+That trades the reference's skipped-computation savings for trace
+stability — the right trade on a systolic accelerator where recompiles
+cost seconds and convs are cheap.
+
+Run:  python example/stochastic-depth/sd_resnet.py [--epochs 2]
+"""
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd  # noqa: E402
+from mxnet_tpu.gluon import Trainer, loss as gloss, nn  # noqa: E402
+
+NUM_BLOCKS = 4
+P_FINAL = 0.6  # survival probability of the deepest block
+
+
+def survival_probs():
+    return [1.0 - (i + 1) / NUM_BLOCKS * (1.0 - P_FINAL)
+            for i in range(NUM_BLOCKS)]
+
+
+def make_data(n, rs):
+    y = rs.randint(0, 10, size=n)
+    x = rs.rand(n, 3, 32, 32).astype(np.float32) * 0.2
+    for i, c in enumerate(y):
+        r, col = divmod(int(c), 4)
+        sl = (slice(4 + 5 * r, 9 + 5 * r), slice(3 + 6 * col, 8 + 6 * col))
+        x[i, 0][sl] += 0.7            # position encodes the class...
+        x[i, 1 + c % 2][sl] += 0.4    # ...and channel balance disambiguates
+    return np.clip(x, 0, 1), y.astype(np.int32)
+
+
+class ResBlock(mx.gluon.HybridBlock):
+    def __init__(self, channels, **kw):
+        super().__init__(**kw)
+        self.body = nn.HybridSequential()
+        self.body.add(nn.Conv2D(channels, 3, padding=1, use_bias=False),
+                      nn.BatchNorm(),
+                      nn.Activation("relu"),
+                      nn.Conv2D(channels, 3, padding=1, use_bias=False),
+                      nn.BatchNorm())
+
+    def hybrid_forward(self, F, x, gate):
+        # gate: scalar-per-sample (n, 1, 1, 1) — Bernoulli/p at train time,
+        # survival probability itself at eval (expectation scaling)
+        return F.Activation(x + F.broadcast_mul(self.body(x), gate),
+                            act_type="relu")
+
+
+class SDResNet(mx.gluon.HybridBlock):
+    def __init__(self, channels=32, **kw):
+        super().__init__(**kw)
+        self.stem = nn.Conv2D(channels, 3, padding=1)
+        self.blocks = []
+        for i in range(NUM_BLOCKS):
+            blk = ResBlock(channels)
+            setattr(self, "block%d" % i, blk)
+            self.blocks.append(blk)
+        self.head = nn.HybridSequential()
+        self.head.add(nn.GlobalAvgPool2D(), nn.Flatten(), nn.Dense(10))
+
+    def hybrid_forward(self, F, x, gates):
+        h = self.stem(x)
+        for i, blk in enumerate(self.blocks):
+            g = F.slice_axis(gates, axis=1, begin=i, end=i + 1)
+            h = blk(h, F.reshape(g, (-1, 1, 1, 1)))
+        return self.head(h)
+
+
+def train_gates(batch, probs, rs):
+    """Bernoulli keep-masks per (sample, block); kept blocks are NOT
+    rescaled at train time (reference semantics: test-time rescaling)."""
+    return (rs.rand(batch, NUM_BLOCKS) <
+            np.asarray(probs)[None, :]).astype(np.float32)
+
+
+def eval_gates(batch, probs):
+    return np.broadcast_to(np.asarray(probs, dtype=np.float32)[None, :],
+                           (batch, NUM_BLOCKS)).copy()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--train-size", type=int, default=2048)
+    args = ap.parse_args()
+
+    rs = np.random.RandomState(37)
+    xtr, ytr = make_data(args.train_size, rs)
+    xte, yte = make_data(512, rs)
+    probs = survival_probs()
+
+    net = SDResNet()
+    net.initialize(mx.initializer.Xavier())
+    lossfn = gloss.SoftmaxCrossEntropyLoss()
+    trainer = Trainer(net.collect_params(), "adam", {"learning_rate": 2e-3})
+
+    t0 = time.time()
+    for epoch in range(args.epochs):
+        perm = rs.permutation(len(xtr))
+        tot, dropped = 0.0, 0
+        for i in range(0, len(xtr), args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            gates = train_gates(len(idx), probs, rs)
+            dropped += int((gates == 0).sum())
+            data, label = nd.array(xtr[idx]), nd.array(ytr[idx])
+            with autograd.record():
+                loss = lossfn(net(data, nd.array(gates)), label)
+            loss.backward()
+            trainer.step(len(idx))
+            tot += float(loss.mean().asscalar()) * len(idx)
+        print("epoch %d loss %.4f (%d block-drops) (%.1fs)"
+              % (epoch, tot / len(xtr), dropped, time.time() - t0))
+
+    out = net(nd.array(xte), nd.array(eval_gates(len(xte), probs)))
+    acc = float((out.asnumpy().argmax(axis=1) == yte).mean())
+    print("test accuracy %.3f (eval uses expectation-scaled blocks)" % acc)
+    ok = acc > 0.75
+    print("stochastic-depth net %s" % ("LEARNED" if ok else "failed"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

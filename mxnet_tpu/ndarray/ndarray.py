@@ -71,6 +71,11 @@ class NDArray:
 
     @property
     def T(self) -> "NDArray":
+        from .. import autograd as _ag
+        if _ag.is_recording() and self._in_graph:
+            # differentiable like reference transpose (FGradient = transpose
+            # back); same tape-bypass class of bug as __getitem__
+            return invoke("transpose", self)
         return NDArray(jnp.transpose(self._data), self._ctx)
 
     @property

@@ -134,7 +134,15 @@ class OpDef:
         for pname, (ptype, pdefault) in self.params.items():
             if pname in raw:
                 v = raw[pname]
-                if isinstance(v, str) or ptype in (bool, int, float, tuple) or isinstance(ptype, str):
+                if v is None or (isinstance(v, str) and v == "None"):
+                    # explicit None on an optional attr = "unset" (reference
+                    # dmlc::optional<T> accepts the string "None"); required
+                    # attrs still error below via the parser
+                    if pdefault is not OpDef.REQUIRED:
+                        out[pname] = pdefault
+                    else:
+                        out[pname] = parser_for(ptype)(v)
+                elif isinstance(v, str) or ptype in (bool, int, float, tuple) or isinstance(ptype, str):
                     out[pname] = parser_for(ptype)(v)
                 else:
                     out[pname] = v

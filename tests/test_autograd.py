@@ -243,3 +243,18 @@ def test_getitem_is_differentiable():
     np.testing.assert_allclose(y.grad.asnumpy(),
                                np.array([[2, 2], [0, 0], [0, 0], [1, 1]],
                                         dtype=np.float32))
+
+
+def test_transpose_property_is_differentiable():
+    """x.T inside record must tape (same bug class as __getitem__)."""
+    import numpy as np
+    from mxnet_tpu import autograd, nd
+
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.attach_grad()
+    w = nd.array(np.ones((2, 4), dtype=np.float32))
+    with autograd.record():
+        loss = nd.dot(x.T, w).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               np.full((2, 3), 4, dtype=np.float32))

@@ -156,3 +156,21 @@ def test_nce_lm_example():
     out = _run("nce-loss/nce_lm.py", "--epochs", "2",
                "--train-size", "4096", timeout=600)
     assert "LEARNED" in out
+
+
+def test_lstnet_example():
+    out = _run("multivariate_time_series/lstnet.py", "--epochs", "4",
+               "--length", "1200", timeout=600)
+    assert "BEATS NAIVE" in out
+
+
+def test_stochastic_depth_example():
+    out = _run("stochastic-depth/sd_resnet.py", "--epochs", "3",
+               "--train-size", "1024", timeout=600)
+    assert "LEARNED" in out
+
+
+def test_fcn_segmentation_example():
+    out = _run("fcn-xs/fcn_segmentation.py", "--epochs", "2",
+               "--train-size", "1024", timeout=600)
+    assert "LEARNED" in out

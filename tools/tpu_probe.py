@@ -72,6 +72,11 @@ def run_bench(profile=False):
         if ln.startswith("{"):
             last = ln
     log("%sbench rc=%d result=%s" % (tag, out.returncode, last[:400]))
+    if not last or out.returncode != 0:
+        # surface the failure cause, not just the rc (r5: a silent rc=1
+        # with no JSON burned 23 min of relay uptime with zero evidence)
+        tail = (out.stderr or "").strip().splitlines()[-8:]
+        log("%sbench stderr tail: %s" % (tag, " | ".join(tail)[:1200]))
     ok = False
     if last:
         try:
