@@ -40,13 +40,19 @@ def survival_probs():
 
 
 def make_data(n, rs):
+    """Class = a TEXTURE (stripe orientation x channel x width), drawn in
+    a randomly-placed patch: a "what" signal that conv detectors find and
+    GlobalAvgPool aggregates (a "where" signal would be erased by GAP)."""
     y = rs.randint(0, 10, size=n)
     x = rs.rand(n, 3, 32, 32).astype(np.float32) * 0.2
     for i, c in enumerate(y):
-        r, col = divmod(int(c), 4)
-        sl = (slice(4 + 5 * r, 9 + 5 * r), slice(3 + 6 * col, 8 + 6 * col))
-        x[i, 0][sl] += 0.7            # position encodes the class...
-        x[i, 1 + c % 2][sl] += 0.4    # ...and channel balance disambiguates
+        ori, ch, wid = c % 2, (c // 2) % 3, 2 + (c // 6)
+        r0, c0 = rs.randint(0, 16), rs.randint(0, 16)
+        patch = np.zeros((16, 16), dtype=np.float32)
+        stripes = (np.arange(16) // wid) % 2 == 0
+        patch[stripes if ori else slice(None),
+              slice(None) if ori else stripes] = 0.8
+        x[i, ch, r0:r0 + 16, c0:c0 + 16] += patch
     return np.clip(x, 0, 1), y.astype(np.int32)
 
 
@@ -55,10 +61,10 @@ class ResBlock(mx.gluon.HybridBlock):
         super().__init__(**kw)
         self.body = nn.HybridSequential()
         self.body.add(nn.Conv2D(channels, 3, padding=1, use_bias=False),
-                      nn.BatchNorm(),
+                      nn.BatchNorm(momentum=0.7),
                       nn.Activation("relu"),
                       nn.Conv2D(channels, 3, padding=1, use_bias=False),
-                      nn.BatchNorm())
+                      nn.BatchNorm(momentum=0.7))
 
     def hybrid_forward(self, F, x, gate):
         # gate: scalar-per-sample (n, 1, 1, 1) — Bernoulli/p at train time,
@@ -101,7 +107,7 @@ def eval_gates(batch, probs):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--epochs", type=int, default=5)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--train-size", type=int, default=2048)
     args = ap.parse_args()
@@ -114,7 +120,7 @@ def main():
     net = SDResNet()
     net.initialize(mx.initializer.Xavier())
     lossfn = gloss.SoftmaxCrossEntropyLoss()
-    trainer = Trainer(net.collect_params(), "adam", {"learning_rate": 2e-3})
+    trainer = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
 
     t0 = time.time()
     for epoch in range(args.epochs):

@@ -36,7 +36,7 @@ def fully_connected(attrs, data, weight, *rest):
     if attrs.flatten and data.ndim > 2:
         data = data.reshape(data.shape[0], -1)
     out = jnp.matmul(data, weight.T)
-    if rest:
+    if rest and rest[0] is not None:
         out = out + rest[0]
     return out
 
@@ -94,7 +94,7 @@ def convolution(attrs, data, weight, *rest):
         feature_group_count=attrs.num_group,
         preferred_element_type=None,
     )
-    if rest:
+    if rest and rest[0] is not None:
         bias = rest[0]
         if layout.startswith("NC"):
             out = out + bias.reshape((1, -1) + (1,) * nd)
@@ -161,7 +161,7 @@ def deconvolution(attrs, data, weight, *rest):
         dimension_numbers=dn,
         feature_group_count=g,
     )
-    if rest:
+    if rest and rest[0] is not None:
         out = out + rest[0].reshape((1, -1) + (1,) * nd)
     return out
 

@@ -136,12 +136,13 @@ class OpDef:
                 v = raw[pname]
                 if v is None or (isinstance(v, str) and v == "None"):
                     # explicit None on an optional attr = "unset" (reference
-                    # dmlc::optional<T> accepts the string "None"); required
-                    # attrs still error below via the parser
+                    # dmlc::optional<T> accepts the string "None")
                     if pdefault is not OpDef.REQUIRED:
                         out[pname] = pdefault
                     else:
-                        out[pname] = parser_for(ptype)(v)
+                        raise MXNetError(
+                            "op %s: required attribute %r is None"
+                            % (self.name, pname))
                 elif isinstance(v, str) or ptype in (bool, int, float, tuple) or isinstance(ptype, str):
                     out[pname] = parser_for(ptype)(v)
                 else:

@@ -129,8 +129,8 @@ def test_multitask_example():
 
 
 def test_ctc_ocr_example():
-    out = _run("ctc/lstm_ocr.py", "--epochs", "3",
-               "--train-size", "2048", timeout=600)
+    out = _run("ctc/lstm_ocr.py", "--epochs", "6",
+               "--train-size", "2048", timeout=900)
     assert "ocr LEARNED" in out
 
 
@@ -165,7 +165,7 @@ def test_lstnet_example():
 
 
 def test_stochastic_depth_example():
-    out = _run("stochastic-depth/sd_resnet.py", "--epochs", "3",
+    out = _run("stochastic-depth/sd_resnet.py", "--epochs", "5",
                "--train-size", "1024", timeout=600)
     assert "LEARNED" in out
 
@@ -173,4 +173,10 @@ def test_stochastic_depth_example():
 def test_fcn_segmentation_example():
     out = _run("fcn-xs/fcn_segmentation.py", "--epochs", "2",
                "--train-size", "1024", timeout=600)
+    assert "LEARNED" in out
+
+
+def test_transformer_gpt_example():
+    out = _run("transformer/train_gpt.py", "--epochs", "2",
+               "--train-size", "1024", timeout=900)
     assert "LEARNED" in out

@@ -423,3 +423,27 @@ def test_model_store_pretrained_contract(tmp_path):
         model_store.get_model_file("resnet999", root)
     model_store.purge(root)
     assert not list(tmp_path.glob("*.params"))
+
+
+def test_dense_and_conv_no_bias():
+    """use_bias=False layers pass bias=None positionally; the op kernels
+    must skip it (regression: TypeError adding None in fully_connected,
+    found by the transformer example)."""
+    import numpy as np
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon import nn
+
+    d = nn.Dense(4, use_bias=False, flatten=False)
+    c = nn.Conv2D(3, 3, padding=1, use_bias=False)
+    d.initialize()
+    c.initialize()
+    x = nd.array(np.random.RandomState(0).rand(2, 5, 6).astype(np.float32))
+    xc = nd.array(np.random.RandomState(1).rand(2, 2, 8, 8).astype(np.float32))
+    assert d(x).shape == (2, 5, 4)
+    assert c(xc).shape == (2, 3, 8, 8)
+    # and under the tape (the path the transformer example exercises)
+    for p in list(d.collect_params().values()):
+        p.data().attach_grad()
+    with autograd.record():
+        loss = (d(x) ** 2).mean()
+    loss.backward()
