@@ -99,11 +99,12 @@ def main():
     ap.add_argument("--layers", type=int, default=2)
     args = ap.parse_args()
 
+    mx.random.seed(7)  # deterministic param init
     rs = np.random.RandomState(29)
     xtr, ytr = make_corpus(args.train_size, rs)
     xte, yte = make_corpus(256, rs)
-    pos = np.broadcast_to(np.arange(SEQ, dtype=np.int32),
-                          (args.batch_size, SEQ)).copy()
+    pos_nd = nd.array(np.broadcast_to(
+        np.arange(SEQ, dtype=np.int32), (args.batch_size, SEQ)).copy())
 
     net = GPT(layers=args.layers)
     net.initialize(mx.initializer.Xavier())
@@ -119,7 +120,7 @@ def main():
             idx = perm[i:i + args.batch_size]
             data, label = nd.array(xtr[idx]), nd.array(ytr[idx])
             with autograd.record():
-                loss = lossfn(net(data, nd.array(pos)), label)
+                loss = lossfn(net(data, pos_nd), label)
             loss.backward()
             trainer.step(1)
             tot += float(loss.mean().asscalar()); cnt += 1

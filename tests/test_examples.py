@@ -100,7 +100,7 @@ def test_neural_style_example():
 
 def test_fgsm_adversary_example():
     out = _run("adversary/fgsm_mnist.py", "--epochs", "1",
-               "--train-size", "1024", "--batch-size", "64", timeout=600)
+               "--train-size", "2048", "--batch-size", "64", timeout=600)
     assert "attack SUCCEEDED" in out
 
 

@@ -442,8 +442,9 @@ def test_dense_and_conv_no_bias():
     assert d(x).shape == (2, 5, 4)
     assert c(xc).shape == (2, 3, 8, 8)
     # and under the tape (the path the transformer example exercises)
-    for p in list(d.collect_params().values()):
-        p.data().attach_grad()
+    for blk in (d, c):
+        for p in list(blk.collect_params().values()):
+            p.data().attach_grad()
     with autograd.record():
-        loss = (d(x) ** 2).mean()
+        loss = (d(x) ** 2).mean() + (c(xc) ** 2).mean()
     loss.backward()
