@@ -180,3 +180,20 @@ def test_transformer_gpt_example():
     out = _run("transformer/train_gpt.py", "--epochs", "2",
                "--train-size", "1024", timeout=900)
     assert "LEARNED" in out
+
+
+def test_numpy_ops_custom_softmax_example():
+    out = _run("numpy-ops/custom_softmax.py", "--epochs", "2",
+               "--train-size", "1024", timeout=600)
+    assert "LEARNED" in out
+
+
+def test_profiler_demo_example():
+    out = _run("profiler/profiler_demo.py", "--steps", "20", timeout=600)
+    assert "profiler CAPTURED" in out
+
+
+def test_dec_example():
+    out = _run("deep-embedded-clustering/dec.py", "--dec-iters", "30",
+               timeout=600)
+    assert "IMPROVED" in out
