@@ -197,3 +197,14 @@ def test_dec_example():
     out = _run("deep-embedded-clustering/dec.py", "--dec-iters", "30",
                timeout=600)
     assert "IMPROVED" in out
+
+
+def test_dsd_example():
+    out = _run("dsd/dsd_training.py", "--train-size", "1024", timeout=600)
+    assert "COMPLETED" in out
+
+
+def test_capsnet_example():
+    out = _run("capsnet/capsnet.py", "--epochs", "2",
+               "--train-size", "1024", timeout=700)
+    assert "LEARNED" in out
