@@ -208,3 +208,9 @@ def test_capsnet_example():
     out = _run("capsnet/capsnet.py", "--epochs", "2",
                "--train-size", "1024", timeout=700)
     assert "LEARNED" in out
+
+
+def test_sgld_example():
+    out = _run("bayesian-methods/sgld.py", "--steps", "300",
+               "--burnin", "150", timeout=600)
+    assert "CALIBRATED" in out
