@@ -214,3 +214,9 @@ def test_sgld_example():
     out = _run("bayesian-methods/sgld.py", "--steps", "300",
                "--burnin", "150", timeout=600)
     assert "CALIBRATED" in out
+
+
+def test_ner_example():
+    out = _run("named_entity_recognition/ner_bilstm.py", "--epochs", "6",
+               "--train-size", "2048", timeout=900)
+    assert "LEARNED" in out
