@@ -77,6 +77,7 @@ def main():
     ap.add_argument("--train-size", type=int, default=4096)
     args = ap.parse_args()
 
+    mx.random.seed(7)  # deterministic param init
     rs = np.random.RandomState(11)
     xtr = make_data(args.train_size, rs)
 

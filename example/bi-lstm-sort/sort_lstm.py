@@ -62,6 +62,7 @@ def main():
     ap.add_argument("--train-size", type=int, default=4096)
     args = ap.parse_args()
 
+    mx.random.seed(7)  # deterministic param init
     rs = np.random.RandomState(5)
     xtr, ytr = make_data(args.train_size, rs)
     xte, yte = make_data(512, rs)

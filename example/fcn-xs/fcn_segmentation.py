@@ -89,6 +89,7 @@ def main():
     ap.add_argument("--train-size", type=int, default=1024)
     args = ap.parse_args()
 
+    mx.random.seed(7)  # deterministic param init
     rs = np.random.RandomState(43)
     xtr, ytr = make_data(args.train_size, rs)
     xte, yte = make_data(256, rs)

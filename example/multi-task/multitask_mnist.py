@@ -67,6 +67,7 @@ def main():
     ap.add_argument("--parity-weight", type=float, default=1.0)
     args = ap.parse_args()
 
+    mx.random.seed(7)  # deterministic param init
     rs = np.random.RandomState(9)
     xtr, ytr_d, ytr_p = make_data(args.train_size, rs)
     xte, yte_d, yte_p = make_data(512, rs)

@@ -90,6 +90,7 @@ def main():
     ap.add_argument("--length", type=int, default=2000)
     args = ap.parse_args()
 
+    mx.random.seed(7)  # deterministic param init
     rs = np.random.RandomState(23)
     series = make_series(args.length, rs)
     x, y = window_data(series)

@@ -90,6 +90,7 @@ def main():
     ap.add_argument("--train-size", type=int, default=8192)
     args = ap.parse_args()
 
+    mx.random.seed(7)  # deterministic param init
     rs = np.random.RandomState(13)
     ctx, tgt, zipf = make_data(args.train_size, rs)
     ctx_te, tgt_te, _ = make_data(1024, rs)

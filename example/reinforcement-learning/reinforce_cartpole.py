@@ -79,6 +79,7 @@ def main():
     ap.add_argument("--target", type=float, default=120.0)
     args = ap.parse_args()
 
+    mx.random.seed(7)  # deterministic param init
     rs = np.random.RandomState(17)
     env = CartPole(rs)
 
