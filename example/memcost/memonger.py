@@ -1,0 +1,118 @@
+"""Memory-cost engineering: where rematerialization actually saves HBM.
+
+Reproduces the reference's ``example/memcost`` study (memonger's
+sublinear training memory) with the compiler's own buffer accounting
+(``memory_analysis().temp_size_in_bytes``), not an estimate. Two
+findings, both measured here:
+
+1. **Whole-graph remat is a no-op inside one fused module.**
+   ``TrainStep(remat=True)`` wraps the full loss in ``jax.checkpoint``;
+   but when forward+backward compile into a single XLA module, the
+   "recomputed" forward feeds the same backward chain, so peak workspace
+   barely moves. (The flag still helps when fwd/bwd compile separately —
+   and costs nothing.)
+2. **Scan-granular remat is the real memonger.** Express the deep stack
+   as the framework's ``_foreach`` scan (symbol.contrib.foreach) with
+   ``remat=True``: each step's internals are recomputed inside that
+   step's backward, so live activations drop from O(depth) to O(1)+carry
+   — the sublinear-memory recipe, and the shape TPU training loops
+   (stacked-layer transformers) actually use.
+
+Run:  python example/memcost/memonger.py [--depth 32] [--width 256]
+"""
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd, parallel  # noqa: E402
+from mxnet_tpu.gluon import loss as gloss, nn  # noqa: E402
+from mxnet_tpu.ops.registry import get_op  # noqa: E402
+
+
+def trainstep_numbers(remat, depth, width, batch):
+    """Compiled workspace of the fused gluon TrainStep (finding 1)."""
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    for _ in range(depth):
+        net.add(nn.Dense(width, activation="relu"))
+    net.add(nn.Dense(10))
+    net.initialize(mx.initializer.Xavier())
+    x, y = nd.zeros((batch, width)), nd.zeros((batch,))
+    net(x)
+    step = parallel.TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                              parallel.device_mesh(1),
+                              optimizer_params={"learning_rate": 0.1},
+                              remat=remat)
+    step(x, y)._data.block_until_ready()
+    return step.memory_analysis().temp_size_in_bytes
+
+
+def scan_numbers(remat, depth, width, batch):
+    """Compiled workspace of the _foreach scan executor (finding 2):
+    loss = mean(final^2) after scanning x -> tanh(x @ w_i) over stacked
+    weights, gradient w.r.t. all weights."""
+    import jax
+    import jax.numpy as jnp
+
+    w_sym, x_sym = mx.symbol.var("w_in"), mx.symbol.var("x_in")
+    sub = mx.symbol.Group([mx.symbol.tanh(mx.symbol.dot(x_sym, w_sym))])
+    op = get_op("_foreach")
+    attrs = op.parse_attrs({
+        "__subgraph__": sub, "data_names": ("w_in",),
+        "state_names": ("x_in",), "free_names": (),
+        "num_out_data": 0, "remat": remat})
+
+    def loss(w, x):
+        (final,) = op.fcompute(attrs, w, x)
+        return (final * final).mean()
+
+    rs = np.random.RandomState(0)
+    wstack = jnp.asarray(rs.randn(depth, width, width)
+                         .astype(np.float32) * 0.1)
+    x0 = jnp.asarray(rs.randn(batch, width).astype(np.float32))
+    g = jax.jit(jax.grad(loss))
+    compiled = g.lower(wstack, x0).compile()
+    t0 = time.time()
+    np.asarray(g(wstack, x0))
+    return compiled.memory_analysis().temp_size_in_bytes, time.time() - t0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--depth", type=int, default=32)
+    ap.add_argument("--width", type=int, default=256)
+    ap.add_argument("--batch-size", type=int, default=2048)
+    args = ap.parse_args()
+
+    mb = 2.0 ** 20
+    print("== finding 1: whole-graph remat on the fused TrainStep")
+    t_stored = trainstep_numbers(False, args.depth, args.width,
+                                 args.batch_size)
+    t_remat = trainstep_numbers(True, args.depth, args.width,
+                                args.batch_size)
+    print("  stored %.1f MB | remat %.1f MB  (fused module: expect ~no "
+          "change)" % (t_stored / mb, t_remat / mb))
+
+    print("== finding 2: scan-granular remat (_foreach remat=True)")
+    s_stored, dt0 = scan_numbers(False, args.depth, args.width,
+                                 args.batch_size)
+    s_remat, dt1 = scan_numbers(True, args.depth, args.width,
+                                args.batch_size)
+    ratio = s_stored / max(s_remat, 1)
+    print("  stored %.1f MB (%.2fs) | remat %.1f MB (%.2fs) -> %.2fx "
+          "smaller workspace"
+          % (s_stored / mb, dt0, s_remat / mb, dt1, ratio))
+
+    ok = ratio > 1.3
+    print("memonger %s" % ("SUBLINEAR" if ok else "no saving"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

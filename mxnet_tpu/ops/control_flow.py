@@ -60,13 +60,21 @@ def _foreach_inputs(attrs):
         "state_names": (_names, REQUIRED),
         "free_names": (_names, ()),
         "num_out_data": (int, REQUIRED),
+        "remat": (bool, False),
     },
     inputs=_foreach_inputs,
     num_outputs=lambda a: a["num_out_data"] + len(a["state_names"]),
 )
 def _foreach(attrs, *inputs):
     """scan the subgraph over axis 0 of each data input; subgraph outputs
-    are [step outputs..., new states...] (reference control_flow.cc:35)."""
+    are [step outputs..., new states...] (reference control_flow.cc:35).
+
+    ``remat=True`` wraps the scan body in ``jax.checkpoint``: each step's
+    internal activations are recomputed in the backward instead of stored
+    — scan-granular rematerialization, the sublinear-memory recipe of the
+    reference's memonger (example/memcost). Whole-graph remat cannot
+    shrink a fused fwd+bwd module; per-step remat can (see
+    example/memcost/memonger.py for compiler-measured numbers)."""
     sub = attrs["__subgraph__"]
     dn, sn = attrs["data_names"], attrs["state_names"]
     fn = attrs["free_names"]
@@ -82,6 +90,10 @@ def _foreach(attrs, *inputs):
         outs = sub.eval_jax(vm)
         return tuple(outs[nod:]), tuple(outs[:nod])
 
+    if attrs.get("remat"):
+        import jax
+
+        step = jax.checkpoint(step)
     final_states, stacked = lax.scan(step, states, data)
     return tuple(stacked) + tuple(final_states)
 

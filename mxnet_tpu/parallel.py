@@ -530,9 +530,31 @@ class TrainStep(object):
         key = (tuple(d.shape), str(d.dtype), tuple(l.shape), str(l.dtype))
         if key not in self._step_jits:
             self._step_jits[key] = self._build_step([0])
+        # avals only (no live buffers): memory_analysis() must not pin a
+        # batch or donated-dead params on device
+        def _aval(a):
+            return jax.ShapeDtypeStruct(jnp.shape(a), a.dtype)
+        self._last_call = (key, self._step_jits[key], jax.tree_util.tree_map(
+            _aval, (self._pvals, self._opt_states, t, lr, d, l, rng)))
         loss, self._pvals, self._opt_states = self._step_jits[key](
             self._pvals, self._opt_states, t, lr, d, l, rng)
         return NDArray(loss, cpu())
+
+    def memory_analysis(self):
+        """XLA's compiled-buffer accounting for the last single-step
+        executor (CompiledMemoryStats: ``temp_size_in_bytes`` is the
+        stored-activation workspace — see example/memcost for where
+        ``remat`` does and does not shrink it). Call the step at least
+        once first; stats are cached per input signature."""
+        if getattr(self, "_last_call", None) is None:
+            raise MXNetError("memory_analysis: run the step once first")
+        key, jit_fn, avals = self._last_call
+        cache = getattr(self, "_mem_stats", None)
+        if cache is None:
+            cache = self._mem_stats = {}
+        if key not in cache:
+            cache[key] = jit_fn.lower(*avals).compile().memory_analysis()
+        return cache[key]
 
     # ------------------------------------------------------------------
     def multi_call(self, datas, labels):

@@ -48,9 +48,13 @@ def _check_single_output(flat, what):
     return flat
 
 
-def foreach(body, data, init_states, name=None):
+def foreach(body, data, init_states, name=None, remat=False):
     """Symbolic scan over axis 0 (reference symbol/contrib.py:foreach):
-    ``out, states = body(data_slice, states)``."""
+    ``out, states = body(data_slice, states)``.
+
+    ``remat=True`` rematerializes each step's activations in the backward
+    (scan-granular jax.checkpoint) — sublinear training memory for deep
+    stacks expressed as a scan (the memonger capability, example/memcost)."""
     from . import symbol as sym_mod
 
     name = NameManager.current().get(name, "foreach")
@@ -81,7 +85,8 @@ def foreach(body, data, init_states, name=None):
     res = sym_mod._invoke(
         "_foreach", list(data_list) + list(states_list) + free_symbols,
         {"__subgraph__": sub, "data_names": dnames, "state_names": snames,
-         "free_names": tuple(free_names), "num_out_data": len(flat_outs)},
+         "free_names": tuple(free_names), "num_out_data": len(flat_outs),
+         "remat": remat},
         name=name)
     nod = len(flat_outs)
     outputs, _ = _regroup([res[i] for i in range(nod)], out_fmt)

@@ -220,3 +220,9 @@ def test_ner_example():
     out = _run("named_entity_recognition/ner_bilstm.py", "--epochs", "6",
                "--train-size", "2048", timeout=900)
     assert "LEARNED" in out
+
+
+def test_memonger_example():
+    out = _run("memcost/memonger.py", "--depth", "24",
+               "--batch-size", "1024", timeout=600)
+    assert "SUBLINEAR" in out
