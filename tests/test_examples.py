@@ -226,3 +226,9 @@ def test_memonger_example():
     out = _run("memcost/memonger.py", "--depth", "24",
                "--batch-size", "1024", timeout=600)
     assert "SUBLINEAR" in out
+
+
+def test_gradcam_example():
+    out = _run("cnn_visualization/gradcam.py", "--epochs", "10",
+               "--train-size", "2048", timeout=700)
+    assert "FAITHFUL" in out
