@@ -1,0 +1,153 @@
+"""Implicit-feedback recommendation with BPR (Rendle et al. 2009).
+
+Reproduces the reference's ``example/recommenders`` family (MF /
+ranking-loss notebooks): factorize a binary interaction matrix by
+optimizing Bayesian Personalized Ranking — for sampled (user, seen-item,
+unseen-item) triples, push ``score(u, i+) > score(u, i-)`` through
+``-log sigma(s+ - s-)`` — and evaluate ranking quality with AUC plus
+hit-rate@10 against a popularity baseline.
+
+TPU-idiomatic notes: triple sampling is host-side (rejection sampling is
+branchy); the scoring/backward over a whole batch of triples is three
+embedding gathers + a row-dot — one compiled module per step. Full
+evaluation scores every user against ALL items as a single (users, d) x
+(d, items) MXU matmul.
+
+Run:  python example/recommenders/bpr_ranking.py [--epochs 6]
+"""
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd  # noqa: E402
+from mxnet_tpu.gluon import Trainer, nn  # noqa: E402
+
+USERS, ITEMS, DIM = 200, 400, 16
+
+
+def make_interactions(rs):
+    """Latent-taste ground truth: users and items live in a hidden 4-D
+    taste space; a user interacts with their top-quantile items plus
+    noise. Test = one held-out positive per user."""
+    u_t = rs.randn(USERS, 4)
+    i_t = rs.randn(ITEMS, 4)
+    affinity = u_t @ i_t.T + 0.5 * rs.randn(USERS, ITEMS)
+    seen = affinity > np.quantile(affinity, 0.9, axis=1, keepdims=True)
+    test_pos = np.full(USERS, -1)
+    for u in range(USERS):
+        pos = np.flatnonzero(seen[u])
+        if len(pos) >= 2:
+            test_pos[u] = pos[rs.randint(len(pos))]
+            seen[u, test_pos[u]] = False
+    return seen, test_pos
+
+
+class BPR(mx.gluon.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.user = nn.Embedding(USERS, DIM)
+        self.item = nn.Embedding(ITEMS, DIM)
+        self.bias = nn.Embedding(ITEMS, 1)
+
+    def hybrid_forward(self, F, u, i_pos, i_neg):
+        eu = self.user(u)                                  # (n, d)
+        sp = (eu * self.item(i_pos)).sum(axis=1) \
+            + self.bias(i_pos).reshape(-1)
+        sn = (eu * self.item(i_neg)).sum(axis=1) \
+            + self.bias(i_neg).reshape(-1)
+        return sp - sn
+
+    def all_scores(self):
+        return (nd.dot(self.user.weight.data(),
+                       self.item.weight.data().T)
+                + self.bias.weight.data().reshape(1, -1))
+
+
+def sample_triples(seen, n, rs):
+    users = rs.randint(0, USERS, n)
+    pos = np.empty(n, dtype=np.int64)
+    neg = np.empty(n, dtype=np.int64)
+    for k, u in enumerate(users):
+        pu = np.flatnonzero(seen[u])
+        pos[k] = pu[rs.randint(len(pu))] if len(pu) else rs.randint(ITEMS)
+        while True:
+            j = rs.randint(ITEMS)
+            if not seen[u, j]:
+                neg[k] = j
+                break
+    return users, pos, neg
+
+
+def evaluate(scores, seen, test_pos):
+    """AUC + HR@10 of the held-out positive vs all unseen items."""
+    aucs, hits, n = [], 0, 0
+    for u in range(USERS):
+        tp = test_pos[u]
+        if tp < 0:
+            continue
+        mask = ~seen[u]
+        mask[tp] = True
+        s = scores[u]
+        # ties count half (standard AUC), else integer-valued baselines
+        # like popularity get flattered by the strict comparison
+        rank = (s[mask] > s[tp]).sum() + 0.5 * ((s[mask] == s[tp]).sum() - 1)
+        num_unseen = mask.sum() - 1
+        aucs.append(1.0 - rank / max(num_unseen, 1))
+        hits += rank < 10
+        n += 1
+    return float(np.mean(aucs)), hits / max(n, 1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--steps-per-epoch", type=int, default=40)
+    args = ap.parse_args()
+
+    mx.random.seed(7)
+    rs = np.random.RandomState(79)
+    seen, test_pos = make_interactions(rs)
+
+    net = BPR()
+    net.initialize(mx.initializer.Normal(0.05))
+    trainer = Trainer(net.collect_params(), "adam", {"learning_rate": 5e-3})
+
+    # popularity baseline: rank by item interaction count
+    pop = seen.sum(axis=0).astype(np.float64)
+    pop_auc, pop_hr = evaluate(np.tile(pop, (USERS, 1)), seen, test_pos)
+
+    t0 = time.time()
+    for epoch in range(args.epochs):
+        tot = 0.0
+        for _ in range(args.steps_per_epoch):
+            u, ip, ineg = sample_triples(seen, args.batch_size, rs)
+            un, ipn, inn = (nd.array(a.astype(np.int32))
+                            for a in (u, ip, ineg))
+            with autograd.record():
+                diff = net(un, ipn, inn)
+                # -log sigmoid(diff), stable
+                loss = (nd.log(1 + nd.exp(-nd.abs(diff)))
+                        + nd.relu(-diff)).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asscalar())
+        print("epoch %d bpr-loss %.4f (%.1fs)"
+              % (epoch, tot / args.steps_per_epoch, time.time() - t0))
+
+    auc, hr = evaluate(net.all_scores().asnumpy(), seen, test_pos)
+    print("BPR  AUC %.3f HR@10 %.3f | popularity baseline AUC %.3f "
+          "HR@10 %.3f" % (auc, hr, pop_auc, pop_hr))
+    ok = auc > 0.75 and auc > pop_auc + 0.03
+    print("recommender %s" % ("BEATS POPULARITY" if ok else "failed"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -232,3 +232,8 @@ def test_gradcam_example():
     out = _run("cnn_visualization/gradcam.py", "--epochs", "10",
                "--train-size", "2048", timeout=700)
     assert "FAITHFUL" in out
+
+
+def test_bpr_recommender_example():
+    out = _run("recommenders/bpr_ranking.py", "--epochs", "6", timeout=600)
+    assert "BEATS POPULARITY" in out
