@@ -71,11 +71,16 @@ def _acquire_backend(timeout_s=120.0, retries=2):
     for path in candidates:
         try:
             with open(path) as f:
-                out["prior_evidence"] = {"file": os.path.basename(path),
-                                         "result": json.load(f)}
-            break
+                result = json.load(f)
         except (OSError, ValueError):
             continue
+        kind = str(result.get("device_kind")
+                   or result.get("extra", {}).get("device_kind") or "")
+        if "tpu" not in kind.lower():
+            continue  # a CPU quick-mode checkpoint is not chip evidence
+        out["prior_evidence"] = {"file": os.path.basename(path),
+                                 "result": result}
+        break
     print(json.dumps(out))
     sys.stdout.flush()
     os._exit(1)  # a hung probe thread would block a normal exit
