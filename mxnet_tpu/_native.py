@@ -23,7 +23,7 @@ import warnings
 from pathlib import Path
 from typing import Optional
 
-from .base import MXNetError
+from .base import MXNetError, get_env
 
 __all__ = ["get_lib", "check_call", "native_available", "build_lib"]
 
@@ -46,7 +46,7 @@ def build_lib(force: bool = False) -> Optional[Path]:
             return _LIB_PATH
     _LIB_PATH.parent.mkdir(parents=True, exist_ok=True)
     cmd = [
-        os.environ.get("CXX", "g++"), "-std=c++17", "-O2", "-fPIC", "-shared",
+        get_env("CXX", "g++", cache=False), "-std=c++17", "-O2", "-fPIC", "-shared",
         "-pthread", "-Wall", "-fvisibility=hidden",
         "-I", str(_SRC_DIR),
     ] + [str(s) for s in sources] + ["-o", str(_LIB_PATH)]
@@ -114,7 +114,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
     with _lock:
         if _load_attempted:
             return _lib
-        if os.environ.get("MXNET_USE_NATIVE", "1") == "0":
+        if get_env("MXNET_USE_NATIVE", "1", cache=False) == "0":
             _load_attempted = True
             return None
         path = build_lib()

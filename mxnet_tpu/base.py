@@ -39,10 +39,19 @@ integer_types = (int, np.integer)
 _ENV_CACHE: Dict[str, Any] = {}
 
 
-def get_env(name: str, default: Any = None, typ: Callable = str) -> Any:
+def get_env(name: str, default: Any = None, typ: Callable = str, *,
+            cache: bool = True) -> Any:
     """Read an ``MXNET_*`` environment knob (reference: dmlc::GetEnv usage,
-    documented in ``docs/faq/env_var.md``)."""
-    if name in _ENV_CACHE:
+    documented in ``docs/faq/env_var.md``).
+
+    Every environment knob in ``mxnet_tpu`` must flow through here — the
+    ``env-knob`` tpulint rule enforces it — so ``docs/env_var.md`` stays the
+    single registry. Pass ``cache=False`` for knobs a launcher or test sets
+    *after* import (e.g. ``MXNET_TPU_FAKE_DATA``, the ``MXNET_COORDINATOR_*``
+    trio): those re-read the environment on every call instead of freezing
+    the first value seen.
+    """
+    if cache and name in _ENV_CACHE:
         return _ENV_CACHE[name]
     raw = os.environ.get(name)
     if raw is None:
@@ -52,7 +61,8 @@ def get_env(name: str, default: Any = None, typ: Callable = str) -> Any:
             val = typ(raw)
         except (TypeError, ValueError):
             val = default
-    _ENV_CACHE[name] = val
+    if cache:
+        _ENV_CACHE[name] = val
     return val
 
 

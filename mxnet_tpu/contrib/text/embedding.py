@@ -17,7 +17,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ...base import MXNetError
+from ...base import MXNetError, get_env
 from . import vocab as _vocab
 
 __all__ = ["register", "create", "get_pretrained_file_names",
@@ -68,9 +68,10 @@ class TokenEmbedding(_vocab.Vocabulary):
     # -- loading -----------------------------------------------------------
     @classmethod
     def _embedding_root(cls):
-        return os.environ.get(
+        return get_env(
             "MXNET_EMBEDDING_ROOT",
-            os.path.join(os.path.expanduser("~"), ".mxnet", "embedding"))
+            os.path.join(os.path.expanduser("~"), ".mxnet", "embedding"),
+            cache=False)
 
     @classmethod
     def _resolve_pretrained(cls, pretrained_file_name):

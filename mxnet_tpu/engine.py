@@ -75,7 +75,7 @@ def _sync_outputs(result) -> None:
     elif isinstance(result, (list, tuple)):
         for r in result:
             if isinstance(r, NDArray):
-                r._data.block_until_ready()
+                r._data.block_until_ready()  # tpulint: disable=host-sync -- naive-mode debug sync is the point
 
 
 # ---------------------------------------------------------------------------

@@ -23,7 +23,8 @@ __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
 
 
 def _fake_ok():
-    return bool(int(os.environ.get("MXNET_TPU_FAKE_DATA", "0")))
+    # cache=False: tests toggle this per-case via monkeypatch.setenv
+    return bool(get_env("MXNET_TPU_FAKE_DATA", 0, int, cache=False))
 
 
 class _DownloadedDataset(Dataset):

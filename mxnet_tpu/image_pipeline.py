@@ -94,7 +94,7 @@ def _worker_main(task_q, result_q, rec_path, idx_path, cfg, seed):
     # keep the child light: no accelerator dial-out, CPU-only jax if any
     # transitive import pulls it in
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # tpulint: disable=env-knob -- worker env setup, not a knob read
     from multiprocessing import shared_memory
 
     from . import recordio
@@ -217,7 +217,7 @@ class MPImageRecordIter(DataIter):
         # the spawned child imports this package BEFORE _worker_main runs,
         # so accelerator-related env must be adjusted in the parent around
         # start(): no relay dial-out, CPU-only jax in workers
-        saved = {k: os.environ.get(k)
+        saved = {k: os.environ.get(k)  # tpulint: disable=env-knob -- save/restore around start(), not a knob read
                  for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")}
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
         os.environ["JAX_PLATFORMS"] = "cpu"

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import optimizer as opt
-from .base import MXNetError
+from .base import MXNetError, get_env
 from .ndarray.ndarray import NDArray
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreTPU", "create"]
@@ -384,9 +384,10 @@ def init_distributed(coordinator=None, num_workers=None, rank=None):
     global _DIST_INITIALIZED
     if _DIST_INITIALIZED:
         return True
-    coordinator = coordinator or os.environ.get("MXNET_COORDINATOR_ADDR")
-    num_workers = num_workers or os.environ.get("MXNET_NUM_WORKERS")
-    rank = rank if rank is not None else os.environ.get("MXNET_WORKER_RANK")
+    # cache=False: the launcher (tools/launch.py) plants these after import
+    coordinator = coordinator or get_env("MXNET_COORDINATOR_ADDR", cache=False)
+    num_workers = num_workers or get_env("MXNET_NUM_WORKERS", cache=False)
+    rank = rank if rank is not None else get_env("MXNET_WORKER_RANK", cache=False)
     if coordinator is None or num_workers is None or rank is None:
         return False
     jax.distributed.initialize(

@@ -755,7 +755,7 @@ def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
     else:
         data = np.asarray(source)
     if dtype is None:
-        dtype = data.dtype if data.dtype != np.float64 else np.float32
+        dtype = data.dtype if data.dtype != np.float64 else np.float32  # tpulint: disable=dtype-drift -- this IS the f64 downcast guard
     d = np_dtype(dtype) if isinstance(dtype, str) else dtype
     return _put(data.astype(d) if data.dtype != d else data, ctx)
 

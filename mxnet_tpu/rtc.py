@@ -100,7 +100,7 @@ class Kernel(object):
 _SIG_RE = re.compile(
     r"^\s*(?P<const>const\s+)?(?P<type>\w+)\s*(?P<ptr>\*)?\s*(?P<name>\w+)\s*$")
 
-_CTYPE_DT = {"float": np.float32, "double": np.float64, "int": np.int32,
+_CTYPE_DT = {"float": np.float32, "double": np.float64, "int": np.int32,  # tpulint: disable=dtype-drift -- C ABI signature table, host-side
              "long": np.int64, "half": np.float16, "bfloat16": jnp.bfloat16,
              "uint8": np.uint8, "int8": np.int8}
 

@@ -58,20 +58,35 @@ def rand_ndarray(shape, stype="default", density=None, dtype=np.float32, ctx=Non
     return cast_storage(array(data, ctx=ctx), stype)
 
 
+def _x64_enabled() -> bool:
+    """True when jax x64 mode is explicitly on (JAX_ENABLE_X64)."""
+    import jax
+
+    return bool(jax.config.jax_enable_x64)
+
+
 def numeric_grad(f: Callable[[List[np.ndarray]], np.ndarray], inputs: List[np.ndarray],
                  eps=1e-4) -> List[np.ndarray]:
-    """Central finite differences of sum(f(inputs)) w.r.t. each input."""
+    """Central finite differences of sum(f(inputs)) w.r.t. each input.
+
+    ``f`` is probed in the inputs' OWN dtype — nothing here promotes the
+    device computation. The float64 below is purely the host-side
+    accumulator for the sum/difference (differencing two nearly-equal f32
+    sums would lose the eps-sized signal to cancellation); like the metric
+    accumulators it never enters the device. Each probe syncs the device —
+    inherent to finite differencing, accepted in test-only code (hence the
+    inline host-sync suppressions)."""
     grads = []
     for i, x in enumerate(inputs):
-        g = np.zeros_like(x, dtype=np.float64)
+        g = np.zeros_like(x, dtype=np.float64)  # tpulint: disable=dtype-drift -- host accumulator only, never enters the device
         flat = x.reshape(-1)
         gflat = g.reshape(-1)
         for j in range(flat.size):
             orig = flat[j]
             flat[j] = orig + eps
-            fplus = float(np.sum(np.asarray(f(inputs), dtype=np.float64)))
+            fplus = float(np.sum(np.asarray(f(inputs), dtype=np.float64)))  # tpulint: disable=host-sync,dtype-drift -- host-side probe, inherent to finite differences
             flat[j] = orig - eps
-            fminus = float(np.sum(np.asarray(f(inputs), dtype=np.float64)))
+            fminus = float(np.sum(np.asarray(f(inputs), dtype=np.float64)))  # tpulint: disable=host-sync,dtype-drift -- host-side probe, inherent to finite differences
             flat[j] = orig
             gflat[j] = (fplus - fminus) / (2 * eps)
         grads.append(g.astype(x.dtype))
@@ -81,8 +96,20 @@ def numeric_grad(f: Callable[[List[np.ndarray]], np.ndarray], inputs: List[np.nd
 def check_numeric_gradient(fn: Callable, inputs: Sequence[np.ndarray],
                            eps=1e-3, rtol=1e-2, atol=1e-4, ctx=None):
     """Compare autograd gradients of `fn` (NDArray -> NDArray) against finite
-    differences (reference test_utils.check_numeric_gradient)."""
-    nd_inputs = [array(x.astype(np.float64) if False else x, ctx=ctx) for x in inputs]
+    differences (reference test_utils.check_numeric_gradient).
+
+    Inputs are promoted to float64 ONLY when jax x64 mode is explicitly
+    enabled. TPUs have no native f64: with x64 off, ``array()`` silently
+    downcasts f64 to f32, so an unconditional promotion (the reference's
+    default) would claim f64 precision while the device computes f32 — the
+    check would run a different program than the one being validated."""
+    promote = _x64_enabled()
+    if promote:
+        inputs = [x.astype(np.float64) for x in inputs]  # tpulint: disable=dtype-drift -- explicitly x64-guarded
+    # array() downcasts f64 by default; pass the dtype explicitly so the
+    # x64 promotion actually reaches the device.
+    nd_inputs = [array(x, ctx=ctx, dtype=x.dtype if promote else None)
+                 for x in inputs]
     for nd in nd_inputs:
         nd.attach_grad()
     with autograd.record():
@@ -92,7 +119,7 @@ def check_numeric_gradient(fn: Callable, inputs: Sequence[np.ndarray],
     analytic = [nd.grad.asnumpy() for nd in nd_inputs]
 
     def np_f(xs):
-        nds = [array(x, ctx=ctx) for x in xs]
+        nds = [array(x, ctx=ctx, dtype=x.dtype if promote else None) for x in xs]
         o = fn(*nds)
         return o.asnumpy() if isinstance(o, NDArray) else np.concatenate([v.asnumpy().reshape(-1) for v in o])
 

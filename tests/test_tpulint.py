@@ -1,0 +1,476 @@
+"""Tests for tools/tpulint — the AST-based TPU-correctness linter.
+
+Pure AST analysis: no JAX import, no device work — tier-1 fast by
+construction. Each pass gets positive + negative fixtures; suppression,
+baseline, the repo-wide gate, and the CLI exit-code contract are covered.
+"""
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.tpulint import core  # noqa: E402
+from tools.tpulint.cli import filter_to_scope, lint_paths, main  # noqa: E402
+from tools.tpulint.core import (DEFAULT_BASELINE, apply_baseline,  # noqa: E402
+                                baseline_counts, collect_files, lint_files,
+                                lint_source, load_baseline, write_baseline)
+
+
+def lint(src, rule=None, relpath="mxnet_tpu/fake.py"):
+    """Lint a snippet; returns findings (optionally for one rule)."""
+    findings = lint_source(relpath, textwrap.dedent(src),
+                           passes=[rule] if rule else None)
+    return findings
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------------
+
+def test_host_sync_asnumpy_in_loop():
+    found = lint("""
+        def f(batches):
+            out = []
+            for b in batches:
+                out.append(b.asnumpy())
+            return out
+    """, "host-sync")
+    assert len(found) == 1 and found[0].line == 5
+
+
+def test_host_sync_float_of_call_in_loop():
+    found = lint("""
+        def f(xs):
+            total = 0.0
+            while xs:
+                total += float(xs.pop().sum())
+            return total
+    """, "host-sync")
+    assert len(found) == 1
+
+
+def test_host_sync_in_jit_even_outside_loop():
+    found = lint("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * x.item()
+    """, "host-sync")
+    assert len(found) == 1 and "trace time" in found[0].message
+
+
+def test_host_sync_jit_reaches_helpers_transitively():
+    found = lint("""
+        import jax, numpy as np
+
+        def helper(x):
+            return np.asarray(x)
+
+        @jax.jit
+        def step(x):
+            return helper(x) + 1
+    """, "host-sync")
+    assert len(found) == 1 and found[0].line == 5
+
+
+def test_host_sync_negative():
+    assert not lint("""
+        def f(batches):
+            x = batches[0].asnumpy()      # outside any loop: one sync, fine
+            n = float(len(batches))       # len() never touches the device
+            for b in batches:
+                n += 1.0
+            return x, n
+    """, "host-sync")
+
+
+def test_host_sync_comprehension_counts_as_loop():
+    found = lint("""
+        def f(batches):
+            return [b.asnumpy() for b in batches]
+    """, "host-sync")
+    assert len(found) == 1
+
+
+# ---------------------------------------------------------------------------
+# tracer-leak
+# ---------------------------------------------------------------------------
+
+def test_tracer_leak_positive():
+    found = lint("""
+        import jax, os, time
+
+        @jax.jit
+        def step(x):
+            print("step!")
+            t = time.time()
+            flag = os.environ.get("MXNET_FLAG")
+            return x + t
+    """, "tracer-leak")
+    msgs = " ".join(f.message for f in found)
+    assert len(found) == 3
+    assert "print" in msgs and "time.time" in msgs and "os.environ" in msgs
+
+
+def test_tracer_leak_global_and_wrapped_lambda():
+    found = lint("""
+        import jax
+
+        _calls = 0
+
+        def bump(x):
+            global _calls
+            _calls += 1
+            return x
+
+        f = jax.jit(lambda x: bump(x) + 1)
+    """, "tracer-leak")
+    assert len(found) == 1 and "global _calls" in found[0].message
+
+
+def test_tracer_leak_curried_partial_wrap():
+    found = lint("""
+        import jax
+        from functools import partial
+
+        def step(x):
+            print("traced")
+            return x
+
+        fast_step = partial(jax.jit, donate_argnums=0)(step)
+    """, "tracer-leak")
+    assert len(found) == 1 and "print" in found[0].message
+
+
+def test_tracer_leak_partial_decorator_and_np_random():
+    found = lint("""
+        import jax, numpy as np
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=0)
+        def step(n, x):
+            return x + np.random.rand(n)
+    """, "tracer-leak")
+    assert len(found) == 1 and "np.random.rand" in found[0].message
+
+
+def test_tracer_leak_negative_outside_jit():
+    assert not lint("""
+        import os, time
+
+        def host_loop(x):
+            print("fine here")
+            return x, time.time(), os.getenv("HOME")
+    """, "tracer-leak")
+
+
+# ---------------------------------------------------------------------------
+# dtype-drift
+# ---------------------------------------------------------------------------
+
+def test_dtype_drift_positive():
+    found = lint("""
+        import numpy as np
+        import jax.numpy as jnp
+
+        def f(x):
+            return np.zeros(3, dtype=np.float64) + x.astype(jnp.float64)
+    """, "dtype-drift")
+    assert len(found) == 2
+
+
+def test_dtype_drift_registry_exempt():
+    assert not lint("""
+        import jax.numpy as jnp
+
+        DTYPE_NP = {
+            "float64": jnp.float64,
+            "float32": jnp.float32,
+        }
+    """, "dtype-drift")
+
+
+def test_dtype_drift_negative():
+    assert not lint("""
+        import numpy as np
+
+        def f(x):
+            return x.astype(np.float32)
+    """, "dtype-drift")
+
+
+# ---------------------------------------------------------------------------
+# native-guard
+# ---------------------------------------------------------------------------
+
+def test_native_guard_unguarded_assign():
+    found = lint("""
+        from mxnet_tpu import _native
+
+        def stats():
+            lib = _native.get_lib()
+            return lib.MXTPUStorageStats()
+    """, "native-guard")
+    assert len(found) == 1 and "never checked" in found[0].message
+
+
+def test_native_guard_guarded_variants():
+    assert not lint("""
+        from mxnet_tpu import _native
+
+        def a():
+            lib = _native.get_lib()
+            if lib is None:
+                return 0
+            return lib.f()
+
+        def b():
+            lib = _native.get_lib()
+            return lib.f() if lib is not None else 0
+
+        def c():
+            lib = _native.get_lib()
+            if not lib:
+                return 0
+            return lib.f()
+
+        def d():
+            lib = _native.get_lib()
+            return getattr(lib, "_name", None) or "unavailable"
+
+        def e():
+            return _native.get_lib() is not None
+    """, "native-guard")
+
+
+def test_native_guard_return_forward_and_direct_use():
+    found = lint("""
+        from mxnet_tpu import _native
+
+        def forward():
+            return _native.get_lib()
+
+        def direct():
+            return _native.get_lib().f()
+    """, "native-guard")
+    assert len(found) == 2
+    assert any("forwards an unguarded Optional" in f.message for f in found)
+    assert any("used directly" in f.message for f in found)
+
+
+# ---------------------------------------------------------------------------
+# env-knob
+# ---------------------------------------------------------------------------
+
+def test_env_knob_positive_reads():
+    found = lint("""
+        import os
+
+        A = os.environ.get("MXNET_A", "1")
+        B = os.getenv("MXNET_B")
+        C = os.environ["MXNET_C"]
+        D = os.environ.setdefault("MXNET_D", "x")
+    """, "env-knob")
+    assert len(found) == 4
+
+
+def test_env_knob_mutations_not_flagged():
+    assert not lint("""
+        import os
+
+        os.environ["MXNET_A"] = "1"
+        os.environ.pop("MXNET_B", None)
+        del os.environ["MXNET_C"]
+    """, "env-knob")
+
+
+def test_env_knob_scoped_to_mxnet_tpu():
+    src = """
+        import os
+        A = os.environ.get("MXNET_A")
+    """
+    assert lint(src, "env-knob", relpath="mxnet_tpu/x.py")
+    assert not lint(src, "env-knob", relpath="tools/x.py")
+    assert not lint(src, "env-knob", relpath="mxnet_tpu/base.py")
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression():
+    src = """
+        import os
+        A = os.environ.get("MXNET_A")  # tpulint: disable=env-knob -- justified
+        B = os.environ.get("MXNET_B")  # tpulint: disable=all
+        C = os.environ.get("MXNET_C")  # tpulint: disable=host-sync (wrong rule)
+    """
+    found = lint(src, "env-knob")
+    assert len(found) == 1 and found[0].line == 5
+
+
+def test_baseline_roundtrip(tmp_path):
+    src_v1 = "import os\nA = os.environ.get('MXNET_A')\n"
+    f1 = lint_source("mxnet_tpu/x.py", src_v1, passes=["env-knob"])
+    assert len(f1) == 1
+    bl = tmp_path / "baseline.json"
+    write_baseline(f1, bl)
+    baseline = load_baseline(bl)
+    # same findings -> nothing new, even when lines shift
+    shifted = lint_source("mxnet_tpu/x.py", "import os\n\n\nA = os.environ.get('MXNET_A')\n",
+                          passes=["env-knob"])
+    assert apply_baseline(shifted, baseline) == []
+    # a second occurrence of the same key -> exactly the surplus is new
+    src_v2 = src_v1 + "B = os.environ.get('MXNET_A')\n"
+    f2 = lint_source("mxnet_tpu/x.py", src_v2, passes=["env-knob"])
+    new = apply_baseline(f2, baseline)
+    assert len(new) == 1 and new[0].line == 3
+
+
+def test_baseline_counts_keys_have_no_line_numbers():
+    f = lint_source("mxnet_tpu/x.py", "import os\nA = os.environ.get('X')\n",
+                    passes=["env-knob"])
+    (key,) = baseline_counts(f)
+    assert key.startswith("mxnet_tpu/x.py::env-knob::")
+    assert "\n" not in key and ":2:" not in key
+
+
+# ---------------------------------------------------------------------------
+# repo gate + CLI contract
+# ---------------------------------------------------------------------------
+
+def test_gate_repo_is_clean_against_committed_baseline():
+    """The acceptance gate: zero non-baselined findings across mxnet_tpu/
+    and tools/. A new hazard in a PR lands here as a failure."""
+    new, all_findings = lint_paths(["mxnet_tpu", "tools"])
+    assert new == [], "new tpulint findings (fix, suppress with justification," \
+                      " or --write-baseline):\n" + "\n".join(map(str, new))
+    # the baseline itself must stay honest: every entry still matches code
+    counts = baseline_counts(all_findings)
+    baseline = load_baseline(DEFAULT_BASELINE)
+    stale = [k for k in baseline if counts.get(k, 0) < baseline[k]]
+    assert stale == [], "stale baseline entries (regenerate with " \
+                        "--write-baseline):\n" + "\n".join(stale)
+
+
+def test_cli_exit_codes(tmp_path):
+    clean = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "mxnet_tpu", "tools"],
+        cwd=str(REPO), capture_output=True, text=True)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    bad = tmp_path / "viol.py"
+    bad.write_text("def f(xs):\n    return [x.asnumpy() for x in xs]\n")
+    dirty = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", str(bad)],
+        cwd=str(REPO), capture_output=True, text=True)
+    assert dirty.returncode == 1, dirty.stdout + dirty.stderr
+    assert "host-sync" in dirty.stdout
+
+
+def test_cli_json_format_and_select(tmp_path, capsys):
+    bad = tmp_path / "viol.py"
+    bad.write_text("import os\ndef f(xs):\n    return [x.asnumpy() for x in xs]\n")
+    rc = main([str(bad), "--format", "json", "--select", "host-sync"])
+    out = capsys.readouterr().out
+    payload = json.loads(out)
+    assert rc == 1
+    assert payload["total"] == 1 and payload["new"][0]["rule"] == "host-sync"
+    # unknown rule -> usage error
+    assert main([str(bad), "--select", "no-such-rule"]) == 2
+
+
+def test_cli_write_baseline_then_clean(tmp_path, capsys):
+    bad = tmp_path / "viol.py"
+    bad.write_text("def f(xs):\n    return [x.asnumpy() for x in xs]\n")
+    bl = tmp_path / "bl.json"
+    assert main([str(bad), "--baseline", str(bl), "--write-baseline"]) == 0
+    capsys.readouterr()
+    assert main([str(bad), "--baseline", str(bl)]) == 0
+    # an additional violation beyond the baselined one -> fails again
+    bad.write_text("def f(xs):\n    return [x.asnumpy() for x in xs]\n"
+                   "def g(xs):\n    return [x.item() for x in xs]\n")
+    capsys.readouterr()
+    assert main([str(bad), "--baseline", str(bl)]) == 1
+
+
+def test_collect_files_survives_hidden_ancestor(tmp_path):
+    # a dotted ancestor of the scanned dir must not empty the lint scope
+    pkg = tmp_path / ".work" / "repo" / "pkg"
+    pkg.mkdir(parents=True)
+    (pkg / "mod.py").write_text("x = 1\n")
+    (pkg / ".hidden" ).mkdir()
+    (pkg / ".hidden" / "skip.py").write_text("x = 1\n")
+    files = collect_files([str(pkg)])
+    assert [f.name for f in files] == ["mod.py"]
+
+
+def test_write_baseline_scoped_run_keeps_other_entries(tmp_path, capsys):
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text("def f(xs):\n    return [x.asnumpy() for x in xs]\n")
+    b.write_text("def g(xs):\n    return [x.item() for x in xs]\n")
+    bl = tmp_path / "bl.json"
+    assert main([str(a), str(b), "--baseline", str(bl), "--write-baseline"]) == 0
+    # re-baselining only a.py must not drop b.py's entry
+    assert main([str(a), "--baseline", str(bl), "--write-baseline"]) == 0
+    capsys.readouterr()
+    assert main([str(a), str(b), "--baseline", str(bl)]) == 0
+    # and a scoped *check* of a.py alone must not report b.py's entry stale
+    assert main([str(a), "--baseline", str(bl)]) == 0
+    assert "stale" not in capsys.readouterr().out
+
+
+def test_nonexistent_path_is_usage_error(tmp_path, capsys):
+    # a typo'd path must not produce a green "0 findings" run
+    assert main([str(tmp_path / "does_not_exist.py")]) == 2
+    assert main(["mxnet_tpu/no_such_file.py"]) == 2
+
+
+def test_changed_only_git_failure_is_loud(monkeypatch):
+    from tools.tpulint import cli as cli_mod
+
+    monkeypatch.setattr(cli_mod, "changed_files", lambda: None)
+    assert cli_mod.main(["--changed-only"]) == 2
+
+
+def test_changed_only_filter():
+    scope = collect_files(["mxnet_tpu"])
+    changed = ["mxnet_tpu/base.py", "mxnet_tpu/does_not_exist.py", "README.md"]
+    picked = filter_to_scope(changed, scope)
+    assert [p.name for p in picked] == ["base.py"]
+
+
+def test_list_rules_names_all_five(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("host-sync", "tracer-leak", "dtype-drift", "native-guard",
+                 "env-knob"):
+        assert rule in out
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    found = lint_files([bad], root=tmp_path)
+    assert len(found) == 1 and found[0].rule == "parse-error"
+
+
+def test_undecodable_and_null_byte_files_are_findings_not_crashes(tmp_path):
+    latin = tmp_path / "latin.py"
+    latin.write_bytes(b"# caf\xe9\nx = 1\n")
+    nul = tmp_path / "nul.py"
+    nul.write_bytes(b"x = 1\x00\n")
+    found = lint_files([latin, nul], root=tmp_path)
+    assert sorted(f.rule for f in found) == ["parse-error", "parse-error"]

@@ -1,0 +1,4 @@
+"""Repo utility scripts. A package so ``python -m tools.tpulint`` resolves;
+the standalone scripts (im2rec.py, launch.py, ...) are still run directly
+and keep importing each other via sys.path, not via this package.
+"""

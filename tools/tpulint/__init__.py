@@ -1,0 +1,24 @@
+"""tpulint — AST-based TPU-correctness linter for mxnet_tpu.
+
+Programmatic entry points::
+
+    from tools.tpulint import lint_paths, main
+    new_findings, all_findings = lint_paths(["mxnet_tpu"])
+
+CLI::
+
+    python -m tools.tpulint [paths...] [--format json] [--write-baseline]
+                            [--changed-only] [--no-baseline] [--list-rules]
+
+Pure stdlib ``ast`` — no JAX import, no device work; safe in tier-1 CI.
+"""
+from .core import (DEFAULT_BASELINE, DEFAULT_ROOTS, FileContext, Finding,
+                   Pass, REGISTRY, all_passes, apply_baseline, collect_files,
+                   lint_files, lint_source, load_baseline, write_baseline)
+from .cli import lint_paths, main
+
+__all__ = [
+    "DEFAULT_BASELINE", "DEFAULT_ROOTS", "FileContext", "Finding", "Pass",
+    "REGISTRY", "all_passes", "apply_baseline", "collect_files", "lint_files",
+    "lint_source", "load_baseline", "write_baseline", "lint_paths", "main",
+]

@@ -1,0 +1,398 @@
+"""tpulint core: findings, per-file analysis context, pass registry, baseline.
+
+TPU-correctness static analysis for mxnet_tpu. The reference framework's
+async engine made ordering hazards *loud* (a missed WaitForVar deadlocks or
+races immediately); on JAX/XLA the equivalent hazard class is *silent* —
+an implicit device->host sync, a side effect swallowed by `jit` tracing, or
+float64 creep all run fine on the CPU tier-1 suite and only show up as a
+TPU throughput cliff or a wrong number. tpulint walks the source with the
+stdlib `ast` module (no new deps, no JAX import, no device work) and flags
+those hazards mechanically before a PR lands.
+
+Design:
+
+- a :class:`Pass` inspects one :class:`FileContext` and yields
+  :class:`Finding`\\ s; passes self-register into :data:`REGISTRY`;
+- per-line suppression with ``# tpulint: disable=<rule>[,<rule>...]``
+  (``disable=all`` silences every rule on that line);
+- a committed baseline (``tools/tpulint/baseline.json``) keyed by
+  ``path::rule::message`` — deliberately *not* by line number, so unrelated
+  edits that shift lines don't invalidate it — lets pre-existing findings
+  ride while any new finding fails the gate.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Sequence
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+DEFAULT_ROOTS = ("mxnet_tpu", "tools")
+
+_SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+class Finding:
+    """One rule violation at a source location."""
+
+    __slots__ = ("rule", "path", "line", "col", "message")
+
+    def __init__(self, rule: str, path: str, line: int, col: int, message: str):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+
+    def baseline_key(self) -> str:
+        # No line number: baselines must survive unrelated edits above them.
+        # Known tradeoff: keys collide per (file, rule, message), so fixing
+        # one baselined site while adding an identical new one in the same
+        # file cancels out and the new site rides the old entry. Accepted —
+        # the alternative (line keys) invalidates the whole baseline on any
+        # edit; burn-down shrinks the counts over time either way.
+        return "%s::%s::%s" % (self.path, self.rule, self.message)
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+    def __str__(self) -> str:
+        return "%s:%d:%d: [%s] %s" % (self.path, self.line, self.col, self.rule, self.message)
+
+    def __repr__(self) -> str:
+        return "Finding(%s)" % self
+
+
+class FileContext:
+    """Parsed source plus the lookups every pass needs (parents, comments)."""
+
+    def __init__(self, relpath: str, source: str, filename: str = "<string>"):
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=filename)
+        attach_parents(self.tree)
+        self._suppressions: Dict[int, set] = {}
+        for lineno, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                self._suppressions[lineno] = {r.strip() for r in m.group(1).split(",")}
+        self._jit_functions: Optional[set] = None
+
+    def jit_functions(self) -> set:
+        """Cached :func:`jit_functions` of this file's tree — several passes
+        need it and the transitive-closure walk is the expensive part."""
+        if self._jit_functions is None:
+            self._jit_functions = jit_functions(self.tree)
+        return self._jit_functions
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self._suppressions.get(line)
+        return rules is not None and (rule in rules or "all" in rules)
+
+    def finding(self, node: ast.AST, rule: str, message: str) -> Finding:
+        return Finding(rule, self.relpath, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), message)
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by passes
+# ---------------------------------------------------------------------------
+
+def attach_parents(tree: ast.AST) -> None:
+    """Annotate every node with ``.tpulint_parent`` (None at the root)."""
+    tree.tpulint_parent = None  # type: ignore[attr-defined]
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            child.tpulint_parent = parent  # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "tpulint_parent", None)
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = parent(node)
+    while cur is not None:
+        yield cur
+        cur = parent(cur)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``'jax.numpy.float64'`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return anc
+    return None
+
+
+def enclosing_scope(node: ast.AST) -> ast.AST:
+    """Nearest function def, else the module."""
+    cur: ast.AST = node
+    for anc in ancestors(node):
+        cur = anc
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return anc
+    return cur
+
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While, ast.comprehension)
+
+
+def in_loop(node: ast.AST) -> bool:
+    """True when `node` sits inside a loop body *within its own function* —
+    a loop in an outer function does not make a nested def per-iteration."""
+    for anc in ancestors(node):
+        if isinstance(anc, _LOOPS) or isinstance(anc, (ast.ListComp, ast.SetComp,
+                                                       ast.DictComp, ast.GeneratorExp)):
+            return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return False
+    return False
+
+
+# -- jit detection ----------------------------------------------------------
+
+_JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.pjit", "eqx.filter_jit"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for an expression denoting jax.jit or a configured jit:
+    ``jax.jit``, ``jit``, ``jax.jit(...)``, ``partial(jax.jit, ...)``."""
+    if dotted_name(node) in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in _JIT_NAMES:
+            return True
+        if fname in ("partial", "functools.partial") and node.args \
+                and dotted_name(node.args[0]) in _JIT_NAMES:
+            return True
+    return False
+
+
+def jit_functions(tree: ast.AST) -> set:
+    """Function/lambda nodes whose bodies run under jax.jit *tracing*:
+
+    - ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated defs;
+    - lambdas or same-file named functions passed to ``jax.jit(...)``;
+    - plus the transitive closure of same-file functions *called by name*
+      from any of the above (tracing inlines the whole call tree).
+    """
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    jitted: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_expr(dec) for dec in node.decorator_list):
+                jitted.add(node)
+        elif isinstance(node, ast.Call) and node.args:
+            f = node.func
+            direct = dotted_name(f) in _JIT_NAMES
+            # partial(jax.jit, ...)(fn) — but NOT jax.jit(f)(x), where
+            # args[0] is data, not a function being compiled
+            curried = (isinstance(f, ast.Call)
+                       and dotted_name(f.func) in ("partial", "functools.partial")
+                       and f.args and dotted_name(f.args[0]) in _JIT_NAMES)
+            if not (direct or curried):
+                continue
+            target = node.args[0]
+            if isinstance(target, ast.Lambda):
+                jitted.add(target)
+            elif isinstance(target, ast.Name):
+                jitted.update(defs_by_name.get(target.id, ()))
+
+    # Transitive closure over same-file calls-by-name.
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(jitted):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    for callee in defs_by_name.get(node.func.id, ()):
+                        if callee not in jitted:
+                            jitted.add(callee)
+                            changed = True
+    return jitted
+
+
+def in_jit(node: ast.AST, jitted: set) -> bool:
+    return any(anc in jitted for anc in ancestors(node))
+
+
+# ---------------------------------------------------------------------------
+# Pass registry
+# ---------------------------------------------------------------------------
+
+class Pass:
+    """One analysis. Subclasses set ``name``/``description`` and implement
+    :meth:`run`; ``applies`` restricts a pass to part of the tree (e.g.
+    env-knob only polices the framework package, not user-facing tools)."""
+
+    name = ""
+    description = ""
+
+    def applies(self, relpath: str) -> bool:
+        return True
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+REGISTRY: Dict[str, Pass] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and add to :data:`REGISTRY`."""
+    inst = cls()
+    if not inst.name:
+        raise ValueError("pass %r has no name" % cls)
+    REGISTRY[inst.name] = inst
+    return cls
+
+
+def all_passes() -> Dict[str, Pass]:
+    from . import passes  # noqa: F401  - importing populates REGISTRY
+    return REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# Running
+# ---------------------------------------------------------------------------
+
+def collect_files(paths: Sequence[str], root: Path = REPO_ROOT) -> List[Path]:
+    """Expand path arguments into a sorted list of ``.py`` files."""
+    out: List[Path] = []
+    for p in paths:
+        path = Path(p)
+        if not path.is_absolute():
+            path = root / path
+        if path.is_dir():
+            # hidden-dir check is relative to the scanned dir: an absolute
+            # path with a dotted ancestor (~/.work/repo) must not empty the scope
+            out.extend(f for f in path.rglob("*.py")
+                       if not any(part.startswith(".")
+                                  for part in f.relative_to(path).parts))
+        elif path.suffix == ".py" and path.exists():
+            out.append(path)
+    return sorted(set(out))
+
+
+def relpath_of(path: Path, root: Path = REPO_ROOT) -> str:
+    try:
+        return path.resolve().relative_to(root).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def lint_source(relpath: str, source: str,
+                passes: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint one in-memory source blob; returns suppression-filtered findings."""
+    registry = all_passes()
+    names = passes if passes is not None else sorted(registry)
+    ctx = FileContext(relpath, source, filename=relpath)
+    findings: List[Finding] = []
+    for name in names:
+        p = registry[name]
+        if not p.applies(relpath):
+            continue
+        for f in p.run(ctx):
+            if not ctx.suppressed(f.rule, f.line):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_files(files: Sequence[Path], root: Path = REPO_ROOT,
+               passes: Optional[Sequence[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in files:
+        rel = relpath_of(path, root)
+        try:
+            source = path.read_text(encoding="utf-8")
+        except OSError:
+            continue
+        except UnicodeDecodeError as exc:
+            findings.append(Finding("parse-error", rel, 1, 0,
+                                    "file is not UTF-8: %s" % exc.reason))
+            continue
+        try:
+            findings.extend(lint_source(rel, source, passes=passes))
+        except SyntaxError as exc:
+            findings.append(Finding("parse-error", rel, exc.lineno or 1, 0,
+                                    "file does not parse: %s" % exc.msg))
+        except ValueError as exc:  # e.g. null bytes in source
+            findings.append(Finding("parse-error", rel, 1, 0,
+                                    "file does not parse: %s" % exc))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+def baseline_counts(findings: Sequence[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.baseline_key()] = counts.get(f.baseline_key(), 0) + 1
+    return counts
+
+
+def write_baseline(findings: Sequence[Finding], path: Path) -> None:
+    write_baseline_counts(baseline_counts(findings), path)
+
+
+def write_baseline_counts(counts: Dict[str, int], path: Path) -> None:
+    data = {"version": 1, "counts": dict(sorted(counts.items()))}
+    path.write_text(json.dumps(data, indent=2, sort_keys=True) + "\n",
+                    encoding="utf-8")
+
+
+def key_scope(key: str) -> tuple:
+    """``(path, rule)`` of a baseline key."""
+    parts = key.split("::", 2)
+    return parts[0], parts[1] if len(parts) > 1 else ""
+
+
+def load_baseline(path: Path) -> Dict[str, int]:
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return {str(k): int(v) for k, v in data.get("counts", {}).items()}
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: Dict[str, int]) -> List[Finding]:
+    """Findings NOT covered by the baseline. When a key appears more often
+    than its baselined count, the surplus (highest line numbers — the likely
+    newest occurrences) is reported."""
+    by_key: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_key.setdefault(f.baseline_key(), []).append(f)
+    new: List[Finding] = []
+    for key, group in by_key.items():
+        allowed = baseline.get(key, 0)
+        if len(group) > allowed:
+            group.sort(key=lambda f: (f.line, f.col))
+            new.extend(group[allowed:])
+    new.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return new

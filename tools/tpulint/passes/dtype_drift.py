@@ -1,0 +1,50 @@
+"""dtype-drift: bare float64 literals outside the dtype registry.
+
+TPUs have no native float64: with ``jax_enable_x64`` off (the default) a
+``jnp.float64`` request silently *downcasts* to float32, and with it on the
+compiler emulates doubles at a large throughput cost. Either way a stray
+``np.float64`` that worked on the CPU tier-1 suite misbehaves on the chip.
+All dtype choices are supposed to flow through the registry in
+``mxnet_tpu/base.py`` (``DTYPE_NP``), where the policy lives in one place.
+
+Flagged: attribute literals ``np.float64`` / ``numpy.float64`` /
+``jnp.float64`` / ``jax.numpy.float64`` anywhere except inside the
+``DTYPE_NP`` registry assignment itself. Intentional uses (host-side
+accumulators, wire-format tables) carry an inline suppression or a baseline
+entry with the justification next to them.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (FileContext, Finding, Pass, ancestors, dotted_name,
+                    register)
+
+_F64_NAMES = {"np.float64", "numpy.float64", "jnp.float64", "jax.numpy.float64"}
+_REGISTRY_TARGETS = {"DTYPE_NP"}
+
+
+def _in_registry_assign(node: ast.AST) -> bool:
+    for anc in ancestors(node):
+        if isinstance(anc, ast.Assign):
+            for target in anc.targets:
+                if isinstance(target, ast.Name) and target.id in _REGISTRY_TARGETS:
+                    return True
+    return False
+
+
+@register
+class DtypeDriftPass(Pass):
+    name = "dtype-drift"
+    description = "bare np/jnp.float64 literals outside the DTYPE_NP registry"
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "float64":
+                full = dotted_name(node)
+                if full in _F64_NAMES and not _in_registry_assign(node):
+                    yield ctx.finding(node, self.name,
+                                      "bare `%s` outside the DTYPE_NP registry — "
+                                      "float64 is emulated or silently downcast on "
+                                      "TPU" % full)

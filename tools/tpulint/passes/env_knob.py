@@ -1,0 +1,54 @@
+"""env-knob: raw ``os.environ`` reads inside the framework package.
+
+The reference routed every knob through ``dmlc::GetEnv`` so
+``docs/faq/env_var.md`` could document them all; this port keeps that
+discipline in ``mxnet_tpu.base.get_env``. A raw ``os.environ.get(...)``
+scattered in a module is an undocumented, unregistered knob — invisible to
+``docs/env_var.md``, untypechecked, and (under jit) a silent trace-time
+constant.
+
+Scope: only files under ``mxnet_tpu/`` are policed (user-facing scripts in
+``tools/`` legitimately read their own CLI environment), and ``base.py``
+itself is exempt — it is the one place the raw read belongs.
+
+Flagged (reads): ``os.environ.get`` / ``os.environ.setdefault`` /
+``os.getenv`` / ``os.environ[...]`` loads. Mutations (``pop``, ``del``,
+subscript stores) are not flagged — writing the environment for a
+subprocess is host-side plumbing, not an unregistered knob.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, Pass, dotted_name, parent, register
+
+_READ_METHODS = {"get", "setdefault"}
+
+
+@register
+class EnvKnobPass(Pass):
+    name = "env-knob"
+    description = "raw os.environ reads in mxnet_tpu/ outside base.get_env"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/") and relpath != "mxnet_tpu/base.py"
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and dotted_name(node.func) == "os.getenv":
+                yield ctx.finding(node, self.name,
+                                  "raw `os.getenv()` — route knob reads through "
+                                  "base.get_env so they are registered in one place")
+            elif isinstance(node, ast.Attribute) and dotted_name(node) == "os.environ":
+                p = parent(node)
+                if isinstance(p, ast.Attribute) and p.attr in _READ_METHODS:
+                    yield ctx.finding(node, self.name,
+                                      "raw `os.environ.%s()` — route knob reads "
+                                      "through base.get_env so they are registered "
+                                      "in one place" % p.attr)
+                elif isinstance(p, ast.Subscript) and isinstance(p.ctx, ast.Load):
+                    yield ctx.finding(node, self.name,
+                                      "raw `os.environ[...]` read — route knob reads "
+                                      "through base.get_env so they are registered "
+                                      "in one place")

@@ -1,0 +1,79 @@
+"""host-sync: implicit device->host synchronization on the hot path.
+
+The reference engine makes a sync explicit (``WaitForVar`` /
+``NDArray.wait_to_read``); under JAX the same sync hides inside innocuous
+host conversions. ``x.asnumpy()`` / ``x.item()`` / ``float(x)`` block the
+Python thread until the device stream drains — once per loop iteration that
+serializes dispatch and idles the TPU; inside a ``jit``-traced function it
+is worse: the tracer is concretized at *trace time*, either erroring or
+baking a stale constant into the compiled program.
+
+Flagged:
+
+- ``.asnumpy()`` / ``.item()`` / ``.tolist()`` / ``.wait_to_read()`` /
+  ``.block_until_ready()`` calls inside a loop or inside jit-traced code;
+- ``np.asarray(...)`` / ``np.array(...)`` inside jit-traced code (on host
+  data in a plain loop it is legitimate, so only the jit context is
+  flagged there);
+- ``float(...)`` / ``int(...)`` applied to a call result (e.g.
+  ``float(x.sum())``, ``float(np.sum(f(x)))``) inside a loop or jit-traced
+  code — scalar conversion of a device value is a full sync.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (FileContext, Finding, Pass, dotted_name, in_jit, in_loop,
+                    register)
+
+_SYNC_METHODS = {"asnumpy", "item", "tolist", "wait_to_read", "block_until_ready"}
+_NP_CONVERTERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+# float(len(x)) etc. never touches the device
+_SCALAR_SAFE_CALLEES = {"len", "str", "ord", "round", "hash", "id"}
+
+
+@register
+class HostSyncPass(Pass):
+    name = "host-sync"
+    description = ("device->host syncs (.asnumpy()/.item()/float()/np.asarray) "
+                   "inside loops or jit-traced code")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        jitted = ctx.jit_functions()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            jit_ctx = in_jit(node, jitted)
+            loop_ctx = in_loop(node)
+
+            if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_METHODS:
+                if jit_ctx:
+                    yield ctx.finding(node, self.name,
+                                      "`.%s()` inside jit-traced code concretizes the "
+                                      "tracer at trace time" % node.func.attr)
+                elif loop_ctx:
+                    yield ctx.finding(node, self.name,
+                                      "`.%s()` inside a loop forces a device->host "
+                                      "sync per iteration" % node.func.attr)
+                continue
+
+            fname = dotted_name(node.func)
+            if fname in _NP_CONVERTERS and jit_ctx:
+                yield ctx.finding(node, self.name,
+                                  "`%s()` inside jit-traced code materializes the "
+                                  "tracer on the host at trace time" % fname)
+                continue
+
+            if fname in ("float", "int") and len(node.args) == 1:
+                arg = node.args[0]
+                if isinstance(arg, ast.Call) \
+                        and dotted_name(arg.func) not in _SCALAR_SAFE_CALLEES:
+                    if jit_ctx:
+                        yield ctx.finding(node, self.name,
+                                          "`%s()` on a computed value inside jit-traced "
+                                          "code concretizes the tracer" % fname)
+                    elif loop_ctx:
+                        yield ctx.finding(node, self.name,
+                                          "`%s()` on a computed value inside a loop is a "
+                                          "device->host sync per iteration" % fname)

@@ -1,0 +1,108 @@
+"""native-guard: every ``_native.get_lib()`` call site must handle ``None``.
+
+The native C++ runtime (``src/*.cc`` -> ``libmxtpu.so``) is an optional
+accelerator for host-side work; the documented invariant in
+``mxnet_tpu/_native.py`` is that the whole framework degrades to pure
+Python when no toolchain is available — *every caller must handle
+``get_lib() is None``*. A call site that dereferences the result
+unconditionally turns "no g++ on this machine" into an AttributeError deep
+inside IO or engine code.
+
+A call site counts as guarded when, within the same function (or module)
+scope, the result is:
+
+- compared against ``None`` (``if lib is None: ...``, ternaries included);
+- truth-tested (``if lib:``, ``if not lib:``, ``while lib``, ``assert lib``,
+  or as a direct operand of ``and`` / ``or``);
+- read only through ``getattr(lib, name, default)`` with a default.
+
+Anything else — including a bare ``return get_lib()`` that forwards the
+``Optional`` to callers the analysis cannot see — is flagged; forwarding
+helpers whose callers all guard carry an inline suppression saying so.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import (FileContext, Finding, Pass, dotted_name, enclosing_scope,
+                    parent, register)
+
+_GET_LIB = {"get_lib", "_native.get_lib"}
+
+
+def _is_name(node: ast.AST, name: str) -> bool:
+    return isinstance(node, ast.Name) and node.id == name
+
+
+def _guards_name(scope: ast.AST, name: str) -> bool:
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Compare) and len(node.comparators) == 1:
+            left, right = node.left, node.comparators[0]
+            none_cmp = isinstance(node.ops[0], (ast.Is, ast.IsNot))
+            if none_cmp and ((_is_name(left, name) and _is_const_none(right)) or
+                             (_is_const_none(left) and _is_name(right, name))):
+                return True
+        elif isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            test = node.test
+            if _is_name(test, name):
+                return True
+            if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+                    and _is_name(test.operand, name):
+                return True
+        elif isinstance(node, ast.Assert) and _is_name(node.test, name):
+            return True
+        elif isinstance(node, ast.BoolOp) and any(_is_name(v, name)
+                                                  for v in node.values):
+            return True
+        elif isinstance(node, ast.Call) and dotted_name(node.func) == "getattr" \
+                and len(node.args) == 3 and _is_name(node.args[0], name):
+            return True
+    return False
+
+
+def _is_const_none(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def _assigned_name(call: ast.Call) -> Optional[str]:
+    p = parent(call)
+    if isinstance(p, ast.Assign) and len(p.targets) == 1 \
+            and isinstance(p.targets[0], ast.Name):
+        return p.targets[0].id
+    if isinstance(p, ast.AnnAssign) and isinstance(p.target, ast.Name):
+        return p.target.id
+    return None
+
+
+@register
+class NativeGuardPass(Pass):
+    name = "native-guard"
+    description = "_native.get_lib() call sites that never handle the None fallback"
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and dotted_name(node.func) in _GET_LIB):
+                continue
+            p = parent(node)
+            # `get_lib() is None` / `get_lib() is not None` guards inline.
+            if isinstance(p, ast.Compare) and len(p.ops) == 1 \
+                    and isinstance(p.ops[0], (ast.Is, ast.IsNot)):
+                continue
+            name = _assigned_name(node)
+            if name is not None:
+                if _guards_name(enclosing_scope(node), name):
+                    continue
+                yield ctx.finding(node, self.name,
+                                  "`%s = get_lib()` is never checked against the "
+                                  "None (pure-Python) fallback in this scope" % name)
+                continue
+            if isinstance(p, ast.Return):
+                yield ctx.finding(node, self.name,
+                                  "`return get_lib()` forwards an unguarded Optional "
+                                  "to callers")
+                continue
+            yield ctx.finding(node, self.name,
+                              "get_lib() result used directly without handling the "
+                              "None (pure-Python) fallback")

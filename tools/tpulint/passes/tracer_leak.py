@@ -1,0 +1,76 @@
+"""tracer-leak: side effects captured (or dropped) by ``jax.jit`` tracing.
+
+``jit`` runs the Python body ONCE per input signature; anything that is not
+a pure function of the traced arguments is frozen into the compiled program
+or silently skipped on cache hits. The reference engine had no tracing —
+every Python line executed every call — so ported code is full of these.
+
+Flagged inside jit-traced functions (decorated, wrapped, or transitively
+called by name in the same file — see ``core.jit_functions``):
+
+- ``print(...)`` — executes at trace time only; use ``jax.debug.print``;
+- clock reads (``time.time()`` et al.) — trace-time constants;
+- ``os.environ`` / ``os.getenv`` access — trace-time constant config;
+- ``global`` / ``nonlocal`` declarations — mutation of outer state runs
+  once per *compile*, not once per call;
+- ``np.random.*`` draws — one sample frozen for every call.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (FileContext, Finding, Pass, dotted_name, in_jit,
+                    register)
+
+_CLOCK_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic", "time.process_time",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+
+
+@register
+class TracerLeakPass(Pass):
+    name = "tracer-leak"
+    description = ("side effects (print, clocks, os.environ, global/nonlocal, "
+                   "np.random) inside jit-traced functions")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        jitted = ctx.jit_functions()
+        if not jitted:
+            return
+        for node in ast.walk(ctx.tree):
+            if not in_jit(node, jitted):
+                continue
+            if isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                if fname == "print":
+                    yield ctx.finding(node, self.name,
+                                      "`print()` under jit runs at trace time only; "
+                                      "use jax.debug.print")
+                elif fname in _CLOCK_CALLS:
+                    yield ctx.finding(node, self.name,
+                                      "`%s()` under jit is frozen to a trace-time "
+                                      "constant" % fname)
+                elif fname == "os.getenv":
+                    yield ctx.finding(node, self.name,
+                                      "`os.getenv()` under jit is frozen to a "
+                                      "trace-time constant")
+                elif fname is not None and fname.startswith(("np.random.",
+                                                             "numpy.random.")):
+                    yield ctx.finding(node, self.name,
+                                      "`%s()` under jit draws once at trace time; "
+                                      "thread a jax PRNG key instead" % fname)
+            elif isinstance(node, ast.Attribute) and dotted_name(node) == "os.environ":
+                yield ctx.finding(node, self.name,
+                                  "`os.environ` under jit is frozen to a trace-time "
+                                  "constant")
+            elif isinstance(node, ast.Global):
+                yield ctx.finding(node, self.name,
+                                  "`global %s` under jit mutates module state at "
+                                  "trace time, not per call" % ", ".join(node.names))
+            elif isinstance(node, ast.Nonlocal):
+                yield ctx.finding(node, self.name,
+                                  "`nonlocal %s` under jit mutates closure state at "
+                                  "trace time, not per call" % ", ".join(node.names))

@@ -1,0 +1,32 @@
+"""Output formatting for tpulint: human text and machine JSON."""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence
+
+from .core import Finding
+
+
+def render_text(new: Sequence[Finding], total: int, baselined: int,
+                stale_keys: Sequence[str] = ()) -> str:
+    lines: List[str] = [str(f) for f in new]
+    lines.append("")
+    lines.append("tpulint: %d finding(s): %d baselined, %d new"
+                 % (total, baselined, len(new)))
+    if stale_keys:
+        lines.append("tpulint: %d stale baseline entr%s (fixed since the "
+                     "baseline was written — regenerate with --write-baseline):"
+                     % (len(stale_keys), "y" if len(stale_keys) == 1 else "ies"))
+        lines.extend("  %s" % k for k in sorted(stale_keys))
+    return "\n".join(lines)
+
+
+def render_json(new: Sequence[Finding], total: int, baselined: int,
+                stale_keys: Sequence[str] = ()) -> str:
+    payload: Dict = {
+        "total": total,
+        "baselined": baselined,
+        "new": [f.as_dict() for f in new],
+        "stale_baseline_keys": sorted(stale_keys),
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)
