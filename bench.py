@@ -19,6 +19,7 @@ import threading
 import time
 
 QUICK = os.environ.get("BENCH_QUICK", "") not in ("", "0")
+SERVING = os.environ.get("BENCH_SERVING", "") not in ("", "0")
 
 TRAIN_BASELINE = 298.51   # V100 ResNet-50 train bs=32 fp32, perf.md:214
 INFER_BASELINE = 1076.81  # V100 ResNet-50 infer bs=32 fp32, perf.md:156
@@ -222,7 +223,143 @@ def _emit(error=None):
     sys.stdout.flush()
 
 
+def _serving_bench():
+    """BENCH_SERVING=1 mode: dynamic-batching server vs sequential predict.
+
+    Offered-load protocol: several client threads submit requests as fast
+    as the server accepts them (the shape of traffic a frontend fanning
+    into one chip produces); the baseline is the same engine driven one
+    request at a time — the repo's pre-serving inference story. Prints ONE
+    JSON line: offered-load throughput, p50/p99 latency, batch-fill ratio
+    and the steady-state recompile count (must be 0: every bucket is
+    warmed before the timed window)."""
+    # same stall story as main(): a wedged accelerator tunnel must yield a
+    # parseable error line, not an eternally hung process (BENCH_r03)
+    deadline = float(os.environ.get("MXNET_BENCH_DEADLINE_S",
+                                    "240" if QUICK else "1500"))
+    printed = threading.Event()
+    phase = ["backend-init"]
+
+    def watchdog():
+        time.sleep(deadline)
+        if not printed.is_set():
+            print(json.dumps({
+                "metric": "serving offered-load throughput",
+                "value": None, "unit": "req/s", "vs_baseline": None,
+                "error": "deadline %.0fs hit during phase %r (accelerator "
+                         "tunnel stall suspected)" % (deadline, phase[0])}))
+            sys.stdout.flush()
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    devices = _acquire_backend()
+    import numpy as np
+
+    from mxnet_tpu import gluon, nd, serving
+
+    if QUICK:
+        sample, hidden, n_seq, n_req, clients = (64,), 256, 100, 400, 4
+        net = gluon.nn.Sequential()
+        net.add(gluon.nn.Dense(hidden, activation="relu"),
+                gluon.nn.Dense(hidden, activation="relu"),
+                gluon.nn.Dense(10))
+        model = "mlp%d" % hidden
+    else:
+        from mxnet_tpu.gluon.model_zoo import vision
+
+        sample, n_seq, n_req, clients = (3, 64, 64), 150, 1024, 8
+        net = vision.resnet18_v1(classes=100)
+        model = "resnet18_v1@64"
+    net.initialize()
+    net(nd.array(np.zeros((1,) + sample, np.float32)))  # materialize params
+
+    engine = serving.BlockEngine(net)
+    buckets = (1, 4, 16)
+    rng = np.random.RandomState(0)
+    reqs = rng.rand(64, *sample).astype(np.float32)
+
+    # sequential single-request baseline: the pre-serving status quo
+    phase[0] = "sequential-baseline"
+    x1 = reqs[:1]
+    engine.run(x1)  # compile bucket 1
+    t0 = time.perf_counter()
+    for i in range(n_seq):
+        engine.run(reqs[i % 64:i % 64 + 1])
+    seq_rate = n_seq / (time.perf_counter() - t0)
+
+    phase[0] = "warmup"
+    srv = serving.Server(engine, sample, buckets=buckets, max_delay_ms=2.0,
+                         queue_depth=4096, timeout_ms=0, name="bench")
+    srv.warmup()
+    compiles_warm = engine.compile_count
+    phase[0] = "offered-load"
+
+    per_client = n_req // clients
+    errors = []
+
+    def client(cid):
+        futures = []
+        try:
+            for i in range(per_client):
+                futures.append(srv.submit(reqs[(cid + i * clients) % 64]))
+            for f in futures:
+                f.result(timeout=120)
+        except Exception as e:  # noqa: BLE001 - surfaced in the JSON line
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    stats = srv.stats()
+    srv.close()
+    # numerator is what was actually ANSWERED: an errored client's
+    # never-served requests must not inflate the reported rate
+    batched_rate = stats["completed"] / elapsed
+    recompiles = engine.compile_count - compiles_warm
+
+    out = {
+        "metric": "serving offered-load throughput (%s, buckets %s, "
+                  "%d clients)" % (model, "/".join(map(str, buckets)),
+                                   clients),
+        "value": round(batched_rate, 2),
+        "unit": "req/s",
+        "vs_baseline": round(batched_rate / seq_rate, 4) if seq_rate else None,
+        "extra": {
+            "sequential_req_s": round(seq_rate, 2),
+            "speedup_vs_sequential": round(batched_rate / seq_rate, 4)
+            if seq_rate else None,
+            "p50_ms": round(stats["p50_ms"], 3),
+            "p99_ms": round(stats["p99_ms"], 3),
+            "batch_fill": round(stats["batch_fill"], 4),
+            "bucket_counts": stats["bucket_counts"],
+            "batches": stats["batches"],
+            "completed": stats["completed"],
+            "shed": stats["shed"],
+            "timeouts": stats["timeouts"],
+            "steady_state_recompiles": recompiles,
+            "warm_compile_count": compiles_warm,
+            "requests": clients * per_client,
+            "device": str(devices[0]),
+            "baseline": "same engine, one request per call (the "
+                        "pre-serving _predict_embed path)",
+        },
+    }
+    if errors:
+        out["error"] = "; ".join(errors[:3])
+    printed.set()
+    print(json.dumps(out))
+    sys.stdout.flush()
+    return 1 if errors or recompiles else 0
+
+
 def main():
+    if SERVING:
+        return _serving_bench()
     # Deadline watchdog: the accelerator tunnel can wedge mid-phase with the
     # process stuck in a device wait (BENCH_r03 failure mode). At the
     # deadline, report whatever phases completed — a partial result with an

@@ -56,6 +56,7 @@ for _m in (
     "image",
     "parallel",
     "sequence_parallel",
+    "serving",
     "contrib",
     "test_utils",
     "util",

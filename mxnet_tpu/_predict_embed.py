@@ -9,7 +9,8 @@ as raw float32 buffers.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+import threading
+from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
@@ -58,6 +59,9 @@ class _Predictor:
         self.input_shapes = input_shapes
         self.inputs: Dict[str, np.ndarray] = {}
         self.outputs: List[np.ndarray] = []
+        self._server = None  # lazy mxnet_tpu.serving.Server (see server())
+        self._server_lock = threading.Lock()
+        self._freed = False
 
     def set_input(self, key: str, buf: bytes):
         shape = self.input_shapes[key]
@@ -65,13 +69,80 @@ class _Predictor:
         self.inputs[key] = arr
 
     def forward(self):
+        from mxnet_tpu.base import fetch_host
+
         feed = {k: self.mx.nd.array(v) for k, v in self.inputs.items()}
         outs = self.executor.forward(is_train=False, **feed)
-        self.outputs = [o.asnumpy().astype(np.float32) for o in outs]
+        # one vectorized device->host copy for every output, instead of a
+        # per-output .asnumpy() sync
+        self.outputs = fetch_host(outs, dtype=np.float32)
 
     def reshape(self, new_shapes: Dict[str, Tuple[int, ...]]):
         self.input_shapes.update(new_shapes)
         self.executor = self.executor.reshape(**new_shapes)
+        with self._server_lock:
+            server, self._server = self._server, None
+        if server is not None:
+            # the server's sample shape and per-bucket executors are frozen
+            # at build time; a rebind invalidates both (bounded join: a
+            # wedged device must not hang the frontend)
+            server.close(timeout=60.0)
+
+    # -- dynamic-batching serve (mxnet_tpu.serving) --------------------
+    def server(self, **kwargs):
+        """Lazily build the dynamic-batching server over this predictor.
+
+        Single-input predictors only (the predict ABI's common case). The
+        per-request sample shape is the bound input shape minus its batch
+        axis; each bucket gets one reshaped executor, compiled on first
+        use (warm after ``Server.warmup()``)."""
+        with self._server_lock:
+            if self._freed:
+                raise ValueError("predictor handle already freed")
+            if self._server is None:
+                from mxnet_tpu import serving
+
+                if len(self.input_names) != 1:
+                    raise ValueError("batched predict serves single-input "
+                                     "models; got inputs %r"
+                                     % self.input_names)
+                key = self.input_names[0]
+                sample_shape = tuple(self.input_shapes[key][1:])
+                # the ABI caller blocks synchronously on every result, and
+                # the first call per bucket pays an XLA compile that can
+                # exceed any wall-clock deadline — no per-request timeout
+                # unless asked
+                kwargs.setdefault("timeout_ms", 0)
+                self._server = serving.Server(
+                    _ExecutorEngine(self, key), sample_shape,
+                    name="predict", **kwargs)
+            return self._server
+
+
+class _ExecutorEngine:
+    """``serving.Engine`` over a bound executor: one reshaped executor per
+    batch bucket, created (and its XLA module compiled) on first use."""
+
+    def __init__(self, predictor: "_Predictor", key: str):
+        self._pred = predictor
+        self._key = key
+        self._executors: Dict[int, Any] = {}
+
+    def run(self, batch: np.ndarray):
+        from mxnet_tpu.base import fetch_host
+
+        ex = self._executors.get(batch.shape[0])
+        if ex is None:
+            ex = self._pred.executor.reshape(**{self._key: batch.shape})
+            self._executors[batch.shape[0]] = ex
+        outs = ex.forward(is_train=False,
+                          **{self._key: self._pred.mx.nd.array(batch)})
+        host = fetch_host(outs, dtype=np.float32)
+        return tuple(host) if len(host) > 1 else host[0]
+
+    @property
+    def compile_count(self) -> int:
+        return len(self._executors)
 
 
 def create(symbol_json: str, param_bytes: bytes, dev_type: int,
@@ -107,5 +178,60 @@ def get_output(handle: int, index: int) -> bytes:
     return _HANDLES[handle].outputs[index].tobytes()
 
 
+def forward_batch(handle: int, bufs: List[bytes],
+                  output_index: int = 0) -> List[bytes]:
+    """Batched predict: N raw float32 sample buffers in, N raw float32
+    output buffers out — one padded fixed-bucket XLA execution per
+    micro-batch (via :mod:`mxnet_tpu.serving`) instead of N sequential
+    ``set_input``/``forward`` round-trips. Each buffer holds one sample
+    shaped like the bound input minus its batch axis.
+
+    Load shedding is an *external-overload* policy; this caller owns its
+    whole batch, so a full queue applies backpressure instead: wait for
+    the oldest in-flight result, then resubmit. ``N`` may exceed the
+    server queue depth.
+    """
+    import collections
+    import time
+
+    from mxnet_tpu import serving
+
+    p = _HANDLES[handle]
+    server = p.server()
+    shape = tuple(p.input_shapes[p.input_names[0]][1:])
+
+    def to_bytes(res):
+        if isinstance(res, tuple):
+            res = res[output_index]
+        return np.ascontiguousarray(res).tobytes()
+
+    outs: List[bytes] = [b""] * len(bufs)
+    pending = collections.deque()
+    for i, buf in enumerate(bufs):
+        arr = np.frombuffer(buf, dtype=np.float32).reshape(shape)
+        while True:
+            try:
+                pending.append((i, server.submit(arr)))
+                break
+            except serving.QueueFullError:
+                if pending:  # drain our oldest in-flight request
+                    j, fut = pending.popleft()
+                    outs[j] = to_bytes(fut.result())
+                else:  # queue filled by other threads: yield and retry
+                    time.sleep(0.001)
+    for j, fut in pending:
+        outs[j] = to_bytes(fut.result())
+    return outs
+
+
 def free(handle: int) -> None:
-    _HANDLES.pop(handle, None)
+    p = _HANDLES.pop(handle, None)
+    if p is None:
+        return
+    with p._server_lock:  # a racing server() either finished or refuses now
+        p._freed = True
+        server, p._server = p._server, None
+    if server is not None:
+        # bounded: free() is driven from the C ABI and must not hang the
+        # frontend if a wedged device has the batcher stuck mid-batch
+        server.close(timeout=60.0)

@@ -46,7 +46,8 @@ def _as_jax_fn(net):
 
 
 def export_model(net, input_shape: Sequence[int], out_dir: str,
-                 dtype="float32", save_tf: bool = True):
+                 dtype="float32", save_tf: bool = True,
+                 poly_batch: bool = False):
     """Export an initialized Gluon block's forward for deployment.
 
     Parameters
@@ -55,6 +56,11 @@ def export_model(net, input_shape: Sequence[int], out_dir: str,
     input_shape : example input shape, e.g. ``(1, 3, 224, 224)``
     out_dir : artifact directory (created)
     save_tf : also write the TF SavedModel for the no-Python C runner
+    poly_batch : export with a *symbolic* leading (batch) dimension so one
+        ``model.stablehlo`` serves every batch size — the format
+        ``mxnet_tpu.serving.StableHLOEngine`` expects for bucketed
+        dynamic batching. Mutually exclusive with ``save_tf`` (the TF
+        SavedModel wrapper is traced at the concrete example shape).
 
     Returns the manifest dict.
     """
@@ -62,22 +68,36 @@ def export_model(net, input_shape: Sequence[int], out_dir: str,
     import jax.export as jexport
     import jax.numpy as jnp
 
+    if poly_batch and save_tf:
+        raise ValueError("poly_batch=True exports a symbolic batch dim; "
+                         "pass save_tf=False (the TF SavedModel needs a "
+                         "concrete shape)")
     os.makedirs(out_dir, exist_ok=True)
     fn = _as_jax_fn(net)
-    spec = jax.ShapeDtypeStruct(tuple(input_shape), jnp.dtype(dtype))
+    if poly_batch:
+        shape = jexport.symbolic_shape(
+            ", ".join(["b"] + [str(int(d)) for d in input_shape[1:]]))
+        spec = jax.ShapeDtypeStruct(shape, jnp.dtype(dtype))
+    else:
+        spec = jax.ShapeDtypeStruct(tuple(input_shape), jnp.dtype(dtype))
 
     exported = jexport.export(jax.jit(fn))(spec)
     with open(os.path.join(out_dir, "model.stablehlo"), "wb") as f:
         f.write(exported.serialize())
 
+    def _json_shape(shape):
+        # symbolic batch dims serialize as their expression string ("b")
+        return [d if isinstance(d, int) else str(d) for d in shape]
+
     manifest = {
         "format": "mxnet_tpu-aot-v1",
         "input_shape": list(input_shape),
         "input_dtype": str(dtype),
-        "outputs": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+        "poly_batch": bool(poly_batch),
+        "outputs": [{"shape": _json_shape(a.shape), "dtype": str(a.dtype)}
                     for a in exported.out_avals],
         # single-output convenience aliases
-        "output_shape": list(exported.out_avals[0].shape),
+        "output_shape": _json_shape(exported.out_avals[0].shape),
         "output_dtype": str(exported.out_avals[0].dtype),
     }
 

@@ -17,6 +17,7 @@ import numpy as np
 __all__ = [
     "MXNetError",
     "get_env",
+    "fetch_host",
     "string_types",
     "numeric_types",
     "integer_types",
@@ -64,6 +65,22 @@ def get_env(name: str, default: Any = None, typ: Callable = str, *,
     if cache:
         _ENV_CACHE[name] = val
     return val
+
+
+def fetch_host(arrays, dtype=None) -> list:
+    """ONE batched device->host transfer for a sequence of arrays
+    (``jax.device_get`` over the whole list) — the replacement for the
+    per-element ``.asnumpy()``-in-a-loop sync the host-sync tpulint rule
+    flags. NDArray-likes are unwrapped via ``._data``; plain numpy passes
+    through. Returns a list of numpy arrays (cast to ``dtype`` if given).
+    Shared by metric accumulation, the predict ABI and serving engines.
+    """
+    import jax
+
+    host = jax.device_get([getattr(a, "_data", a) for a in arrays])
+    if dtype is None:
+        return [np.asarray(h) for h in host]
+    return [np.asarray(h, dtype=dtype) for h in host]
 
 
 # ---------------------------------------------------------------------------

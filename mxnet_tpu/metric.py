@@ -14,7 +14,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as _np
 
-from .base import MXNetError, numeric_types, string_types
+from .base import MXNetError, fetch_host, numeric_types, string_types
 
 __all__ = [
     "EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy", "F1",
@@ -63,6 +63,14 @@ def _asnp(x) -> _np.ndarray:
     if hasattr(x, "asnumpy"):
         return x.asnumpy()
     return _np.asarray(x)
+
+
+def _asnp_many(arrays: Sequence[Any]) -> List[_np.ndarray]:
+    """One batched device->host transfer for a list of label/pred arrays
+    (``base.fetch_host``) instead of a per-element sync — the serving
+    latency path updates metrics per micro-batch, so per-element syncs
+    would serialize it."""
+    return fetch_host(arrays)
 
 
 def check_label_shapes(labels, preds, shape=False):
@@ -381,20 +389,21 @@ class Perplexity(EvalMetric):
     def update(self, labels, preds):
         labels, preds = _to_list(labels), _to_list(preds)
         assert len(labels) == len(preds)
+        labels = _asnp_many(labels)
+        preds = _asnp_many(preds)
         loss = 0.0
         num = 0
         for label, pred in zip(labels, preds):
-            label = _asnp(label)
-            pred = _asnp(pred)
             assert label.size == pred.size / pred.shape[-1], \
                 "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
             label = label.reshape((label.size,)).astype("int32")
             probs = pred.reshape(-1, pred.shape[-1])[_np.arange(label.size), label]
             if self.ignore_label is not None:
                 ignore = (label == self.ignore_label).astype(probs.dtype)
-                num -= int(_np.sum(ignore))
+                num -= _np.count_nonzero(ignore)  # exact host int
                 probs = probs * (1 - ignore) + ignore
-            loss -= float(_np.sum(_np.log(_np.maximum(1e-10, probs))))
+            pair_loss = _np.sum(_np.log(_np.maximum(1e-10, probs)))
+            loss -= float(pair_loss)  # accumulate in python float64
             num += label.size
         self.sum_metric += loss
         self.num_inst += num
@@ -415,14 +424,15 @@ class MAE(EvalMetric):
     def update(self, labels, preds):
         labels, preds = _to_list(labels), _to_list(preds)
         check_label_shapes(labels, preds)
+        labels = _asnp_many(labels)
+        preds = _asnp_many(preds)
         for label, pred in zip(labels, preds):
-            label = _asnp(label)
-            pred = _asnp(pred)
             if len(label.shape) == 1:
                 label = label.reshape(label.shape[0], 1)
             if len(pred.shape) == 1:
                 pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += float(_np.abs(label - pred).mean())
+            err = _np.abs(label - pred).mean()
+            self.sum_metric += float(err)  # python-float64 accumulation
             self.num_inst += 1
 
 
@@ -436,14 +446,15 @@ class MSE(EvalMetric):
     def update(self, labels, preds):
         labels, preds = _to_list(labels), _to_list(preds)
         check_label_shapes(labels, preds)
+        labels = _asnp_many(labels)
+        preds = _asnp_many(preds)
         for label, pred in zip(labels, preds):
-            label = _asnp(label)
-            pred = _asnp(pred)
             if len(label.shape) == 1:
                 label = label.reshape(label.shape[0], 1)
             if len(pred.shape) == 1:
                 pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += float(((label - pred) ** 2.0).mean())
+            err = ((label - pred) ** 2.0).mean()
+            self.sum_metric += float(err)  # python-float64 accumulation
             self.num_inst += 1
 
 
@@ -457,14 +468,15 @@ class RMSE(EvalMetric):
     def update(self, labels, preds):
         labels, preds = _to_list(labels), _to_list(preds)
         check_label_shapes(labels, preds)
+        labels = _asnp_many(labels)
+        preds = _asnp_many(preds)
         for label, pred in zip(labels, preds):
-            label = _asnp(label)
-            pred = _asnp(pred)
             if len(label.shape) == 1:
                 label = label.reshape(label.shape[0], 1)
             if len(pred.shape) == 1:
                 pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += float(_np.sqrt(((label - pred) ** 2.0).mean()))
+            err = _np.sqrt(((label - pred) ** 2.0).mean())
+            self.sum_metric += float(err)  # python-float64 accumulation
             self.num_inst += 1
 
 
@@ -539,11 +551,11 @@ class Loss(EvalMetric):
         super().__init__(name, output_names=output_names, label_names=label_names)
 
     def update(self, _, preds):
-        preds = _to_list(preds)
+        preds = _asnp_many(_to_list(preds))
         for pred in preds:
-            loss = float(_np.sum(_asnp(pred)))
-            self.sum_metric += loss
-            self.num_inst += _asnp(pred).size
+            loss = _np.sum(pred)
+            self.sum_metric += float(loss)  # python-float64 accumulation
+            self.num_inst += pred.size
 
 
 @register

@@ -280,17 +280,22 @@ class Frame(_Span):
 
 
 class Counter:
-    """Numeric counter emitting 'C' events (reference profiler.py Counter)."""
+    """Numeric counter emitting 'C' events (reference profiler.py Counter).
+
+    Value updates are guarded by a per-counter lock: serving and data
+    pipelines increment counters from many threads, and an unguarded
+    read-modify-write in ``increment`` loses updates under contention.
+    """
 
     def __init__(self, domain, name, value=None):
         self.domain = domain
         self.name = name
         self._value = 0
+        self._vlock = threading.Lock()
         if value is not None:
             self.set_value(value)
 
-    def set_value(self, value):
-        self._value = value
+    def _emit(self, value):
         if ENABLED:
             with _lock:
                 _events.append({
@@ -299,11 +304,21 @@ class Counter:
                     "pid": os.getpid(),
                     "args": {self.name: value}})
 
+    def set_value(self, value):
+        with self._vlock:
+            self._value = value
+            # emit under the value lock so concurrent updates cannot land
+            # in the event buffer out of order (the counter lane would end
+            # on a stale value); _vlock -> _lock nests only here
+            self._emit(value)
+
     def increment(self, delta=1):
-        self.set_value(self._value + delta)
+        with self._vlock:
+            self._value += delta
+            self._emit(self._value)
 
     def decrement(self, delta=1):
-        self.set_value(self._value - delta)
+        self.increment(-delta)
 
 
 class Marker:

@@ -1,0 +1,86 @@
+"""mxnet_tpu.serving — dynamic-batching in-process inference service.
+
+The layer between "a jitted forward" and "traffic" (ROADMAP north star:
+serve heavy traffic from millions of users). TPU serving economics invert
+the eager story: throughput comes from coalescing many small concurrent
+requests into a few fixed-shape batched XLA executions, so every serve
+hits a warm jit cache entry and the steady state never recompiles.
+
+Pieces
+------
+* :mod:`~mxnet_tpu.serving.buckets`  — the fixed batch-size ladder
+  (default ``1/4/16/32``) and zero-padding up to the next bucket;
+* :mod:`~mxnet_tpu.serving.engine`   — the ``Engine`` interface hiding
+  *what* executes a batch: a live Gluon block (:class:`BlockEngine`) or a
+  loaded ``aot`` StableHLO artifact (:class:`StableHLOEngine`);
+* :mod:`~mxnet_tpu.serving.batcher`  — :class:`Server`: bounded submit
+  queue, deadline-driven micro-batcher, load shedding, per-request
+  timeout, error isolation, graceful drain;
+* :mod:`~mxnet_tpu.serving.stats`    — counters + latency reservoir
+  behind ``Server.stats()``, bridged to ``profiler`` Counters/Markers.
+
+Typical use::
+
+    from mxnet_tpu import serving
+    srv = serving.serve_block(net, sample_shape=(3, 224, 224))
+    srv.warmup()                      # compile every bucket up front
+    fut = srv.submit(image)           # thread-safe, from any thread
+    probs = fut.result(timeout=1.0)
+    print(srv.stats())                # p50/p99, batch fill, shed, ...
+    srv.close()                       # graceful drain
+
+Every ``MXNET_SERVING_*`` knob flows through ``base.get_env``
+(``cache=False`` — servers are constructed long after import); the
+registry lives in ``docs/env_var.md`` and ``docs/serving.md``.
+"""
+from __future__ import annotations
+
+from .batcher import (QueueFullError, RequestTimeoutError, Server,
+                      ServerClosedError, ServingError)
+from .buckets import bucket_ladder, pad_to_bucket, select_bucket
+from .engine import BlockEngine, Engine, StableHLOEngine
+from .stats import ServingStats
+
+__all__ = [
+    "Engine", "BlockEngine", "StableHLOEngine",
+    "Server", "ServingError", "QueueFullError", "RequestTimeoutError",
+    "ServerClosedError",
+    "ServingStats",
+    "bucket_ladder", "select_bucket", "pad_to_bucket",
+    "serve_block", "serve_stablehlo",
+]
+
+
+def serve_block(block, sample_shape, dtype="float32", **kwargs) -> Server:
+    """Serve a live (initialized) Gluon block.
+
+    ``sample_shape`` is the per-request shape *without* the batch axis —
+    the server stacks requests along a new leading axis before running
+    the block, so a block exported for ``(batch, *sample_shape)`` inputs
+    serves unchanged.
+    """
+    return Server(BlockEngine(block, dtype=dtype), sample_shape,
+                  dtype=dtype, **kwargs)
+
+
+def serve_stablehlo(out_dir: str, **kwargs) -> Server:
+    """Serve a loaded ``aot.export_model`` artifact.
+
+    Reads ``manifest.json`` for the sample shape/dtype. Artifacts exported
+    with ``poly_batch=True`` serve every bucket from one serialization;
+    fixed-shape artifacts serve only the bucket equal to their exported
+    batch size (pass ``buckets=[that_size]``).
+    """
+    import json
+    import os
+
+    with open(os.path.join(out_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    sample_shape = tuple(manifest["input_shape"][1:])
+    dtype = manifest.get("input_dtype", "float32")
+    if not manifest.get("poly_batch") and kwargs.get("buckets") is None:
+        # a fixed-shape artifact runs exactly one batch size: serve it as
+        # the single bucket instead of failing every other rung
+        kwargs["buckets"] = [int(manifest["input_shape"][0])]
+    return Server(StableHLOEngine(out_dir), sample_shape, dtype=dtype,
+                  **kwargs)

@@ -1,0 +1,288 @@
+"""Server: bounded queue + deadline-driven micro-batcher + robustness policy.
+
+The coalescing loop TPU serving lives on: concurrent ``submit()`` calls
+land requests in a bounded queue; a single batcher thread anchors a
+micro-batch window (``MXNET_SERVING_MAX_DELAY_MS``) at the oldest queued
+request, collects until the top bucket fills or the window closes, pads to
+the smallest bucket that fits (:mod:`~mxnet_tpu.serving.buckets`) and hands
+one fixed-shape batch to the :class:`~mxnet_tpu.serving.engine.Engine`.
+Every request resolves through its own ``concurrent.futures.Future``.
+
+Robustness policy, in the order a request meets it:
+
+* **validation** — shape/dtype are checked in ``submit`` on the caller's
+  thread; malformed input never reaches the batch;
+* **load shedding** — a full queue (``MXNET_SERVING_QUEUE_DEPTH``) rejects
+  at ``submit`` with :class:`QueueFullError`: under overload the server
+  degrades by answering fewer requests fast, not all requests late;
+* **per-request timeout** — requests whose queue wait exceeds their
+  deadline (``MXNET_SERVING_TIMEOUT_MS``) fail with
+  :class:`RequestTimeoutError` at batch-assembly time instead of wasting
+  a bucket slot on an answer nobody is waiting for;
+* **error isolation** — if the engine raises on a batch, the batcher
+  re-runs each member alone: only the poisoned request(s) receive the
+  exception, innocent bystanders still get answers;
+* **graceful drain** — ``close()`` stops intake, serves everything queued,
+  then joins the batcher thread; ``close(drain=False)`` fails queued
+  requests with :class:`ServerClosedError` immediately.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+from typing import Deque, List, Optional, Sequence
+
+import numpy as np
+
+from ..base import MXNetError, get_env, np_dtype
+from .buckets import bucket_ladder, pad_to_bucket, select_bucket
+from .engine import Engine
+from .stats import ServingStats
+
+__all__ = ["Server", "ServingError", "QueueFullError", "RequestTimeoutError",
+           "ServerClosedError"]
+
+_DEFAULT_MAX_DELAY_MS = 2.0
+_DEFAULT_QUEUE_DEPTH = 256
+_DEFAULT_TIMEOUT_MS = 1000.0
+
+
+class ServingError(MXNetError):
+    """Base class of serving-policy failures."""
+
+
+class QueueFullError(ServingError):
+    """Load shed: the bounded submit queue is at capacity."""
+
+
+class RequestTimeoutError(ServingError):
+    """The request's deadline expired while it waited in the queue."""
+
+
+class ServerClosedError(ServingError):
+    """Submitted to (or still queued in) a closed server."""
+
+
+class _Request:
+    __slots__ = ("data", "future", "t_submit", "deadline")
+
+    def __init__(self, data, deadline):
+        self.data = data
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter()
+        self.deadline = deadline
+
+
+class Server:
+    """Thread-safe dynamic-batching inference service over one Engine.
+
+    Parameters mirror the ``MXNET_SERVING_*`` knobs and win over them when
+    given explicitly; ``sample_shape`` is the per-request shape without the
+    batch axis. Results delivered through futures are views into the
+    batched output array (zero-copy); copy before mutating.
+    """
+
+    def __init__(self, engine: Engine, sample_shape: Sequence[int],
+                 dtype="float32", buckets: Optional[Sequence[int]] = None,
+                 max_delay_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 timeout_ms: Optional[float] = None, name: str = "serving"):
+        self._engine = engine
+        self._sample_shape = tuple(int(d) for d in sample_shape)
+        self._dtype = np.dtype(np_dtype(dtype))
+        self._ladder = bucket_ladder(buckets)
+        if max_delay_ms is None:
+            max_delay_ms = get_env("MXNET_SERVING_MAX_DELAY_MS",
+                                   _DEFAULT_MAX_DELAY_MS, float, cache=False)
+        if queue_depth is None:
+            queue_depth = get_env("MXNET_SERVING_QUEUE_DEPTH",
+                                  _DEFAULT_QUEUE_DEPTH, int, cache=False)
+        if timeout_ms is None:
+            timeout_ms = get_env("MXNET_SERVING_TIMEOUT_MS",
+                                 _DEFAULT_TIMEOUT_MS, float, cache=False)
+        self._max_delay_s = max(0.0, float(max_delay_ms)) / 1e3
+        self._queue_depth = max(1, int(queue_depth))
+        self._timeout_s = float(timeout_ms) / 1e3
+        self._stats = ServingStats(name)
+        self._queue: Deque[_Request] = collections.deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name="mxnet-serving-" + name)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # client side
+    # ------------------------------------------------------------------
+    def submit(self, x, timeout_ms: Optional[float] = None) -> Future:
+        """Enqueue one request; returns its Future. Thread-safe.
+
+        ``timeout_ms`` overrides the server default for this request;
+        ``<= 0`` disables the deadline. Raises :class:`ServerClosedError` /
+        :class:`QueueFullError` synchronously — shed work costs the caller
+        one host array copy, never a device cycle.
+        """
+        arr = np.asarray(x, dtype=self._dtype)
+        if arr.shape != self._sample_shape:
+            raise MXNetError(
+                "serving request shape %s != sample_shape %s"
+                % (arr.shape, self._sample_shape))
+        timeout_s = (self._timeout_s if timeout_ms is None
+                     else float(timeout_ms) / 1e3)
+        deadline = (None if timeout_s <= 0
+                    else time.perf_counter() + timeout_s)
+        req = _Request(arr, deadline)
+        shed = False
+        depth = 0
+        with self._cv:
+            if self._closed:
+                raise ServerClosedError("submit() on a closed Server")
+            if len(self._queue) >= self._queue_depth:
+                shed = True
+            else:
+                self._queue.append(req)
+                depth = len(self._queue)
+                self._cv.notify_all()
+        if shed:
+            self._stats.on_shed()
+            raise QueueFullError(
+                "serving queue full (depth %d): request shed"
+                % self._queue_depth)
+        self._stats.on_submit(depth)
+        return req.future
+
+    def warmup(self) -> int:
+        """Run one dummy batch per bucket so every rung's executable is
+        compiled before traffic arrives; returns the engine compile count.
+        After warmup, a steady-state serve performs zero compiles."""
+        for b in self._ladder:
+            self._engine.run(np.zeros((b,) + self._sample_shape,
+                                      self._dtype))
+        return self._engine.compile_count
+
+    def stats(self) -> dict:
+        """Snapshot of serving metrics (see ``ServingStats.snapshot``),
+        plus the engine's ``compile_count`` and the bucket ladder."""
+        out = self._stats.snapshot()
+        out["compile_count"] = self._engine.compile_count
+        out["buckets"] = list(self._ladder)
+        return out
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop intake; by default serve everything already queued, then
+        stop the batcher thread. ``drain=False`` fails queued requests
+        with :class:`ServerClosedError` instead. ``timeout`` bounds the
+        thread join (seconds; ``None`` waits for the full drain) — the
+        batcher is a daemon thread, so a bounded close abandons a wedged
+        in-flight batch rather than hanging the caller. Idempotent."""
+        with self._cv:
+            self._closed = True
+            dropped: List[_Request] = []
+            if not drain:
+                dropped = list(self._queue)
+                self._queue.clear()
+            self._cv.notify_all()
+        for req in dropped:
+            self._fail(req, ServerClosedError("server closed before serve"))
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # ------------------------------------------------------------------
+    # batcher thread
+    # ------------------------------------------------------------------
+    def _worker(self):
+        top = self._ladder[-1]
+        while True:
+            batch: List[_Request] = []
+            expired: List[_Request] = []
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if not self._queue:  # closed and drained
+                    return
+                # window anchored at the oldest request: no request waits
+                # on coalescing longer than max_delay, regardless of how
+                # traffic trickles in behind it
+                window_end = self._queue[0].t_submit + self._max_delay_s
+                while len(self._queue) < top and not self._closed:
+                    remaining = window_end - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                now = time.perf_counter()
+                while self._queue and len(batch) < top:
+                    req = self._queue.popleft()
+                    if req.deadline is not None and now > req.deadline:
+                        expired.append(req)
+                    else:
+                        batch.append(req)
+                depth = len(self._queue)
+            for req in expired:
+                self._stats.on_timeout()
+                self._fail(req, RequestTimeoutError(
+                    "request spent > its deadline queued"))
+            if not batch:
+                continue
+            try:
+                bucket = select_bucket(len(batch), self._ladder)
+                padded = pad_to_bucket([r.data for r in batch], bucket,
+                                       self._dtype)
+                self._stats.on_batch(len(batch), bucket, depth)
+                self._run_batch(batch, padded)
+            except Exception as exc:  # noqa: BLE001 - batcher must survive
+                # e.g. a custom engine returning malformed output: fail the
+                # batch's futures instead of killing the batcher thread and
+                # hanging every later request
+                self._stats.on_error()
+                for req in batch:
+                    self._fail(req, exc)
+
+    def _run_batch(self, reqs: List[_Request], padded: np.ndarray):
+        try:
+            out = self._engine.run(padded)
+        except Exception as exc:  # noqa: BLE001 - isolation boundary
+            if len(reqs) == 1:
+                self._stats.on_error()
+                self._fail(reqs[0], exc)
+                return
+            # error isolation: the batch is poisoned by (at least) one
+            # member — rerun each alone in the bottom bucket so only the
+            # guilty request(s) observe the failure
+            self._stats.on_isolation_retry()
+            bottom = self._ladder[0]
+            for req in reqs:
+                # each rerun is a real device execution: record it so
+                # batches/bucket_counts/batch_fill track what actually ran
+                self._stats.on_batch(1, bottom, None)
+                self._run_batch([req], pad_to_bucket([req.data], bottom,
+                                                     self._dtype))
+            return
+        self._deliver(reqs, out)
+
+    def _deliver(self, reqs: List[_Request], out):
+        multi = isinstance(out, tuple)
+        done = time.perf_counter()
+        for i, req in enumerate(reqs):
+            result = tuple(o[i] for o in out) if multi else out[i]
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_result(result)
+                self._stats.on_complete((done - req.t_submit) * 1e3)
+
+    @staticmethod
+    def _fail(req: _Request, exc: BaseException):
+        if req.future.done():  # already resolved (only the batcher resolves)
+            return
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_exception(exc)

@@ -1,0 +1,158 @@
+"""Engine: what executes one padded batch. The batcher doesn't care.
+
+An :class:`Engine` maps a host ``(bucket, *sample_shape)`` numpy batch to
+host numpy outputs — the whole device round-trip (transfer in, XLA run,
+one batched device->host copy out) lives behind ``run``. Two production
+backends ship here:
+
+* :class:`BlockEngine` — a live initialized Gluon block. The engine owns
+  its own ``jax.jit`` wrapper (parameters close over as constants), so the
+  jit cache is private and countable: ``compile_count`` is the number of
+  distinct batch shapes compiled, the metric the compile-once guarantee is
+  asserted against.
+* :class:`StableHLOEngine` — a loaded ``aot.export_model`` artifact
+  (``model.stablehlo``). Artifacts exported with ``poly_batch=True`` carry
+  a symbolic batch dimension and serve the whole bucket ladder from one
+  serialization; the jit wrapper re-specializes (once) per bucket.
+
+Tests implement throwaway subclasses (slow/poisoned engines) to drive the
+batcher's failure paths — anything with ``run`` + ``compile_count`` serves.
+"""
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import numpy as np
+
+__all__ = ["Engine", "BlockEngine", "StableHLOEngine"]
+
+BatchOut = Union[np.ndarray, Tuple[np.ndarray, ...]]
+
+
+class Engine:
+    """Interface: run one fixed-shape batch, report compile activity."""
+
+    def run(self, batch: np.ndarray) -> BatchOut:
+        """Execute one padded batch; return host output(s) whose leading
+        axis aligns with the input batch axis."""
+        raise NotImplementedError
+
+    @property
+    def compile_count(self) -> int:
+        """Distinct shapes compiled so far; -1 when the backend can't tell.
+        A steady-state serve must leave this unchanged."""
+        return -1
+
+
+def _host(out) -> BatchOut:
+    """One batched device->host copy for however many outputs there are."""
+    from ..base import fetch_host
+
+    if isinstance(out, (list, tuple)):
+        return tuple(fetch_host(list(out)))
+    return fetch_host([out])[0]
+
+
+def _cache_size(jitted) -> int:
+    probe = getattr(jitted, "_cache_size", None)
+    if probe is None:
+        return -1
+    return int(probe())
+
+
+class BlockEngine(Engine):
+    """Serve a live (initialized, materialized) Gluon block.
+
+    For a :class:`HybridBlock` the forward is the block's functional form
+    (``_base_fn``): the parameter pytree enters every rung's executable as
+    a *traced operand*, so all buckets share ONE set of device parameter
+    buffers instead of each executable baking its own constant copy of the
+    weights (a 4-rung ladder over a 45 MB net would otherwise hold 4
+    copies in HBM). Plain Blocks have no functional form; their forward
+    closes over the parameters, which bake in as XLA constants per rung.
+
+    Either way the values are snapshot at construction — frozen-weights
+    deployment semantics, matching ``aot.export_model``; call
+    :meth:`refresh_params` after retraining to re-snapshot.
+    """
+
+    def __init__(self, block, dtype="float32"):
+        import jax
+        import jax.numpy as jnp
+
+        from .. import _global
+        from ..base import np_dtype
+        from ..ndarray.ndarray import NDArray
+
+        self._block = block
+        self._dtype = np_dtype(dtype)
+        self._jnp = jnp
+        self._global = _global
+        self._functional = hasattr(block, "_base_fn")
+        if self._functional:
+            base_fn = block._base_fn([0], train=False)
+
+            def fwd(pvals, x, rng):
+                outs, _aux = base_fn(pvals, rng, x)  # aux (BN stats)
+                return outs                          # dropped: inference
+
+            self._fwd = fwd
+        else:
+            def fwd_const(x):
+                out = block(NDArray(x, None))
+                if isinstance(out, (list, tuple)):
+                    return tuple(o._data for o in out)
+                return out._data
+
+            self._fwd = fwd_const
+        self._fn = jax.jit(self._fwd)
+        self._pvals = None
+        self.refresh_params()
+
+    def refresh_params(self):
+        """Re-snapshot the block's current parameter values (the block
+        must be initialized with materialized shapes). On the functional
+        path compiled executables are kept — only the buffers swap; the
+        constant-closure path re-jits (warm shapes recompile once)."""
+        if self._functional:
+            params = self._block.collect_params()
+            self._pvals = {n: p.data()._data for n, p in params.items()}
+        else:
+            import jax
+
+            self._fn = jax.jit(self._fwd)
+
+    def run(self, batch: np.ndarray) -> BatchOut:
+        x = self._jnp.asarray(batch, self._dtype)
+        if self._functional:
+            return _host(self._fn(self._pvals, x, self._global.next_key()))
+        return _host(self._fn(x))
+
+    @property
+    def compile_count(self) -> int:
+        return _cache_size(self._fn)
+
+
+class StableHLOEngine(Engine):
+    """Serve a deserialized ``model.stablehlo`` artifact (``aot`` format).
+
+    ``exported.call`` re-traces on every invocation; wrapping it in
+    ``jax.jit`` here makes each concrete batch shape lower exactly once,
+    so bucketed traffic against a ``poly_batch`` export is compile-once
+    with the same countable cache as :class:`BlockEngine`.
+    """
+
+    def __init__(self, out_dir: str):
+        import jax
+
+        from .. import aot
+
+        self._exported = aot.load_stablehlo(out_dir)
+        self._fn = jax.jit(self._exported.call)
+
+    def run(self, batch: np.ndarray) -> BatchOut:
+        return _host(self._fn(batch))
+
+    @property
+    def compile_count(self) -> int:
+        return _cache_size(self._fn)

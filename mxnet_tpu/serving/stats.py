@@ -1,0 +1,132 @@
+"""Serving observability: counters + latency reservoir + profiler bridge.
+
+Two consumers, one collector:
+
+* ``Server.stats()`` — an O(window) synchronous snapshot (queue depth,
+  batch-fill ratio, p50/p99 latency, shed/timeout/error counts) for
+  benches, autoscalers and tests;
+* the framework profiler — every update also feeds ``profiler.py``
+  Counters (queue depth, batch fill) and Markers (shed, timeout), which
+  no-op unless a profiling session is running, so a serve under
+  ``profiler.set_state('run')`` drops its pressure signals straight into
+  the chrome://tracing timeline next to the op/executor lanes.
+
+Latency is held in a bounded ring (``MXNET_SERVING_LATENCY_WINDOW``,
+default 2048 most-recent requests) — percentiles over recent traffic,
+O(1) memory under unbounded load.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from .. import profiler
+from ..base import get_env
+
+__all__ = ["ServingStats"]
+
+_DEFAULT_WINDOW = 2048
+
+
+class ServingStats:
+    """Thread-safe serving metrics collector for one :class:`Server`."""
+
+    def __init__(self, name: str = "serving", window: Optional[int] = None):
+        if window is None:
+            window = get_env("MXNET_SERVING_LATENCY_WINDOW", _DEFAULT_WINDOW,
+                             int, cache=False)
+        self._lock = threading.Lock()
+        self._lat_ms = collections.deque(maxlen=max(1, int(window)))
+        self.submitted = 0
+        self.completed = 0
+        self.shed = 0
+        self.timeouts = 0
+        self.errors = 0
+        self.batches = 0
+        self.padded_rows = 0
+        self.served_rows = 0
+        self.isolation_retries = 0
+        self.bucket_counts: Dict[int, int] = {}
+        self._queue_depth = 0
+        # profiler bridge: zero-cost unless a profiling session is live
+        dom = profiler.Domain(name)
+        self._c_depth = dom.new_counter("queue_depth")
+        self._c_fill = dom.new_counter("batch_fill_pct")
+        self._m_shed = dom.new_marker("shed")
+        self._m_timeout = dom.new_marker("timeout")
+
+    # -- producers (called by Server / batcher thread) ---------------------
+    def on_submit(self, depth: int):
+        with self._lock:
+            self.submitted += 1
+            self._queue_depth = depth
+        self._c_depth.set_value(depth)
+
+    def on_shed(self):
+        with self._lock:
+            self.shed += 1
+        self._m_shed.mark()
+
+    def on_timeout(self):
+        with self._lock:
+            self.timeouts += 1
+        self._m_timeout.mark()
+
+    def on_batch(self, real: int, bucket: int, depth: Optional[int]):
+        """Record one device execution; ``depth=None`` (isolation reruns)
+        leaves the queue-depth gauge untouched."""
+        with self._lock:
+            self.batches += 1
+            self.served_rows += real
+            self.padded_rows += bucket - real
+            self.bucket_counts[bucket] = self.bucket_counts.get(bucket, 0) + 1
+            if depth is not None:
+                self._queue_depth = depth
+        if depth is not None:
+            self._c_depth.set_value(depth)
+        self._c_fill.set_value(100.0 * real / bucket)
+
+    def on_complete(self, latency_ms: float):
+        with self._lock:
+            self.completed += 1
+            self._lat_ms.append(latency_ms)
+
+    def on_error(self):
+        with self._lock:
+            self.errors += 1
+
+    def on_isolation_retry(self):
+        with self._lock:
+            self.isolation_retries += 1
+
+    # -- consumer ----------------------------------------------------------
+    def snapshot(self) -> Dict:
+        """Point-in-time dict of every serving metric (``Server.stats()``)."""
+        with self._lock:
+            lat = np.asarray(self._lat_ms)  # host floats; no device dtype
+            out = {
+                "queue_depth": self._queue_depth,
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "shed": self.shed,
+                "timeouts": self.timeouts,
+                "errors": self.errors,
+                "batches": self.batches,
+                "isolation_retries": self.isolation_retries,
+                "bucket_counts": dict(self.bucket_counts),
+                "batch_fill": (self.served_rows /
+                               (self.served_rows + self.padded_rows)
+                               if self.served_rows else 0.0),
+            }
+        if lat.size:
+            p50, p99 = np.percentile(lat, [50.0, 99.0])
+            out["p50_ms"] = float(p50)
+            out["p99_ms"] = float(p99)
+            out["latency_window"] = int(lat.size)
+        else:
+            out["p50_ms"] = out["p99_ms"] = 0.0
+            out["latency_window"] = 0
+        return out

@@ -1,0 +1,463 @@
+"""mxnet_tpu.serving — dynamic-batching inference server (tier-1, CPU).
+
+Covers the ISSUE-2 acceptance surface: bucket selection/padding,
+compile-once via jit cache-miss counting, concurrent submit, per-request
+timeout, shed-on-full-queue, graceful drain, error isolation, StableHLO
+backend parity with the live block, and the batched predict-ABI entry.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, serving
+from mxnet_tpu.base import MXNetError
+
+
+# ---------------------------------------------------------------------------
+# deterministic engines driving the batcher's policy paths
+# ---------------------------------------------------------------------------
+
+class _DoubleEngine(serving.Engine):
+    """Pure-numpy engine (result == 2 * request, exactly checkable)."""
+
+    def __init__(self):
+        self.batch_sizes = []
+
+    def run(self, batch):
+        self.batch_sizes.append(batch.shape[0])
+        return batch * 2.0
+
+
+class _GateEngine(_DoubleEngine):
+    """Blocks inside run() until released — freezes the batcher mid-batch
+    so queue states (full, stale, closed) can be staged deterministically."""
+
+    def __init__(self, hold_s=0.3):
+        super().__init__()
+        self.started = threading.Event()
+        self.gate = threading.Event()
+        self.hold_s = hold_s
+
+    def run(self, batch):
+        self.started.set()
+        self.gate.wait(self.hold_s)
+        return super().run(batch)
+
+
+class _PoisonEngine(_DoubleEngine):
+    """Raises on any batch containing the poison marker in row position 0."""
+
+    POISON = 42.0
+
+    def run(self, batch):
+        if np.any(batch[:, 0] == self.POISON):
+            raise ValueError("poisoned batch")
+        return super().run(batch)
+
+
+class _MultiOutEngine(serving.Engine):
+    def run(self, batch):
+        return batch * 2.0, batch + 1.0
+
+
+def _mlp(in_dim=8, out_dim=4):
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(out_dim))
+    net.initialize()
+    net(nd.array(np.zeros((1, in_dim), np.float32)))  # materialize params
+    return net
+
+
+# ---------------------------------------------------------------------------
+# buckets
+# ---------------------------------------------------------------------------
+
+def test_bucket_ladder_default_and_env(monkeypatch):
+    assert serving.bucket_ladder() == (1, 4, 16, 32)
+    monkeypatch.setenv("MXNET_SERVING_BUCKETS", "2,8")
+    assert serving.bucket_ladder() == (2, 8)  # cache=False: re-read post-import
+    assert serving.bucket_ladder([32, 1, 8]) == (1, 8, 32)  # explicit wins
+
+
+def test_bucket_ladder_rejects_garbage():
+    with pytest.raises(MXNetError):
+        serving.bucket_ladder([0, 4])
+    with pytest.raises(MXNetError):
+        serving.bucket_ladder([4, 4])
+    with pytest.raises(MXNetError):
+        serving.bucket_ladder([])
+
+
+def test_select_bucket():
+    ladder = (1, 4, 16)
+    assert [serving.select_bucket(n, ladder) for n in (1, 2, 4, 5, 16)] == \
+        [1, 4, 4, 16, 16]
+    assert serving.select_bucket(99, ladder) == 16  # overflow -> top rung
+    with pytest.raises(MXNetError):
+        serving.select_bucket(0, ladder)
+
+
+def test_pad_to_bucket():
+    rows = [np.full((3,), i, np.float32) for i in range(3)]
+    out = serving.pad_to_bucket(rows, 4)
+    assert out.shape == (4, 3) and out.dtype == np.float32
+    np.testing.assert_array_equal(out[:3], np.stack(rows))
+    np.testing.assert_array_equal(out[3], np.zeros(3))
+    with pytest.raises(MXNetError):
+        serving.pad_to_bucket(rows, 2)  # more rows than bucket
+
+
+# ---------------------------------------------------------------------------
+# server correctness
+# ---------------------------------------------------------------------------
+
+def test_serve_block_matches_direct_forward():
+    net = _mlp()
+    rs = np.random.RandomState(0)
+    x = rs.randn(6, 8).astype(np.float32)
+    expect = net(nd.array(x)).asnumpy()
+    with serving.serve_block(net, (8,), buckets=[1, 4, 16],
+                             max_delay_ms=5.0) as srv:
+        futs = [srv.submit(x[i]) for i in range(6)]
+        got = np.stack([f.result(timeout=10) for f in futs])
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_block_functional_engine_and_refresh():
+    """HybridBlocks serve through the functional path: the param pytree is
+    a traced operand (one device copy across rungs) and refresh_params()
+    picks up retrained weights without invalidating compiled shapes."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize()
+    x = np.random.RandomState(4).randn(3, 8).astype(np.float32)
+    net(nd.array(x))
+    eng = serving.BlockEngine(net)
+    assert eng._functional
+    with serving.Server(eng, (8,), buckets=[1, 4], max_delay_ms=5.0) as srv:
+        srv.warmup()
+        compiled = eng.compile_count
+        got = srv.submit(x[0]).result(timeout=10)
+        np.testing.assert_allclose(got, net(nd.array(x[:1])).asnumpy()[0],
+                                   rtol=1e-5, atol=1e-6)
+        # "retrain": perturb a weight, re-snapshot, same compiled shapes
+        w = net[1].weight
+        w.set_data(w.data() * 2.0)
+        eng.refresh_params()
+        got2 = srv.submit(x[0]).result(timeout=10)
+        np.testing.assert_allclose(got2, net(nd.array(x[:1])).asnumpy()[0],
+                                   rtol=1e-5, atol=1e-6)
+        assert not np.allclose(got, got2)
+        assert eng.compile_count == compiled  # buffers swapped, no re-jit
+
+
+def test_compile_once_across_traffic():
+    """The tentpole guarantee: after warmup, traffic of every size hits a
+    warm jit cache entry — the cache-miss count never moves again."""
+    srv = serving.serve_block(_mlp(), (8,), buckets=[1, 2, 4],
+                              max_delay_ms=2.0)
+    assert srv.warmup() == 3  # one executable per rung
+    rs = np.random.RandomState(1)
+    for wave in (1, 2, 3, 4, 7, 1, 5):
+        futs = [srv.submit(rs.randn(8).astype(np.float32))
+                for _ in range(wave)]
+        for f in futs:
+            f.result(timeout=10)
+    st = srv.stats()
+    srv.close()
+    assert st["compile_count"] == 3  # zero steady-state recompiles
+    assert st["completed"] == 23
+    assert st["p50_ms"] > 0 and st["p99_ms"] >= st["p50_ms"]
+    assert 0 < st["batch_fill"] <= 1
+
+
+def test_concurrent_submit_exact_results():
+    eng = _DoubleEngine()
+    srv = serving.Server(eng, (4,), buckets=[1, 4, 16], max_delay_ms=1.0,
+                         queue_depth=1024)
+    n_threads, per = 4, 30
+    results = {}
+
+    def client(tid):
+        futs = []
+        for i in range(per):
+            row = np.full((4,), tid * 1000 + i, np.float32)
+            futs.append((row, srv.submit(row)))
+        results[tid] = [(row, f.result(timeout=10)) for row, f in futs]
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    srv.close()
+    assert sorted(results) == list(range(n_threads))
+    for tid in results:
+        for row, got in results[tid]:
+            np.testing.assert_array_equal(got, row * 2.0)
+    assert max(eng.batch_sizes) <= 16
+
+
+def test_submit_validates_shape_before_enqueue():
+    with serving.Server(_DoubleEngine(), (4,), buckets=[1]) as srv:
+        with pytest.raises(MXNetError):
+            srv.submit(np.zeros((5,), np.float32))
+        st = srv.stats()
+        assert st["submitted"] == 0  # rejected on the caller's thread
+
+
+def test_multi_output_delivery():
+    with serving.Server(_MultiOutEngine(), (3,), buckets=[4],
+                        max_delay_ms=1.0) as srv:
+        row = np.arange(3, dtype=np.float32)
+        out = srv.submit(row).result(timeout=10)
+    assert isinstance(out, tuple) and len(out) == 2
+    np.testing.assert_array_equal(out[0], row * 2.0)
+    np.testing.assert_array_equal(out[1], row + 1.0)
+
+
+# ---------------------------------------------------------------------------
+# robustness policy
+# ---------------------------------------------------------------------------
+
+def test_timeout_of_stale_queued_request():
+    eng = _GateEngine(hold_s=0.5)
+    srv = serving.Server(eng, (2,), buckets=[1], max_delay_ms=0.0,
+                         timeout_ms=0)
+    f1 = srv.submit(np.zeros(2, np.float32))          # no deadline
+    assert eng.started.wait(5)                        # batcher inside run()
+    f2 = srv.submit(np.ones(2, np.float32), timeout_ms=50)
+    time.sleep(0.1)                                   # f2 goes stale queued
+    eng.gate.set()
+    np.testing.assert_array_equal(f1.result(timeout=10), np.zeros(2))
+    with pytest.raises(serving.RequestTimeoutError):
+        f2.result(timeout=10)
+    st = srv.stats()
+    srv.close()
+    assert st["timeouts"] == 1 and st["completed"] == 1
+
+
+def test_shed_on_full_queue():
+    eng = _GateEngine(hold_s=1.0)
+    srv = serving.Server(eng, (2,), buckets=[1], max_delay_ms=0.0,
+                         queue_depth=2, timeout_ms=0)
+    first = srv.submit(np.zeros(2, np.float32))
+    assert eng.started.wait(5)  # in-flight; queue now empty
+    q1 = srv.submit(np.ones(2, np.float32))
+    q2 = srv.submit(np.ones(2, np.float32))
+    with pytest.raises(serving.QueueFullError):
+        srv.submit(np.ones(2, np.float32))  # depth 2 exceeded -> shed
+    eng.gate.set()
+    for f in (first, q1, q2):
+        f.result(timeout=10)  # shed didn't hurt accepted requests
+    st = srv.stats()
+    srv.close()
+    assert st["shed"] == 1 and st["completed"] == 3
+
+
+def test_graceful_drain_on_close():
+    eng = _DoubleEngine()
+    srv = serving.Server(eng, (2,), buckets=[1, 4], max_delay_ms=20.0,
+                         queue_depth=256, timeout_ms=0)
+    futs = [srv.submit(np.full(2, i, np.float32)) for i in range(25)]
+    srv.close()  # drain=True: everything queued still gets served
+    for i, f in enumerate(futs):
+        np.testing.assert_array_equal(f.result(timeout=0.001),
+                                      np.full(2, 2 * i))
+    assert srv.stats()["completed"] == 25
+
+
+def test_close_without_drain_fails_queued():
+    eng = _GateEngine(hold_s=0.3)
+    srv = serving.Server(eng, (2,), buckets=[1], max_delay_ms=0.0,
+                         timeout_ms=0)
+    f1 = srv.submit(np.zeros(2, np.float32))
+    assert eng.started.wait(5)
+    f2 = srv.submit(np.ones(2, np.float32))
+    srv.close(drain=False)
+    np.testing.assert_array_equal(f1.result(timeout=10), np.zeros(2))
+    with pytest.raises(serving.ServerClosedError):
+        f2.result(timeout=10)
+    with pytest.raises(serving.ServerClosedError):
+        srv.submit(np.zeros(2, np.float32))  # intake is closed
+
+
+def test_error_isolation_poisoned_request():
+    eng = _PoisonEngine()
+    srv = serving.Server(eng, (4,), buckets=[4], max_delay_ms=100.0,
+                         timeout_ms=0)
+    rows = [np.full((4,), i + 1, np.float32) for i in range(3)]
+    rows.append(np.full((4,), _PoisonEngine.POISON, np.float32))
+    futs = [srv.submit(r) for r in rows]
+    for r, f in zip(rows[:3], futs[:3]):
+        np.testing.assert_array_equal(f.result(timeout=10), r * 2.0)
+    with pytest.raises(ValueError):  # only the poisoned future fails
+        futs[3].result(timeout=10)
+    st = srv.stats()
+    srv.close()
+    assert st["isolation_retries"] >= 1
+    assert st["errors"] == 1 and st["completed"] == 3
+
+
+def test_batcher_survives_malformed_engine_output():
+    class _BadOnceEngine(serving.Engine):
+        def __init__(self):
+            self.calls = 0
+
+        def run(self, batch):
+            self.calls += 1
+            if self.calls == 1:
+                return batch[:1] * 2.0  # malformed: fewer rows than bucket
+            return batch * 2.0
+
+    srv = serving.Server(_BadOnceEngine(), (2,), buckets=[2],
+                         max_delay_ms=50.0, timeout_ms=0)
+    f1 = srv.submit(np.zeros(2, np.float32))
+    f2 = srv.submit(np.ones(2, np.float32))
+    # the malformed delivery must fail (at least) the short row's future,
+    # not kill the batcher thread
+    with pytest.raises(Exception):
+        f1.result(timeout=10), f2.result(timeout=10)
+    # ...and the server still serves afterwards
+    f3 = srv.submit(np.full(2, 3.0, np.float32))
+    f4 = srv.submit(np.full(2, 4.0, np.float32))
+    np.testing.assert_array_equal(f3.result(timeout=10), np.full(2, 6.0))
+    np.testing.assert_array_equal(f4.result(timeout=10), np.full(2, 8.0))
+    srv.close()
+
+
+def test_env_knob_defaults(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVING_QUEUE_DEPTH", "3")
+    monkeypatch.setenv("MXNET_SERVING_BUCKETS", "1,2")
+    srv = serving.Server(_DoubleEngine(), (2,))
+    try:
+        assert srv._queue_depth == 3  # cache=False knobs: read at ctor time
+        assert srv._ladder == (1, 2)
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# StableHLO backend parity
+# ---------------------------------------------------------------------------
+
+def test_stablehlo_backend_parity_with_block(tmp_path):
+    from mxnet_tpu import aot
+
+    net = _mlp()
+    out_dir = str(tmp_path / "aot")
+    manifest = aot.export_model(net, (1, 8), out_dir, save_tf=False,
+                                poly_batch=True)
+    assert manifest["poly_batch"] is True
+    rs = np.random.RandomState(2)
+    x = rs.randn(5, 8).astype(np.float32)
+    expect = net(nd.array(x)).asnumpy()
+    with serving.serve_stablehlo(out_dir, buckets=[1, 4],
+                                 max_delay_ms=5.0) as srv:
+        srv.warmup()
+        futs = [srv.submit(x[i]) for i in range(5)]
+        got = np.stack([f.result(timeout=10) for f in futs])
+        st = srv.stats()
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+    assert st["compile_count"] == 2  # one per bucket, poly artifact
+
+
+def test_fixed_shape_artifact_defaults_to_its_own_bucket(tmp_path):
+    from mxnet_tpu import aot
+
+    net = _mlp()
+    out_dir = str(tmp_path / "aot_fixed")
+    aot.export_model(net, (2, 8), out_dir, save_tf=False)  # fixed batch 2
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 8).astype(np.float32)
+    expect = net(nd.array(x)).asnumpy()
+    with serving.serve_stablehlo(out_dir, max_delay_ms=20.0) as srv:
+        assert srv._ladder == (2,)  # ladder collapsed to the exported size
+        futs = [srv.submit(x[i]) for i in range(2)]
+        got = np.stack([f.result(timeout=10) for f in futs])
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_poly_batch_rejects_save_tf(tmp_path):
+    from mxnet_tpu import aot
+
+    with pytest.raises(ValueError):
+        aot.export_model(_mlp(), (1, 8), str(tmp_path), save_tf=True,
+                         poly_batch=True)
+
+
+# ---------------------------------------------------------------------------
+# batched predict-ABI entry point
+# ---------------------------------------------------------------------------
+
+def test_predict_embed_forward_batch(tmp_path):
+    from mxnet_tpu import _predict_embed as pe
+    from mxnet_tpu import model
+
+    data = mx.symbol.var("data")
+    hid = mx.symbol.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.symbol.Activation(hid, act_type="relu", name="relu1")
+    sym = mx.symbol.FullyConnected(act, num_hidden=3, name="fc2")
+    rs = np.random.RandomState(0)
+    arg_shapes, _, _ = sym.infer_shape(data=(1, 5))
+    args = {n: mx.nd.array(rs.randn(*s).astype(np.float32) * 0.3)
+            for n, s in zip(sym.list_arguments(), arg_shapes) if n != "data"}
+    prefix = str(tmp_path / "mlp")
+    model.save_checkpoint(prefix, 0, sym, args, {})
+    with open(prefix + "-0000.params", "rb") as f:
+        param_bytes = f.read()
+
+    hdl = pe.create(sym.tojson(), param_bytes, 1, ["data"], [[1, 5]])
+    try:
+        xs = (rs.randn(6, 5).astype(np.float32) * 0.1)
+        # sequential reference through the one-at-a-time ABI
+        seq = []
+        for i in range(6):
+            pe.set_input(hdl, "data", xs[i:i + 1].tobytes())
+            pe.forward(hdl)
+            seq.append(np.frombuffer(pe.get_output(hdl, 0), np.float32))
+        # batched entry: one padded bucketed execution behind the scenes
+        got = pe.forward_batch(hdl, [xs[i].tobytes() for i in range(6)])
+        for g, s in zip(got, seq):
+            np.testing.assert_allclose(np.frombuffer(g, np.float32), s,
+                                       rtol=1e-5, atol=1e-6)
+    finally:
+        pe.free(hdl)  # also closes the per-handle server
+
+
+def test_predict_embed_forward_batch_larger_than_queue(tmp_path, monkeypatch):
+    """forward_batch owns its whole batch: N beyond the queue depth must
+    apply backpressure, not shed its own requests."""
+    from mxnet_tpu import _predict_embed as pe
+    from mxnet_tpu import model
+
+    monkeypatch.setenv("MXNET_SERVING_QUEUE_DEPTH", "8")
+    data = mx.symbol.var("data")
+    sym = mx.symbol.FullyConnected(data, num_hidden=2, name="fc")
+    rs = np.random.RandomState(1)
+    arg_shapes, _, _ = sym.infer_shape(data=(1, 3))
+    args = {n: mx.nd.array(rs.randn(*s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), arg_shapes) if n != "data"}
+    prefix = str(tmp_path / "m")
+    model.save_checkpoint(prefix, 0, sym, args, {})
+    with open(prefix + "-0000.params", "rb") as f:
+        pb = f.read()
+    hdl = pe.create(sym.tojson(), pb, 1, ["data"], [[1, 3]])
+    try:
+        xs = rs.randn(40, 3).astype(np.float32)  # 5x the queue depth
+        outs = pe.forward_batch(hdl, [x.tobytes() for x in xs])
+        assert len(outs) == 40
+        pe.set_input(hdl, "data", xs[:1].tobytes())
+        pe.forward(hdl)
+        ref0 = np.frombuffer(pe.get_output(hdl, 0), np.float32)
+        np.testing.assert_allclose(np.frombuffer(outs[0], np.float32), ref0,
+                                   rtol=1e-5, atol=1e-6)
+    finally:
+        pe.free(hdl)
+    # freed handles refuse to rebuild a server
+    with pytest.raises(KeyError):
+        pe.forward_batch(hdl, [xs[0].tobytes()])
