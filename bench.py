@@ -25,6 +25,21 @@ TRAIN_BASELINE = 298.51   # V100 ResNet-50 train bs=32 fp32, perf.md:214
 INFER_BASELINE = 1076.81  # V100 ResNet-50 infer bs=32 fp32, perf.md:156
 
 
+def _attach_telemetry(out):
+    """Attach a telemetry snapshot to a result line (success OR error):
+    a stall like r05 ("deadline hit during phase 'infer-fp32'") then
+    carries its recompile/transfer counts as evidence instead of a bare
+    message. Must never break the emit path — the snapshot rides along
+    only when the framework got far enough to import."""
+    try:
+        from mxnet_tpu import telemetry
+
+        out["telemetry"] = telemetry.snapshot()
+    except Exception:  # noqa: BLE001 - emit must survive a broken import
+        pass
+    return out
+
+
 def _acquire_backend(timeout_s=120.0, retries=2):
     """Bounded backend acquisition: ``jax.devices()`` can hang indefinitely
     when the accelerator tunnel is down, which previously made a bench run
@@ -82,7 +97,7 @@ def _acquire_backend(timeout_s=120.0, retries=2):
         out["prior_evidence"] = {"file": os.path.basename(path),
                                  "result": result}
         break
-    print(json.dumps(out))
+    print(json.dumps(_attach_telemetry(out)))
     sys.stdout.flush()
     os._exit(1)  # a hung probe thread would block a normal exit
 
@@ -219,7 +234,7 @@ def _emit(error=None):
     }
     if error:
         out["error"] = error
-    print(json.dumps(out))
+    print(json.dumps(_attach_telemetry(out)))
     sys.stdout.flush()
 
 
@@ -243,11 +258,11 @@ def _serving_bench():
     def watchdog():
         time.sleep(deadline)
         if not printed.is_set():
-            print(json.dumps({
+            print(json.dumps(_attach_telemetry({
                 "metric": "serving offered-load throughput",
                 "value": None, "unit": "req/s", "vs_baseline": None,
                 "error": "deadline %.0fs hit during phase %r (accelerator "
-                         "tunnel stall suspected)" % (deadline, phase[0])}))
+                         "tunnel stall suspected)" % (deadline, phase[0])})))
             sys.stdout.flush()
             os._exit(3)
 
@@ -352,7 +367,7 @@ def _serving_bench():
     if errors:
         out["error"] = "; ".join(errors[:3])
     printed.set()
-    print(json.dumps(out))
+    print(json.dumps(_attach_telemetry(out)))
     sys.stdout.flush()
     return 1 if errors or recompiles else 0
 

@@ -50,6 +50,7 @@ for _m in (
     "callback",
     "monitor",
     "profiler",
+    "telemetry",
     "rtc",
     "runtime",
     "visualization",

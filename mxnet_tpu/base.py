@@ -74,13 +74,33 @@ def fetch_host(arrays, dtype=None) -> list:
     flags. NDArray-likes are unwrapped via ``._data``; plain numpy passes
     through. Returns a list of numpy arrays (cast to ``dtype`` if given).
     Shared by metric accumulation, the predict ABI and serving engines.
+
+    Every transfer through here is accounted in the telemetry registry
+    (``mxnet_host_transfer_bytes_total{path="fetch_host"}``), so host-sync
+    cost shows up on a scrape instead of only in a lint report.
     """
     import jax
 
     host = jax.device_get([getattr(a, "_data", a) for a in arrays])
     if dtype is None:
-        return [np.asarray(h) for h in host]
-    return [np.asarray(h, dtype=dtype) for h in host]
+        out = [np.asarray(h) for h in host]
+    else:
+        out = [np.asarray(h, dtype=dtype) for h in host]
+    _telemetry().record_transfer("fetch_host", out)
+    return out
+
+
+_TELEMETRY = None
+
+
+def _telemetry():
+    """The telemetry package, resolved lazily: base loads before telemetry
+    in the package import sequence, but fetch_host only runs long after."""
+    global _TELEMETRY
+    if _TELEMETRY is None:
+        from . import telemetry
+        _TELEMETRY = telemetry
+    return _TELEMETRY
 
 
 # ---------------------------------------------------------------------------

@@ -17,7 +17,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ...base import MXNetError, get_env
+from ...base import MXNetError, fetch_host, get_env
 from . import vocab as _vocab
 
 __all__ = ["register", "create", "get_pretrained_file_names",
@@ -133,8 +133,12 @@ class TokenEmbedding(_vocab.Vocabulary):
         self._unknown_token = vocabulary.unknown_token
         self._reserved_tokens = vocabulary.reserved_tokens
         mat = np.zeros((len(self), source.vec_len), dtype=np.float32)
-        for token, idx in self._token_to_idx.items():
-            mat[idx] = source.get_vecs_by_tokens(token).asnumpy()
+        toks = list(self._token_to_idx)
+        if toks:
+            # one batched lookup + ONE device->host transfer (accounted by
+            # telemetry), not a per-token asnumpy sync
+            vecs, = fetch_host([source.get_vecs_by_tokens(toks)])
+            mat[[self._token_to_idx[t] for t in toks]] = vecs
         self._vec_len = source.vec_len
         self._set_idx_to_vec(mat)
 
@@ -172,8 +176,9 @@ class TokenEmbedding(_vocab.Vocabulary):
             else nd.array(new_vectors)
         if single and len(vecs.shape) == 1:
             vecs = vecs.reshape((1, -1))
-        mat = np.array(self._idx_to_vec.asnumpy())  # asnumpy view is read-only
-        for t, v in zip(toks, vecs.asnumpy()):
+        table, vhost = fetch_host([self._idx_to_vec, vecs])
+        mat = np.array(table)  # fetched views are read-only; copy to write
+        for t, v in zip(toks, vhost):
             mat[self._token_to_idx[t]] = v
         self._set_idx_to_vec(mat)
 
@@ -249,9 +254,10 @@ class CompositeEmbedding(TokenEmbedding):
         self._idx_to_token = list(vocabulary.idx_to_token)
         self._unknown_token = vocabulary.unknown_token
         self._reserved_tokens = vocabulary.reserved_tokens
-        parts = []
-        for emb in token_embeddings:
-            parts.append(emb.get_vecs_by_tokens(self._idx_to_token).asnumpy())
+        # device-side lookups first, then ONE batched host fetch for all
+        # embeddings instead of an asnumpy sync per constituent
+        parts = fetch_host([emb.get_vecs_by_tokens(self._idx_to_token)
+                            for emb in token_embeddings])
         mat = np.concatenate(parts, axis=1)
         self._vec_len = mat.shape[1]
         self._set_idx_to_vec(mat)

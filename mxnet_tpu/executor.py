@@ -16,7 +16,7 @@ import jax
 import numpy as np
 
 from . import _fused, _global
-from . import profiler as _profiler
+from . import telemetry as _telemetry
 from .base import MXNetError, get_env
 from .context import Context, current_context
 from .ndarray.ndarray import NDArray
@@ -163,14 +163,16 @@ class Executor(object):
             if "bwd_jit" not in cell:
                 raw = cell["bwd"]
                 cell["bwd_jit"] = jax.jit(lambda res, cts: raw(res, *cts))
-            (grads,) = cell["bwd_jit"](list(res), list(cts))
+            (grads,) = _telemetry.jit_call("executor.backward",
+                                           cell["bwd_jit"],
+                                           list(res), list(cts))
             return grads
 
         pair = {"fwd": jax.jit(fwd), "bwd": bwd}
         self._fwd_cache[key] = pair
         return pair
 
-    @_profiler.profiled(
+    @_telemetry.traced(
         "executor", lambda self, *a, **kw: "forward(%s)" % self._symbol.name)
     def forward(self, is_train=False, **kwargs):
         """Run forward (reference executor.py:114). kwargs update arg data."""
@@ -196,12 +198,15 @@ class Executor(object):
             pair = self._train_pair(diff_names, shape_sig)
             const_args = {n: v for n, v in arg_vals.items()
                           if n not in diff_names}
-            outputs, aux_updates, self._residuals = pair["fwd"](
+            outputs, aux_updates, self._residuals = _telemetry.jit_call(
+                "executor.train_forward", pair["fwd"],
                 [arg_vals[n] for n in diff_names], const_args, aux_vals, rng)
             self._bwd_pair = pair
             self._diff_names = diff_names
         else:
-            outputs, aux_updates = self._graph_fn(False)(arg_vals, aux_vals, rng)
+            outputs, aux_updates = _telemetry.jit_call(
+                "executor.forward", self._graph_fn(False),
+                arg_vals, aux_vals, rng)
             self._residuals = None
         for name, val in aux_updates.items():
             if name in self.aux_dict:
@@ -214,7 +219,7 @@ class Executor(object):
                 self._monitor_callback(name, out)
         return self.outputs
 
-    @_profiler.profiled(
+    @_telemetry.traced(
         "executor", lambda self, *a, **kw: "backward(%s)" % self._symbol.name)
     def backward(self, out_grads=None, is_train=True):
         """Run backward (reference executor.py:155); accumulates into

@@ -13,9 +13,15 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ... import telemetry
 from ...ndarray import ndarray as nd_mod
 from ...ndarray.ndarray import NDArray
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+_T_PREFETCH = telemetry.counter(
+    "mxnet_io_prefetch_batches_total",
+    "batches prefetched ahead of the consumer",
+    labels=("pipeline",))
 
 __all__ = ["DataLoader", "default_batchify_fn"]
 
@@ -83,7 +89,9 @@ class DataLoader(object):
             depth = max(1, self._prefetch)
 
             def fetch(idx_batch):
-                return self._batchify_fn([self._dataset[i] for i in idx_batch])
+                out = self._batchify_fn([self._dataset[i] for i in idx_batch])
+                _T_PREFETCH.inc(pipeline="gluon.DataLoader")
+                return out
 
             it = iter(batches)
             for _ in range(depth):

@@ -14,10 +14,19 @@ from collections import namedtuple
 
 import numpy as np
 
+from . import telemetry
 from .base import MXNetError
 from .context import cpu
 from .ndarray import ndarray as nd_mod
 from .ndarray.ndarray import NDArray
+
+# pipeline health: batches staged ahead of the consumer, per pipeline kind —
+# a stalled producer shows up as this counter flatlining while the step
+# spans keep ticking
+_T_PREFETCH = telemetry.counter(
+    "mxnet_io_prefetch_batches_total",
+    "batches prefetched ahead of the consumer",
+    labels=("pipeline",))
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
@@ -292,6 +301,8 @@ class PrefetchingIter(DataIter):
                     self.next_batch[i] = self.iters[i].next()
                 except StopIteration:
                     self.next_batch[i] = None
+                if self.next_batch[i] is not None:
+                    _T_PREFETCH.inc(pipeline="PrefetchingIter")
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
 
@@ -691,6 +702,7 @@ class DevicePrefetchIter(DataIter):
             try:
                 for batch in self.base:
                     self._queue.put(self._stage(batch))
+                    _T_PREFETCH.inc(pipeline="DevicePrefetchIter")
             except Exception as exc:  # noqa: BLE001 - delivered at next()
                 self._queue.put(exc)
                 return

@@ -35,10 +35,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import optimizer as opt
+from . import telemetry
 from .base import MXNetError, get_env
 from .ndarray.ndarray import NDArray
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreTPU", "create"]
+
+# gradient-exchange accounting: op counts per kind, durations via the span
+# histogram (mxnet_span_duration_ms{category="kvstore"})
+_T_OPS = telemetry.counter(
+    "mxnet_kvstore_ops_total",
+    "kvstore operations by kind",
+    labels=("op",))
 
 
 def _key(k):
@@ -97,29 +105,34 @@ class KVStore(object):
         REPLACES the stored value (reference kvstore_local.h PushImpl:
         ``local = merged``) — this is what lets Trainer/Module push
         gradients and pull the aggregate back each step."""
-        for k, v in _key_value_pairs(key, value):
-            if k not in self._store:
-                raise MXNetError("key %s has not been initialized" % k)
-            vals = v if isinstance(v, (list, tuple)) else [v]
-            agg = self._reduce([x._data for x in vals])
-            agg = self._to_store_sharding(agg, self._store[k]._data)
-            if self._compression is not None:
-                agg = self._compression.compress(k, agg)
-            if self._updater is not None:
-                grad = NDArray(agg, vals[0].context)
-                self._updater(int(k) if k.isdigit() else k, grad, self._store[k])
-            else:
-                self._store[k]._data = agg
+        _T_OPS.inc(op="push")
+        with telemetry.span("kvstore.push", "kvstore"):
+            for k, v in _key_value_pairs(key, value):
+                if k not in self._store:
+                    raise MXNetError("key %s has not been initialized" % k)
+                vals = v if isinstance(v, (list, tuple)) else [v]
+                agg = self._reduce([x._data for x in vals])
+                agg = self._to_store_sharding(agg, self._store[k]._data)
+                if self._compression is not None:
+                    agg = self._compression.compress(k, agg)
+                if self._updater is not None:
+                    grad = NDArray(agg, vals[0].context)
+                    self._updater(int(k) if k.isdigit() else k, grad,
+                                  self._store[k])
+                else:
+                    self._store[k]._data = agg
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast stored values into out (reference kvstore.py:240)."""
         assert out is not None
-        for k, o in _key_value_pairs(key, out):
-            if k not in self._store:
-                raise MXNetError("key %s has not been initialized" % k)
-            outs = o if isinstance(o, (list, tuple)) else [o]
-            for dst in outs:
-                dst._data = self._store[k]._data
+        _T_OPS.inc(op="pull")
+        with telemetry.span("kvstore.pull", "kvstore"):
+            for k, o in _key_value_pairs(key, out):
+                if k not in self._store:
+                    raise MXNetError("key %s has not been initialized" % k)
+                outs = o if isinstance(o, (list, tuple)) else [o]
+                for dst in outs:
+                    dst._data = self._store[k]._data
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull selected rows (reference kvstore.py:314). XLA has no sparse
@@ -258,16 +271,18 @@ class KVStoreTPU(KVStore):
                 "dist_async with a server-side updater is single-process "
                 "only on this runtime; use dist_sync for multi-host "
                 "training (fused allreduce over ICI/DCN)")
-        for k, v in _key_value_pairs(key, value):
-            if k not in self._store:
-                raise MXNetError("key %s has not been initialized" % k)
-            vals = v if isinstance(v, (list, tuple)) else [v]
-            for x in vals:
-                g = self._to_store_sharding(x._data, self._store[k]._data)
-                if self._compression is not None:
-                    g = self._compression.compress(k, g)
-                self._updater(int(k) if k.isdigit() else k,
-                              NDArray(g, x.context), self._store[k])
+        _T_OPS.inc(op="push")
+        with telemetry.span("kvstore.push_async", "kvstore"):
+            for k, v in _key_value_pairs(key, value):
+                if k not in self._store:
+                    raise MXNetError("key %s has not been initialized" % k)
+                vals = v if isinstance(v, (list, tuple)) else [v]
+                for x in vals:
+                    g = self._to_store_sharding(x._data, self._store[k]._data)
+                    if self._compression is not None:
+                        g = self._compression.compress(k, g)
+                    self._updater(int(k) if k.isdigit() else k,
+                                  NDArray(g, x.context), self._store[k])
 
     @property
     def rank(self):
@@ -321,27 +336,30 @@ class KVStoreTPU(KVStore):
         assert self._updater is None and self._compression is None
         from . import parallel
 
-        norm = []
-        for k, v in zip(keys, value_lists):
-            kk = _key(k)
-            if kk not in self._store:
-                raise MXNetError("key %s has not been initialized" % kk)
-            norm.append((kk, v if isinstance(v, (list, tuple)) else [v]))
-        totals = parallel.all_reduce_multi([[x._data for x in v]
-                                            for _, v in norm])
-        for (kk, _), total, o in zip(norm, totals, out_lists):
-            self._store[kk]._data = self._to_store_sharding(
-                total, self._store[kk]._data)
-            outs = o if isinstance(o, (list, tuple)) else [o]
-            for dst in outs:
-                dst_devs = dst._data.devices() if hasattr(dst._data, "devices") \
-                    else None
-                if dst_devs and len(dst_devs) == 1 and hasattr(total, "devices") \
-                        and dst_devs != total.devices():
-                    dst._data = parallel.shard_for_device(
-                        total, next(iter(dst_devs)))
-                else:
-                    dst._data = total
+        _T_OPS.inc(op="pushpull_multi")
+        with telemetry.span("kvstore.pushpull_multi", "kvstore"):
+            norm = []
+            for k, v in zip(keys, value_lists):
+                kk = _key(k)
+                if kk not in self._store:
+                    raise MXNetError("key %s has not been initialized" % kk)
+                norm.append((kk, v if isinstance(v, (list, tuple)) else [v]))
+            totals = parallel.all_reduce_multi([[x._data for x in v]
+                                                for _, v in norm])
+            for (kk, _), total, o in zip(norm, totals, out_lists):
+                self._store[kk]._data = self._to_store_sharding(
+                    total, self._store[kk]._data)
+                outs = o if isinstance(o, (list, tuple)) else [o]
+                for dst in outs:
+                    dst_devs = dst._data.devices() \
+                        if hasattr(dst._data, "devices") else None
+                    if dst_devs and len(dst_devs) == 1 \
+                            and hasattr(total, "devices") \
+                            and dst_devs != total.devices():
+                        dst._data = parallel.shard_for_device(
+                            total, next(iter(dst_devs)))
+                    else:
+                        dst._data = total
 
     def _barrier(self):
         """Block until all local work completes (reference

@@ -12,7 +12,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..base import MXNetError
+from ..base import MXNetError, fetch_host
 from ..context import Context
 from ..ndarray import ndarray as nd_mod
 from ..ndarray.ndarray import NDArray
@@ -122,9 +122,12 @@ class DataParallelExecutorGroup(object):
             if len(block) == 1:
                 weight = block[0]
             else:
-                acc = block[0].asnumpy()
-                for w in block[1:]:
-                    acc = acc + w.asnumpy()
+                # ONE batched transfer for every device copy of the block
+                # (telemetry-accounted), then average on host
+                host = fetch_host(block)
+                acc = host[0]
+                for w in host[1:]:
+                    acc = acc + w
                 weight = nd_mod.array(acc / len(block))
             arg_params[name] = weight.copyto(weight.context) if name not in arg_params \
                 else arg_params[name]

@@ -13,6 +13,7 @@ import math
 import re
 
 from . import ndarray as nd_mod
+from .base import fetch_host
 from .ndarray.ndarray import NDArray
 
 __all__ = ["Monitor"]
@@ -27,10 +28,11 @@ def _fmt(stat):
     """Render one recorded statistic: scalars print bare, arrays via numpy;
     a stat_func may also return a list of NDArrays (reference contract)."""
     vals = stat if isinstance(stat, list) else [stat]
-    parts = []
     for v in vals:
         assert isinstance(v, NDArray), "stat_func must return NDArray(s)"
-        a = v.asnumpy()
+    # ONE batched device->host transfer for however many stats came back
+    parts = []
+    for a in fetch_host(vals):
         parts.append(str(a.reshape(-1)[0]) if a.size == 1 else str(a))
     return "\t".join(parts) + "\t"
 

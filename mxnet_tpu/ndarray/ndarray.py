@@ -91,7 +91,12 @@ class NDArray:
     # conversion / sync
     # ------------------------------------------------------------------
     def asnumpy(self) -> np.ndarray:
-        return np.asarray(self._data)
+        out = np.asarray(self._data)
+        # host-sync accounting: asnumpy is THE implicit device->host sync
+        # tpulint can only flag statically; the telemetry counter measures
+        # how much of it a run actually does (free when telemetry is off)
+        _telemetry.record_transfer("asnumpy", (out,))
+        return out
 
     def asscalar(self):
         if self.size != 1:
@@ -522,6 +527,7 @@ class NDArray:
 
 from .. import profiler as _profiler
 from .. import engine as _engine
+from .. import telemetry as _telemetry
 
 
 @_profiler.profiled("operator", lambda op_name, *i, **kw: op_name)

@@ -55,6 +55,18 @@ _jax_trace_active = False
 # fast-path flag read by the eager dispatch hook; avoids any work when off
 ENABLED = False
 
+_TELEMETRY = None
+
+
+def _telemetry():
+    """The telemetry package, lazily: telemetry.spans imports this module,
+    so the reverse edge must resolve at call time, not import time."""
+    global _TELEMETRY
+    if _TELEMETRY is None:
+        from . import telemetry
+        _TELEMETRY = telemetry
+    return _TELEMETRY
+
 
 def _now_us() -> float:
     return time.perf_counter() * 1e6
@@ -71,11 +83,28 @@ def set_config(**kwargs):
 
 
 def set_state(state="stop", profile_process="worker"):
-    """Start/stop profiling (reference profiler.py set_state)."""
+    """Start/stop profiling (reference profiler.py set_state).
+
+    ``set_state('run')`` while already running is a no-op that warns: the
+    session keeps its original event buffer AND the jax device trace keeps
+    streaming to the ``.jaxtrace`` directory derived from the filename
+    configured at start — a ``set_config(filename=...)`` between two run
+    calls does NOT rotate the trace. Stop first, then run, to restart
+    under a new filename.
+    """
     global _state, ENABLED, _jax_trace_active
     if state not in ("run", "stop"):
         raise MXNetError("profiler state must be 'run' or 'stop'")
     with _lock:
+        if state == "run" and _state == "run":
+            import warnings
+
+            warnings.warn(
+                "profiler.set_state('run') while already running is a "
+                "no-op: the active session (and any jax trace directory "
+                "chosen at start) continues; call set_state('stop') first "
+                "to restart with the current filename", stacklevel=2)
+            return
         if state == "run" and _state != "run":
             _state = "run"
             ENABLED = not _paused
@@ -112,16 +141,22 @@ def _stop_jax_trace():
 
 def pause(profile_process="worker"):
     """Suspend event collection without ending the session (reference
-    profiler.py pause)."""
+    profiler.py pause). Holds ``_lock``: pause/resume race ``set_state``
+    from other threads, and an unlocked write could otherwise interleave
+    with a concurrent stop->run transition and leave ENABLED stale-on for
+    a stopped session (or stale-off for a running one)."""
     global _paused, ENABLED
-    _paused = True
-    ENABLED = False
+    with _lock:
+        _paused = True
+        ENABLED = False
 
 
 def resume(profile_process="worker"):
+    """Re-enable collection for the active session (no-op when stopped)."""
     global _paused, ENABLED
-    _paused = False
-    ENABLED = _state == "run"
+    with _lock:
+        _paused = False
+        ENABLED = _state == "run"
 
 
 def record_event(name: str, category: str, start_us: float, dur_us: float):
@@ -303,6 +338,11 @@ class Counter:
                     "cat": "counter", "ph": "C", "ts": _now_us(),
                     "pid": os.getpid(),
                     "args": {self.name: value}})
+        # registry bridge: the chrome-trace counter lane and the scrapable
+        # mxnet_profiler_counter gauge are fed by the same update (the
+        # gauge records regardless of whether a profiling session is live)
+        _telemetry().PROFILER_COUNTER.set(value, domain=str(self.domain),
+                                          counter=self.name)
 
     def set_value(self, value):
         with self._vlock:

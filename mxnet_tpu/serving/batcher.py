@@ -36,6 +36,7 @@ from typing import Deque, List, Optional, Sequence
 
 import numpy as np
 
+from .. import telemetry
 from ..base import MXNetError, get_env, np_dtype
 from .buckets import bucket_ladder, pad_to_bucket, select_bucket
 from .engine import Engine
@@ -106,6 +107,8 @@ class Server:
         self._queue_depth = max(1, int(queue_depth))
         self._timeout_s = float(timeout_ms) / 1e3
         self._stats = ServingStats(name)
+        self._name = name
+        self._warm_compiles: Optional[int] = None
         self._queue: Deque[_Request] = collections.deque()
         self._cv = threading.Condition()
         self._closed = False
@@ -160,14 +163,32 @@ class Server:
         for b in self._ladder:
             self._engine.run(np.zeros((b,) + self._sample_shape,
                                       self._dtype))
-        return self._engine.compile_count
+        count = self._engine.compile_count
+        # anchor for the steady-state-recompile gauge: any compile the
+        # engine does past this point violates the compile-once promise.
+        # No gauge when the engine can't count compiles (-1) — a constant
+        # 0 that was never measured would defeat the alert it feeds.
+        self._warm_compiles = count if count >= 0 else None
+        if self._warm_compiles is not None:
+            telemetry.set_steady_state_recompiles("serving." + self._name, 0)
+        return count
 
     def stats(self) -> dict:
         """Snapshot of serving metrics (see ``ServingStats.snapshot``),
-        plus the engine's ``compile_count`` and the bucket ladder."""
+        plus the engine's ``compile_count``, the bucket ladder, and — once
+        :meth:`warmup` has run — ``steady_state_recompiles`` (compiles
+        since warmup; the bucket ladder exists so this stays 0, and the
+        ``mxnet_steady_state_recompiles`` gauge lets a scraper alert on
+        it)."""
         out = self._stats.snapshot()
-        out["compile_count"] = self._engine.compile_count
+        count = self._engine.compile_count
+        out["compile_count"] = count
         out["buckets"] = list(self._ladder)
+        if self._warm_compiles is not None and count >= 0:
+            steady = count - self._warm_compiles
+            out["steady_state_recompiles"] = steady
+            telemetry.set_steady_state_recompiles(
+                "serving." + self._name, steady)
         return out
 
     def close(self, drain: bool = True, timeout: Optional[float] = None):

@@ -54,10 +54,9 @@ def _host(out) -> BatchOut:
 
 
 def _cache_size(jitted) -> int:
-    probe = getattr(jitted, "_cache_size", None)
-    if probe is None:
-        return -1
-    return int(probe())
+    from ..telemetry import jit_cache_size
+
+    return jit_cache_size(jitted)
 
 
 class BlockEngine(Engine):
@@ -123,10 +122,14 @@ class BlockEngine(Engine):
             self._fn = jax.jit(self._fwd)
 
     def run(self, batch: np.ndarray) -> BatchOut:
+        from .. import telemetry
+
         x = self._jnp.asarray(batch, self._dtype)
         if self._functional:
-            return _host(self._fn(self._pvals, x, self._global.next_key()))
-        return _host(self._fn(x))
+            return _host(telemetry.jit_call("serving.block_engine", self._fn,
+                                            self._pvals, x,
+                                            self._global.next_key()))
+        return _host(telemetry.jit_call("serving.block_engine", self._fn, x))
 
     @property
     def compile_count(self) -> int:
@@ -151,7 +154,10 @@ class StableHLOEngine(Engine):
         self._fn = jax.jit(self._exported.call)
 
     def run(self, batch: np.ndarray) -> BatchOut:
-        return _host(self._fn(batch))
+        from .. import telemetry
+
+        return _host(telemetry.jit_call("serving.stablehlo_engine",
+                                        self._fn, batch))
 
     @property
     def compile_count(self) -> int:

@@ -1,6 +1,6 @@
-"""Serving observability: counters + latency reservoir + profiler bridge.
+"""Serving observability: counters + latency reservoir + two bridges.
 
-Two consumers, one collector:
+Three consumers, one collector:
 
 * ``Server.stats()`` — an O(window) synchronous snapshot (queue depth,
   batch-fill ratio, p50/p99 latency, shed/timeout/error counts) for
@@ -9,7 +9,12 @@ Two consumers, one collector:
   Counters (queue depth, batch fill) and Markers (shed, timeout), which
   no-op unless a profiling session is running, so a serve under
   ``profiler.set_state('run')`` drops its pressure signals straight into
-  the chrome://tracing timeline next to the op/executor lanes.
+  the chrome://tracing timeline next to the op/executor lanes;
+* the telemetry registry — the same updates publish Prometheus-scrapable
+  series (``mxnet_serving_requests_total{server=,event=}``, the
+  ``mxnet_serving_latency_ms`` p50/p99 summary, queue-depth/batch-fill
+  gauges, per-bucket batch counts), so a fleet dashboard reads serving
+  pressure without calling into the process.
 
 Latency is held in a bounded ring (``MXNET_SERVING_LATENCY_WINDOW``,
 default 2048 most-recent requests) — percentiles over recent traffic,
@@ -23,12 +28,35 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from .. import profiler
+from .. import profiler, telemetry
 from ..base import get_env
 
 __all__ = ["ServingStats"]
 
 _DEFAULT_WINDOW = 2048
+
+# registry handles shared by every ServingStats; the `server` label keeps
+# concurrent servers in one process apart
+_T_REQS = telemetry.counter(
+    "mxnet_serving_requests_total",
+    "serving request lifecycle events",
+    labels=("server", "event"))
+_T_LATENCY = telemetry.histogram(
+    "mxnet_serving_latency_ms",
+    "end-to-end request latency (submit to result) in milliseconds",
+    labels=("server",))
+_T_DEPTH = telemetry.gauge(
+    "mxnet_serving_queue_depth",
+    "requests waiting in the submit queue",
+    labels=("server",))
+_T_FILL = telemetry.gauge(
+    "mxnet_serving_batch_fill_pct",
+    "real rows over bucket size of the most recent batch, percent",
+    labels=("server",))
+_T_BATCHES = telemetry.counter(
+    "mxnet_serving_batches_total",
+    "device batch executions per bucket rung",
+    labels=("server", "bucket"))
 
 
 class ServingStats:
@@ -51,6 +79,7 @@ class ServingStats:
         self.isolation_retries = 0
         self.bucket_counts: Dict[int, int] = {}
         self._queue_depth = 0
+        self.name = name
         # profiler bridge: zero-cost unless a profiling session is live
         dom = profiler.Domain(name)
         self._c_depth = dom.new_counter("queue_depth")
@@ -64,16 +93,20 @@ class ServingStats:
             self.submitted += 1
             self._queue_depth = depth
         self._c_depth.set_value(depth)
+        _T_REQS.inc(server=self.name, event="submitted")
+        _T_DEPTH.set(depth, server=self.name)
 
     def on_shed(self):
         with self._lock:
             self.shed += 1
         self._m_shed.mark()
+        _T_REQS.inc(server=self.name, event="shed")
 
     def on_timeout(self):
         with self._lock:
             self.timeouts += 1
         self._m_timeout.mark()
+        _T_REQS.inc(server=self.name, event="timeout")
 
     def on_batch(self, real: int, bucket: int, depth: Optional[int]):
         """Record one device execution; ``depth=None`` (isolation reruns)
@@ -87,20 +120,27 @@ class ServingStats:
                 self._queue_depth = depth
         if depth is not None:
             self._c_depth.set_value(depth)
+            _T_DEPTH.set(depth, server=self.name)
         self._c_fill.set_value(100.0 * real / bucket)
+        _T_FILL.set(100.0 * real / bucket, server=self.name)
+        _T_BATCHES.inc(server=self.name, bucket=bucket)
 
     def on_complete(self, latency_ms: float):
         with self._lock:
             self.completed += 1
             self._lat_ms.append(latency_ms)
+        _T_REQS.inc(server=self.name, event="completed")
+        _T_LATENCY.observe(latency_ms, server=self.name)
 
     def on_error(self):
         with self._lock:
             self.errors += 1
+        _T_REQS.inc(server=self.name, event="error")
 
     def on_isolation_retry(self):
         with self._lock:
             self.isolation_retries += 1
+        _T_REQS.inc(server=self.name, event="isolation_retry")
 
     # -- consumer ----------------------------------------------------------
     def snapshot(self) -> Dict:

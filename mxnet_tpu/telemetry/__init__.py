@@ -1,0 +1,63 @@
+"""mxnet_tpu.telemetry — unified runtime observability.
+
+The framework-wide metrics layer (ROADMAP north star: a production system
+serving millions of users needs its runtime *measured*, not guessed). One
+process-wide registry, fed by every subsystem, read by machine-scrapable
+exporters:
+
+====================  =====================================================
+piece                 what it gives you
+====================  =====================================================
+:mod:`.registry`      Counter/Gauge/Histogram with labels; thread-safe;
+                      bounded-reservoir percentiles; free when
+                      ``MXNET_TELEMETRY=0``
+:mod:`.spans`         ``telemetry.span("x")`` context manager/decorator —
+                      duration histograms in the registry AND chrome-trace
+                      events in the profiler buffer from one call site
+:mod:`.accounting`    the TPU-truth numbers: recompiles + compile seconds
+                      per jit call site, device->host transfer count/bytes
+                      per path, the serving steady-state-recompile gauge
+:mod:`.exporters`     ``render_prometheus()`` text format, ``snapshot()``
+                      JSON, and the ``MXNET_TELEMETRY_EMIT_SECS`` JSONL
+                      emitter thread for post-mortems of hung runs
+====================  =====================================================
+
+Publishers wired in-framework: ``serving.ServingStats``, ``profiler.
+Counter``, ``kvstore`` push/pull, the io/gluon prefetch pipelines, the
+executor's forward/backward, ``base.fetch_host`` and ``NDArray.asnumpy``.
+
+Knobs (all via ``base.get_env``; registry in ``docs/env_var.md``):
+``MXNET_TELEMETRY`` (default 1), ``MXNET_TELEMETRY_RESERVOIR`` (2048),
+``MXNET_TELEMETRY_EMIT_SECS`` (0 = off), ``MXNET_TELEMETRY_EMIT_PATH``
+(``telemetry.jsonl``). See ``docs/observability.md`` for the architecture
+and the metric naming scheme.
+"""
+from __future__ import annotations
+
+from . import accounting, exporters, registry, spans
+from .accounting import (COMPILE_SECONDS, PROFILER_COUNTER, RECOMPILES,
+                         STEADY_STATE_RECOMPILES, TRANSFER_BYTES, TRANSFERS,
+                         jit_cache_size, jit_call, note_recompile,
+                         record_transfer, set_steady_state_recompiles)
+from .exporters import (Emitter, render_prometheus, snapshot, start_emitter,
+                        stop_emitter)
+from .registry import (Counter, Gauge, Histogram, Registry, REGISTRY,
+                       counter, gauge, histogram, enabled, set_enabled)
+from .spans import span, traced
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
+    "counter", "gauge", "histogram", "enabled", "set_enabled",
+    "span", "traced",
+    "jit_call", "jit_cache_size", "note_recompile", "record_transfer",
+    "set_steady_state_recompiles",
+    "RECOMPILES", "COMPILE_SECONDS", "STEADY_STATE_RECOMPILES",
+    "TRANSFERS", "TRANSFER_BYTES", "PROFILER_COUNTER",
+    "render_prometheus", "snapshot", "Emitter", "start_emitter",
+    "stop_emitter",
+]
+
+# Post-mortem channel: MXNET_TELEMETRY_EMIT_SECS > 0 starts the JSONL
+# emitter as soon as telemetry loads (start_emitter reads the knob and
+# no-ops at <= 0, the default).
+start_emitter()

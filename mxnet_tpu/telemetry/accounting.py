@@ -1,0 +1,123 @@
+"""TPU-truth accounting: the two silent performance killers, quantified.
+
+On this stack the throughput cliffs that hurt in production are invisible
+to the chrome trace unless you know to look: an XLA **recompile** (a new
+shape reaching a jit cache) costs seconds and, recurring in steady state,
+caps throughput at compile speed; a **device->host transfer** (``asnumpy``
+and friends) serializes dispatch per call. tpulint flags the static
+patterns; this module measures what actually happened at runtime:
+
+* :func:`jit_call` wraps a jitted callable per *call site* and counts jit
+  cache growth (``mxnet_recompiles_total{site=}``) plus the wall time of
+  calls that compiled (``mxnet_compile_seconds_total{site=}``);
+* :func:`record_transfer` accumulates transfer count and bytes per *path*
+  (``fetch_host``, ``asnumpy``) — wired into ``base.fetch_host`` and the
+  NDArray host-conversion methods;
+* :func:`set_steady_state_recompiles` is the serving-facing gauge: after
+  ``Server.warmup()`` it must stay 0, and the bench asserts exactly that.
+"""
+from __future__ import annotations
+
+import time
+
+from . import registry as _registry
+
+__all__ = ["RECOMPILES", "COMPILE_SECONDS", "STEADY_STATE_RECOMPILES",
+           "TRANSFERS", "TRANSFER_BYTES", "PROFILER_COUNTER",
+           "jit_call", "jit_cache_size", "note_recompile",
+           "record_transfer", "set_steady_state_recompiles"]
+
+RECOMPILES = _registry.counter(
+    "mxnet_recompiles_total",
+    "XLA (re)compilations observed per jit call site",
+    labels=("site",))
+
+COMPILE_SECONDS = _registry.counter(
+    "mxnet_compile_seconds_total",
+    "cumulative wall seconds of jit calls that triggered a compile",
+    labels=("site",))
+
+STEADY_STATE_RECOMPILES = _registry.gauge(
+    "mxnet_steady_state_recompiles",
+    "recompiles after warmup at a site that promised compile-once "
+    "(serving asserts 0)",
+    labels=("site",))
+
+TRANSFERS = _registry.counter(
+    "mxnet_host_transfers_total",
+    "device->host transfer operations per path",
+    labels=("path",))
+
+TRANSFER_BYTES = _registry.counter(
+    "mxnet_host_transfer_bytes_total",
+    "bytes moved device->host per path",
+    labels=("path",))
+
+PROFILER_COUNTER = _registry.gauge(
+    "mxnet_profiler_counter",
+    "latest value of each profiler.Counter (chrome-trace counter lanes, "
+    "bridged)",
+    labels=("domain", "counter"))
+
+
+def jit_cache_size(jitted) -> int:
+    """Compiled-entry count of a ``jax.jit`` callable; -1 when the backend
+    can't tell (same probe contract as ``serving.engine``)."""
+    probe = getattr(jitted, "_cache_size", None)
+    if probe is None:
+        return -1
+    try:
+        return int(probe())
+    except Exception:  # noqa: BLE001 - a probe must never break the call
+        return -1
+
+
+def jit_call(site: str, jitted, *args, **kwargs):
+    """Invoke ``jitted(*args, **kwargs)`` recording recompiles at ``site``.
+
+    Cache growth across the call means this invocation traced+compiled —
+    count it and attribute the call's wall time as compile cost (dispatch
+    time is noise next to an XLA compile). Repeated same-shape calls grow
+    nothing and record nothing, so a steady-state loop through here is
+    probe-only overhead (two int reads on the jit cache).
+    """
+    if not _registry.ENABLED:
+        return jitted(*args, **kwargs)
+    before = jit_cache_size(jitted)
+    t0 = time.perf_counter()
+    out = jitted(*args, **kwargs)
+    if before >= 0:
+        after = jit_cache_size(jitted)
+        if after > before:
+            RECOMPILES.inc(after - before, site=site)
+            COMPILE_SECONDS.inc(time.perf_counter() - t0, site=site)
+    return out
+
+
+def note_recompile(site: str, count: int = 1, seconds: float = 0.0):
+    """Manual recompile report for backends without a countable cache."""
+    if not _registry.ENABLED or count <= 0:
+        return
+    RECOMPILES.inc(count, site=site)
+    if seconds > 0:
+        COMPILE_SECONDS.inc(seconds, site=site)
+
+
+def set_steady_state_recompiles(site: str, count: int):
+    """Publish the post-warmup recompile count for ``site``."""
+    if not _registry.ENABLED:
+        return
+    STEADY_STATE_RECOMPILES.set(count, site=site)
+
+
+def record_transfer(path: str, arrays):
+    """Account one device->host transfer of ``arrays`` (any objects with
+    ``nbytes``; others count as 0 bytes) under the given ``path`` label."""
+    if not _registry.ENABLED:
+        return
+    nbytes = 0
+    for a in arrays:
+        n = getattr(a, "nbytes", 0)
+        nbytes += n
+    TRANSFERS.inc(1, path=path)
+    TRANSFER_BYTES.inc(nbytes, path=path)

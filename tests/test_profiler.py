@@ -61,6 +61,44 @@ def test_pause_resume():
     assert any("relu" in k for k in mx.profiler._agg)
 
 
+def test_redundant_run_is_noop_and_warns():
+    mx.profiler.set_state("run")
+    nd.relu(nd.ones((2, 2))).asnumpy()
+    assert any("relu" in k for k in mx.profiler._agg)
+    with pytest.warns(UserWarning, match="no-op"):
+        mx.profiler.set_state("run")
+    # the session continued: the redundant run did NOT clear the buffers
+    assert any("relu" in k for k in mx.profiler._agg)
+    mx.profiler.set_state("stop")
+
+
+def test_pause_resume_threaded_against_set_state():
+    """pause/resume from worker threads while the main thread cycles
+    set_state: the final state must be consistent (both now mutate under
+    _lock), i.e. a stopped profiler is never left ENABLED."""
+    import threading
+
+    stop = threading.Event()
+
+    def churn():
+        while not stop.is_set():
+            mx.profiler.pause()
+            mx.profiler.resume()
+
+    threads = [threading.Thread(target=churn) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for _ in range(200):
+        mx.profiler.set_state("run")
+        mx.profiler.set_state("stop")
+    stop.set()
+    for t in threads:
+        t.join()
+    # profiler is stopped; a straggling resume() must not re-enable it
+    mx.profiler.resume()
+    assert mx.profiler.ENABLED is False
+
+
 def test_profiler_off_means_no_events():
     nd.ones((2, 2)).asnumpy()
     assert not mx.profiler._events

@@ -1,0 +1,359 @@
+"""Telemetry tests: registry semantics + thread safety, span double-sink,
+recompile/transfer accounting, exporters, disabled-path freedom, and the
+serving/training smoke the acceptance criteria are stated against."""
+import json
+import re
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, telemetry
+from mxnet_tpu.telemetry import registry as reg_mod
+
+
+@pytest.fixture(autouse=True)
+def _reset_registry():
+    prev = telemetry.set_enabled(True)
+    telemetry.REGISTRY.clear_data()
+    yield
+    telemetry.REGISTRY.clear_data()
+    telemetry.set_enabled(prev)
+
+
+# ---------------------------------------------------------------------------
+# registry core
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_basics():
+    c = telemetry.counter("mxnet_t_basic_total", "help", labels=("k",))
+    c.inc(k="a")
+    c.inc(2.5, k="a")
+    c.inc(k="b")
+    assert c.value(k="a") == 3.5
+    assert c.value(k="b") == 1.0
+    assert c.value(k="never") == 0.0
+    with pytest.raises(mx.MXNetError):
+        c.inc(-1, k="a")  # counters are monotonic
+    g = telemetry.gauge("mxnet_t_basic_gauge")
+    g.set(5)
+    g.dec(2)
+    assert g.value() == 3.0
+
+
+def test_get_or_create_and_kind_mismatch():
+    a = telemetry.counter("mxnet_t_shared_total", labels=("x",))
+    b = telemetry.counter("mxnet_t_shared_total", labels=("x",))
+    assert a is b  # instrumentation points in different modules share series
+    with pytest.raises(mx.MXNetError):
+        telemetry.gauge("mxnet_t_shared_total", labels=("x",))
+    with pytest.raises(mx.MXNetError):
+        telemetry.counter("mxnet_t_shared_total", labels=("y",))
+
+
+def test_label_validation():
+    with pytest.raises(mx.MXNetError):
+        telemetry.counter("bad name")
+    with pytest.raises(mx.MXNetError):
+        telemetry.counter("mxnet_t_badlabel_total", labels=("bad-label",))
+    c = telemetry.counter("mxnet_t_labels_total", labels=("a", "b"))
+    with pytest.raises(mx.MXNetError):
+        c.inc(a="1")  # missing label
+    with pytest.raises(mx.MXNetError):
+        c.inc(a="1", b="2", c="3")  # extra label
+
+
+def test_registry_thread_safety_concurrent_increments():
+    c = telemetry.counter("mxnet_t_race_total", labels=("who",))
+    h = telemetry.histogram("mxnet_t_race_ms", labels=())
+    n_threads, n_iter = 8, 1000
+
+    def worker(i):
+        for _ in range(n_iter):
+            c.inc(who="t%d" % (i % 2))
+            h.observe(1.0)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = c.value(who="t0") + c.value(who="t1")
+    assert total == n_threads * n_iter  # no lost read-modify-write updates
+    assert h.count() == n_threads * n_iter
+
+
+def test_histogram_percentile_sanity():
+    h = telemetry.histogram("mxnet_t_pct_ms", labels=("s",), reservoir=4096)
+    for v in range(1, 1001):  # 1..1000
+        h.observe(float(v), s="w")
+    assert h.count(s="w") == 1000
+    assert abs(h.percentile(50, s="w") - 500) <= 10
+    assert abs(h.percentile(99, s="w") - 990) <= 10
+    (row,) = h.series()
+    assert row["sum"] == sum(range(1, 1001))
+    assert row["p50"] <= row["p90"] <= row["p99"]
+
+
+def test_histogram_reservoir_bounded():
+    h = telemetry.histogram("mxnet_t_bounded_ms", reservoir=64)
+    for v in range(10000):
+        h.observe(float(v))
+    (row,) = h.series()
+    assert row["count"] == 10000      # exact totals survive the window
+    assert row["window"] == 64        # ...but memory stays bounded
+    assert row["p50"] >= 9000         # window holds only recent values
+
+
+def test_clear_data_keeps_handles_working():
+    c = telemetry.counter("mxnet_t_clear_total")
+    c.inc()
+    telemetry.REGISTRY.clear_data()
+    assert c.value() == 0.0
+    c.inc()
+    assert c.value() == 1.0
+
+
+# ---------------------------------------------------------------------------
+# disabled path
+# ---------------------------------------------------------------------------
+
+class _PoisonLock:
+    """Lock stand-in that fails the test if anything acquires it."""
+
+    def __enter__(self):
+        raise AssertionError("disabled telemetry path acquired a lock")
+
+    def __exit__(self, *exc):
+        return False
+
+    def acquire(self, *a, **kw):
+        raise AssertionError("disabled telemetry path acquired a lock")
+
+    release = acquire
+
+
+def test_disabled_path_does_no_locking():
+    c = telemetry.counter("mxnet_t_off_total", labels=("k",))
+    g = telemetry.gauge("mxnet_t_off_gauge")
+    h = telemetry.histogram("mxnet_t_off_ms")
+    telemetry.set_enabled(False)
+    try:
+        c._lock = g._lock = h._lock = _PoisonLock()
+        c.inc(k="a")
+        g.set(1)
+        h.observe(2.0)
+        with telemetry.span("off-region"):
+            pass
+        telemetry.record_transfer("asnumpy", (np.zeros(4),))
+    finally:
+        c._lock, g._lock, h._lock = (threading.Lock(), threading.Lock(),
+                                     threading.Lock())
+        telemetry.set_enabled(True)
+    assert c.value(k="a") == 0.0  # nothing was recorded while off
+
+
+def test_disabled_jit_call_passthrough():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    telemetry.set_enabled(False)
+    out = telemetry.jit_call("t.off_site", f, jnp.ones(2))
+    assert float(np.asarray(out)[0]) == 2.0
+    telemetry.set_enabled(True)
+    assert telemetry.RECOMPILES.value(site="t.off_site") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# accounting
+# ---------------------------------------------------------------------------
+
+def test_recompile_counter_fires_exactly_once_for_same_shape():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x * 2)
+    for _ in range(5):
+        telemetry.jit_call("t.same_shape", f, jnp.ones((3,)))
+    assert telemetry.RECOMPILES.value(site="t.same_shape") == 1.0
+    assert telemetry.COMPILE_SECONDS.value(site="t.same_shape") > 0.0
+    # a new shape is a real recompile and must be counted
+    telemetry.jit_call("t.same_shape", f, jnp.ones((4,)))
+    assert telemetry.RECOMPILES.value(site="t.same_shape") == 2.0
+
+
+def test_executor_recompile_accounting():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(2, 3))
+    ex.arg_dict["data"][:] = np.ones((2, 3), np.float32)
+    for _ in range(3):
+        ex.forward(is_train=False)
+    assert telemetry.RECOMPILES.value(site="executor.forward") == 1.0
+
+
+def test_transfer_accounting_fetch_host_and_asnumpy():
+    from mxnet_tpu.base import fetch_host
+
+    arrs = [nd.ones((4, 4)), nd.ones((2,))]
+    out = fetch_host(arrs)
+    assert telemetry.TRANSFERS.value(path="fetch_host") == 1.0  # ONE batched
+    expect = sum(int(a.nbytes) for a in out)
+    assert telemetry.TRANSFER_BYTES.value(path="fetch_host") == expect
+
+    nd.ones((8, 8)).asnumpy()
+    assert telemetry.TRANSFERS.value(path="asnumpy") == 1.0
+    assert telemetry.TRANSFER_BYTES.value(path="asnumpy") == 8 * 8 * 4
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+def test_span_feeds_registry_and_profiler():
+    mx.profiler.set_state("run")
+    try:
+        with telemetry.span("t_region", category="t_cat"):
+            pass
+    finally:
+        mx.profiler.set_state("stop")
+    assert telemetry.spans.SPAN_MS.count(category="t_cat",
+                                         span="t_region") == 1
+    assert any(e["name"] == "t_region" and e["cat"] == "t_cat"
+               for e in mx.profiler._events)
+    mx.profiler._events.clear()
+
+
+def test_span_as_decorator():
+    calls = []
+
+    @telemetry.span("t_deco")
+    def work(x):
+        calls.append(x)
+        return x + 1
+
+    assert work(1) == 2
+    assert calls == [1]
+    assert telemetry.spans.SPAN_MS.count(category="span", span="t_deco") == 1
+
+
+def test_profiler_counter_bridged_to_gauge():
+    ctr = mx.profiler.Domain("t_dom").new_counter("t_ctr", 3)
+    ctr.increment(4)
+    assert telemetry.PROFILER_COUNTER.value(domain="t_dom",
+                                            counter="t_ctr") == 7.0
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def test_prometheus_output_format():
+    c = telemetry.counter("mxnet_t_prom_total", "counter help", labels=("k",))
+    c.inc(3, k='va"l\\ue')  # escaping-hostile label value
+    h = telemetry.histogram("mxnet_t_prom_ms", "hist help", labels=())
+    h.observe(1.0)
+    h.observe(2.0)
+    text = telemetry.render_prometheus()
+    assert "# HELP mxnet_t_prom_total counter help" in text
+    assert "# TYPE mxnet_t_prom_total counter" in text
+    assert 'mxnet_t_prom_total{k="va\\"l\\\\ue"} 3' in text
+    assert "# TYPE mxnet_t_prom_ms summary" in text
+    assert 'mxnet_t_prom_ms{quantile="0.5"}' in text
+    assert "mxnet_t_prom_ms_sum 3" in text
+    assert "mxnet_t_prom_ms_count 2" in text
+    # every sample line is NAME{labels} VALUE parseable
+    sample = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? -?[0-9.e+-]+$")
+    for line in text.strip().splitlines():
+        if not line.startswith("#"):
+            assert sample.match(line), line
+
+
+def test_snapshot_shape_and_json_round_trip():
+    telemetry.counter("mxnet_t_snap_total", labels=("k",)).inc(k="a")
+    snap = telemetry.snapshot()
+    assert snap["enabled"] is True
+    m = snap["metrics"]["mxnet_t_snap_total"]
+    assert m["type"] == "counter"
+    assert m["series"] == [{"labels": {"k": "a"}, "value": 1.0}]
+    json.dumps(snap)  # JSONL-emitter requirement: always serializable
+
+
+def test_emitter_appends_jsonl(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    telemetry.counter("mxnet_t_emit_total").inc()
+    em = telemetry.Emitter(60.0, path)
+    assert em.emit_once()
+    assert em.emit_once()
+    lines = open(path).read().strip().splitlines()
+    assert len(lines) == 2
+    doc = json.loads(lines[0])
+    assert "mxnet_t_emit_total" in doc["metrics"]
+
+
+def test_start_emitter_disabled_by_default():
+    assert telemetry.start_emitter() is None  # MXNET_TELEMETRY_EMIT_SECS=0
+
+
+def test_start_emitter_runs_and_stops(tmp_path):
+    path = str(tmp_path / "bg.jsonl")
+    em = telemetry.start_emitter(0.2, path)
+    try:
+        assert em is not None and em.is_alive()
+        assert telemetry.start_emitter(0.2, path) is em  # idempotent
+    finally:
+        telemetry.stop_emitter()
+    assert not em.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# acceptance smoke: serving + training publish >= 15 distinct series
+# ---------------------------------------------------------------------------
+
+def test_serving_plus_training_smoke_series():
+    from mxnet_tpu import gluon, serving
+
+    # training: one executor fwd/bwd (recompile + span series)
+    data = mx.sym.var("data")
+    net_s = mx.sym.FullyConnected(data=data, num_hidden=4, name="fct")
+    ex = net_s.simple_bind(mx.cpu(), data=(2, 3))
+    ex.arg_dict["data"][:] = np.ones((2, 3), np.float32)
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.outputs[0].asnumpy()
+
+    # serving: tiny MLP behind a Server (request/latency/bucket series)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(2))
+    net.initialize()
+    net(nd.array(np.zeros((1, 4), np.float32)))
+    srv = serving.serve_block(net, sample_shape=(4,), buckets=(1, 4),
+                              max_delay_ms=1.0, name="t_smoke")
+    try:
+        srv.warmup()
+        futs = [srv.submit(np.random.rand(4).astype(np.float32))
+                for _ in range(8)]
+        for f in futs:
+            f.result(timeout=30)
+        stats = srv.stats()
+    finally:
+        srv.close()
+    assert stats["steady_state_recompiles"] == 0
+    assert telemetry.STEADY_STATE_RECOMPILES.value(
+        site="serving.t_smoke") == 0.0
+
+    text = telemetry.render_prometheus()
+    samples = [l for l in text.splitlines() if l and not l.startswith("#")]
+    assert len(samples) >= 15, text
+    for required in ("mxnet_recompiles_total",
+                     "mxnet_host_transfer_bytes_total",
+                     "mxnet_serving_latency_ms"):
+        assert any(s.startswith(required) for s in samples), required
+    # serving latency exports the p50/p99 summary the criteria name
+    assert any('quantile="0.5"' in s for s in samples
+               if s.startswith("mxnet_serving_latency_ms"))
+    assert any('quantile="0.99"' in s for s in samples
+               if s.startswith("mxnet_serving_latency_ms"))
