@@ -20,6 +20,19 @@ import time
 
 QUICK = os.environ.get("BENCH_QUICK", "") not in ("", "0")
 SERVING = os.environ.get("BENCH_SERVING", "") not in ("", "0")
+# BENCH_CHAOS=1: run the bench under injected faults (MXNET_CHAOS spec, or
+# a default mild schedule) — proves the resilience layer holds the numbers
+# up under transient failures, and stamps fault/retry counters on the line
+CHAOS = os.environ.get("BENCH_CHAOS", "") not in ("", "0")
+# p=0.2 because the fused-step protocol performs only ~a dozen accounted
+# transfers per run (one barrier fetch per timed phase): a mild rate would
+# usually inject nothing and "prove" resilience vacuously
+_DEFAULT_CHAOS = "seed=7,site=transfer.*,p=0.2"
+# serving mode scopes faults to the engine site: the sequential BASELINE
+# loop drives the engine raw (that is the point of the baseline — no
+# server, no policy), so faults outside the server's retry boundary would
+# measure the baseline's fragility, not the server's resilience
+_DEFAULT_CHAOS_SERVING = "seed=7,site=serving.engine,p=0.1"
 
 TRAIN_BASELINE = 298.51   # V100 ResNet-50 train bs=32 fp32, perf.md:214
 INFER_BASELINE = 1076.81  # V100 ResNet-50 infer bs=32 fp32, perf.md:156
@@ -37,7 +50,30 @@ def _attach_telemetry(out):
         out["telemetry"] = telemetry.snapshot()
     except Exception:  # noqa: BLE001 - emit must survive a broken import
         pass
+    try:
+        from mxnet_tpu import resilience
+        from mxnet_tpu.resilience import chaos
+
+        if chaos.ENABLED:
+            # fault/retry/breaker accounting rides every line of a chaos
+            # run (success, error AND watchdog paths): the evidence that
+            # the number was earned under faults, not around them
+            out["chaos"] = resilience.snapshot()
+    except Exception:  # noqa: BLE001 - emit must survive a broken import
+        pass
     return out
+
+
+def _maybe_enable_chaos():
+    """BENCH_CHAOS=1: activate the MXNET_CHAOS spec (already live if the
+    env var was set — chaos reads it at import) or the default schedule."""
+    if not CHAOS:
+        return
+    from mxnet_tpu.resilience import chaos
+
+    if not chaos.ENABLED:
+        chaos.configure(_DEFAULT_CHAOS_SERVING if SERVING
+                        else _DEFAULT_CHAOS)
 
 
 def _acquire_backend(timeout_s=120.0, retries=2):
@@ -113,11 +149,12 @@ def _time_iters(run_one, budget_s=30.0, max_iters=20):
         # relay platform (measured: returns immediately with work still
         # queued); a tiny device->host fetch is. Fetch one element so the
         # transfer itself stays off the timed path's critical bandwidth.
-        import jax
-        import numpy as np
+        # Routed through base.fetch_host: the one accounted (and, under
+        # BENCH_CHAOS, fault-injected + retried) device->host path.
+        from mxnet_tpu.base import fetch_host
         arr = out._data
         arr.block_until_ready()
-        np.asarray(jax.device_get(arr if arr.ndim == 0 else arr.ravel()[0]))
+        fetch_host([arr if arr.ndim == 0 else arr.ravel()[0]])
 
     t0 = time.perf_counter()
     block(run_one())
@@ -272,6 +309,8 @@ def _serving_bench():
 
     from mxnet_tpu import gluon, nd, serving
 
+    _maybe_enable_chaos()
+
     if QUICK:
         sample, hidden, n_seq, n_req, clients = (64,), 256, 100, 400, 4
         net = gluon.nn.Sequential()
@@ -401,6 +440,8 @@ def main():
         import mxnet_tpu as mx
         from mxnet_tpu import gluon, nd, parallel
         from mxnet_tpu.gluon.model_zoo import vision
+
+        _maybe_enable_chaos()
 
         if QUICK:
             batch, side, classes = 4, 32, 10

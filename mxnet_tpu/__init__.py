@@ -57,6 +57,7 @@ for _m in (
     "image",
     "parallel",
     "sequence_parallel",
+    "resilience",
     "serving",
     "contrib",
     "test_utils",

@@ -78,10 +78,22 @@ def fetch_host(arrays, dtype=None) -> list:
     Every transfer through here is accounted in the telemetry registry
     (``mxnet_host_transfer_bytes_total{path="fetch_host"}``), so host-sync
     cost shows up on a scrape instead of only in a lint report.
+
+    The transfer itself runs under the resilience retry policy at chaos
+    site ``transfer.fetch_host``: a transient device->host failure (or an
+    injected fault) retries with backoff, and the re-fetch is idempotent —
+    ``device_get`` reads committed device buffers.
     """
     import jax
 
-    host = jax.device_get([getattr(a, "_data", a) for a in arrays])
+    data = [getattr(a, "_data", a) for a in arrays]
+    res = _resilience()
+
+    def attempt():
+        res.chaos.maybe_fail("transfer.fetch_host")
+        return jax.device_get(data)
+
+    host = res.call("transfer.fetch_host", attempt)
     if dtype is None:
         out = [np.asarray(h) for h in host]
     else:
@@ -101,6 +113,19 @@ def _telemetry():
         from . import telemetry
         _TELEMETRY = telemetry
     return _TELEMETRY
+
+
+_RESILIENCE = None
+
+
+def _resilience():
+    """The resilience package, resolved lazily for the same layering reason
+    as :func:`_telemetry` (base is the bottom of the import graph)."""
+    global _RESILIENCE
+    if _RESILIENCE is None:
+        from . import resilience
+        _RESILIENCE = resilience
+    return _RESILIENCE
 
 
 # ---------------------------------------------------------------------------

@@ -27,7 +27,9 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from . import resilience
 from .base import MXNetError
+from .resilience import chaos
 
 __all__ = ["CheckpointManager", "run_elastic", "start_heartbeat",
            "stop_heartbeat", "get_dead_nodes"]
@@ -116,6 +118,34 @@ def get_dead_nodes(timeout: float = 10.0) -> List[int]:
 # atomic checkpoints
 # ---------------------------------------------------------------------------
 
+def _fsync_file(path: str) -> None:
+    """Flush a written file's data to stable storage before it is renamed
+    into place (rename-then-crash must not expose torn contents)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    """Flush a directory entry (the rename itself) to stable storage.
+    Directory fds are a POSIX notion; where they can't be opened (or fsync
+    on them is rejected, e.g. some network filesystems) durability falls
+    back to the filesystem's own ordering."""
+    flags = os.O_RDONLY | getattr(os, "O_DIRECTORY", 0)
+    try:
+        fd = os.open(path, flags)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover - fs-dependent
+        pass
+    finally:
+        os.close(fd)
+
+
 class CheckpointManager(object):
     """Atomic, bounded-retention checkpoints for elastic resume.
 
@@ -157,9 +187,35 @@ class CheckpointManager(object):
 
     @staticmethod
     def _atomic_write(path: str, writer: Callable[[str], None]) -> None:
+        """tmp + fsync + rename + directory-fsync commit. The rename alone
+        (the previous implementation) is atomic against concurrent READERS
+        but not crash-durable: after a power loss the file system may
+        replay the rename before the tmp file's data blocks, leaving a
+        committed name with torn contents — exactly the state the manifest
+        protocol promises can't exist. fsync the data before the rename
+        and the directory entry after it, and the commit point is real.
+        A failed attempt always removes its tmp file (no stale partials
+        for a retry or a later save to trip over)."""
         tmp = path + ".tmp.%d" % os.getpid()
-        writer(tmp)
-        os.replace(tmp, path)
+        try:
+            writer(tmp)
+            _fsync_file(tmp)
+            chaos.maybe_fail("ckpt.commit")
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        _fsync_dir(os.path.dirname(path) or ".")
+
+    def _commit(self, path: str, writer: Callable[[str], None]) -> None:
+        """One durable file commit under the resilience retry policy: a
+        transient write failure (or injected ``ckpt.commit`` fault) retries
+        with backoff instead of losing the checkpoint."""
+        resilience.call("ckpt.commit",
+                        lambda: self._atomic_write(path, writer))
 
     # -- save/restore ------------------------------------------------------
     def save(self, epoch: int, net=None, trainer=None,
@@ -208,18 +264,18 @@ class CheckpointManager(object):
             def commit():
                 files = {}
                 if params_bytes is not None:
-                    self._atomic_write(
+                    self._commit(
                         self._params_path(epoch),
                         lambda p: open(p, "wb").write(params_bytes))
                     files["params"] = os.path.basename(self._params_path(epoch))
                 if states_bytes is not None:
-                    self._atomic_write(
+                    self._commit(
                         self._states_path(epoch),
                         lambda p: open(p, "wb").write(states_bytes))
                     files["states"] = os.path.basename(self._states_path(epoch))
                 manifest = {"epoch": epoch, "time": time.time(),
                             "files": files, "metadata": metadata or {}}
-                self._atomic_write(
+                self._commit(
                     self._manifest_path(epoch),
                     lambda p: open(p, "w").write(json.dumps(manifest)))
                 self._retire_old()
@@ -228,22 +284,22 @@ class CheckpointManager(object):
             return self._manifest_path(epoch)
         files = {}
         if net is not None:
-            self._atomic_write(self._params_path(epoch),
-                               lambda p: net.save_parameters(p))
+            self._commit(self._params_path(epoch),
+                         lambda p: net.save_parameters(p))
             files["params"] = os.path.basename(self._params_path(epoch))
         elif params is not None:
             from .ndarray import io_utils
 
-            self._atomic_write(self._params_path(epoch),
-                               lambda p: io_utils.save(p, params))
+            self._commit(self._params_path(epoch),
+                         lambda p: io_utils.save(p, params))
             files["params"] = os.path.basename(self._params_path(epoch))
         if trainer is not None:
-            self._atomic_write(self._states_path(epoch),
-                               lambda p: trainer.save_states(p))
+            self._commit(self._states_path(epoch),
+                         lambda p: trainer.save_states(p))
             files["states"] = os.path.basename(self._states_path(epoch))
         manifest = {"epoch": epoch, "time": time.time(), "files": files,
                     "metadata": metadata or {}}
-        self._atomic_write(
+        self._commit(
             self._manifest_path(epoch),
             lambda p: open(p, "w").write(json.dumps(manifest)))
         self._retire_old()
@@ -312,7 +368,8 @@ class CheckpointManager(object):
 
 def run_elastic(train_fn: Callable[[int, CheckpointManager], object],
                 manager: CheckpointManager, max_restarts: int = 3,
-                restart_delay: float = 0.0):
+                restart_delay: float = 1.0, restart_backoff: float = 2.0,
+                max_restart_delay: float = 60.0):
     """Run ``train_fn(start_epoch, manager)`` with automatic resume.
 
     On an exception the function is restarted from
@@ -320,7 +377,15 @@ def run_elastic(train_fn: Callable[[int, CheckpointManager], object],
     checkpoint — up to ``max_restarts`` times; the final failure is
     re-raised. This is the reference's restarted-worker recovery
     (``is_recovery``, kvstore_dist.h:52) for a checkpoint-based world.
+
+    Restart ``n`` waits ``restart_delay * restart_backoff**(n-1)`` seconds
+    (capped at ``max_restart_delay``): a deterministic early-crash (bad
+    config, poisoned shard) backs off instead of spinning a tight
+    crash-restart loop that hammers the checkpoint directory and floods
+    logs. ``restart_delay=0`` disables the wait (tests). Each restart
+    ticks ``mxnet_retries_total{site="elastic.restart",outcome="retry"}``.
     """
+    restarts = resilience.policies.retries_counter()
     attempt = 0
     while True:
         start_epoch = manager.latest_epoch() + 1
@@ -331,9 +396,13 @@ def run_elastic(train_fn: Callable[[int, CheckpointManager], object],
         except Exception as exc:  # noqa: BLE001 - the point of the harness
             attempt += 1
             if attempt > max_restarts:
+                restarts.inc(site="elastic.restart", outcome="exhausted")
                 raise
-            _LOG.warning("train_fn failed (%s); restart %d/%d from epoch %d",
-                         exc, attempt, max_restarts,
-                         manager.latest_epoch() + 1)
-            if restart_delay:
-                time.sleep(restart_delay)
+            restarts.inc(site="elastic.restart", outcome="retry")
+            delay = min(restart_delay * (restart_backoff ** (attempt - 1)),
+                        max_restart_delay) if restart_delay else 0.0
+            _LOG.warning("train_fn failed (%s); restart %d/%d from epoch %d "
+                         "in %.1fs", exc, attempt, max_restarts,
+                         manager.latest_epoch() + 1, delay)
+            if delay:
+                time.sleep(delay)

@@ -2,16 +2,23 @@
 ``python/mxnet/gluon/model_zoo/model_store.py``).
 
 The reference downloads ``{name}-{sha1[:8]}.params`` into
-``~/.mxnet/models`` and verifies the digest before loading. This
-environment has no network egress, so the DOWNLOAD step is out of scope —
-the rest of the contract (cache location, file naming, sha1 verification,
-purge) is implemented so locally-provisioned zoo artifacts load exactly
-like the reference's:
+``~/.mxnet/models`` and verifies the digest before loading. The cache
+contract (location, file naming, sha1 verification, purge) is implemented
+so locally-provisioned zoo artifacts load exactly like the reference's:
 
     mx.gluon.model_zoo.vision.resnet18_v1(pretrained=True, root=dir)
 
 finds ``resnet18_v1-<hash>.params`` (or plain ``resnet18_v1.params``) in
 ``root``, verifies the embedded short hash when present, and loads it.
+
+Fetching is resilient and *atomic*: :func:`download` streams to a ``.part``
+temp file, verifies the sha1 BEFORE committing into the cache with an
+``os.replace``, and retries partial/corrupt fetches with backoff under the
+resilience policy (site ``zoo.download``) — a stale partial file can never
+poison the cache directory, where previously any interrupted write left a
+``.params`` path that every later lookup tripped over. The default
+``urllib`` fetcher needs egress (unavailable in this environment); mirrors
+and tests supply their own ``fetcher``.
 """
 from __future__ import annotations
 
@@ -19,9 +26,11 @@ import glob
 import hashlib
 import os
 
+from ... import resilience
 from ...base import MXNetError
+from ...resilience import TransientError, chaos
 
-__all__ = ["get_model_file", "purge"]
+__all__ = ["get_model_file", "download", "purge"]
 
 _DEFAULT_ROOT = os.path.join("~", ".mxnet", "models")
 
@@ -34,13 +43,77 @@ def _sha1(path: str) -> str:
     return h.hexdigest()
 
 
-def get_model_file(name: str, root: str = _DEFAULT_ROOT) -> str:
+def _urllib_fetcher(url: str, dest: str) -> None:
+    """Default fetcher: stream ``url`` into ``dest``. Network failures are
+    re-raised as :class:`TransientError` so the retry policy engages."""
+    import http.client
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url) as r, open(dest, "wb") as f:
+            while True:
+                chunk = r.read(1 << 20)
+                if not chunk:
+                    break
+                f.write(chunk)
+    except (urllib.error.URLError, http.client.HTTPException) as exc:
+        # HTTPException covers mid-body disconnects (IncompleteRead,
+        # RemoteDisconnected) that URLError does not
+        raise TransientError("fetch of %r failed: %s" % (url, exc))
+
+
+def download(url: str, path: str, sha1_hash: str = None,
+             fetcher=None) -> str:
+    """Fetch ``url`` into ``path`` atomically, digest-verified, with retry.
+
+    The fetch writes ``path + ".part.<pid>"``; when ``sha1_hash`` is given
+    the temp file's sha1 must START WITH it (the reference's short-hash
+    convention) or the attempt counts as a transient failure — truncated
+    and corrupted transfers retry with backoff instead of landing in the
+    cache. Only a fully verified file is ``os.replace``d into ``path``.
+    ``fetcher(url, dest)`` overrides the urllib default (mirrors, tests,
+    zero-egress environments).
+    """
+    import threading
+
+    fetch = fetcher or _urllib_fetcher
+    # pid AND thread id: two threads lazily fetching the same model must
+    # not share a temp file (one would truncate it between the other's
+    # sha1 check and its os.replace — committing torn bytes as verified)
+    tmp = path + ".part.%d.%d" % (os.getpid(), threading.get_ident())
+
+    def attempt():
+        chaos.maybe_fail("zoo.download")
+        try:
+            fetch(url, tmp)
+            if sha1_hash and not _sha1(tmp).startswith(sha1_hash.lower()):
+                raise TransientError(
+                    "downloaded file %r does not match sha1 %r (partial or "
+                    "corrupt fetch)" % (url, sha1_hash))
+        except BaseException:
+            # never leave a partial file behind for a later lookup to trust
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        os.replace(tmp, path)
+        return path
+
+    return resilience.call("zoo.download", attempt)
+
+
+def get_model_file(name: str, root: str = _DEFAULT_ROOT, url: str = None,
+                   sha1_hash: str = None, fetcher=None) -> str:
     """Locate (and verify) a pretrained parameter file in the local cache.
 
     Accepts the reference's ``{name}-{short_hash}.params`` naming (the
     short hash is checked against the file's sha1) or a plain
-    ``{name}.params``. Raises with provisioning instructions when absent —
-    this build performs no downloads (zero-egress environment).
+    ``{name}.params``. On a cache miss with ``url`` given, the file is
+    fetched through :func:`download` (sha1-verified, atomic, retried);
+    without a ``url`` it raises with provisioning instructions — the
+    default build performs no downloads (zero-egress environment).
     """
     root = os.path.expanduser(root)
     plain = os.path.join(root, name + ".params")
@@ -52,6 +125,11 @@ def get_model_file(name: str, root: str = _DEFAULT_ROOT) -> str:
         if _sha1(cand).startswith(short.lower()):
             return cand
         corrupt.append(cand)  # keep scanning: a valid sibling may exist
+    if url:
+        os.makedirs(root, exist_ok=True)
+        target = plain if not sha1_hash else os.path.join(
+            root, "%s-%s.params" % (name, sha1_hash[:8].lower()))
+        return download(url, target, sha1_hash=sha1_hash, fetcher=fetcher)
     if corrupt:
         raise MXNetError(
             "pretrained file(s) %s corrupted (sha1 does not start with the "
@@ -59,8 +137,8 @@ def get_model_file(name: str, root: str = _DEFAULT_ROOT) -> str:
     raise MXNetError(
         "no pretrained weights for %r in %s and this build performs no "
         "downloads; provision %s.params (e.g. converted from the reference "
-        "zoo with net.save_parameters) into that directory"
-        % (name, root, name))
+        "zoo with net.save_parameters) into that directory, or pass a "
+        "url= to fetch from a mirror" % (name, root, name))
 
 
 def purge(root: str = _DEFAULT_ROOT) -> None:

@@ -352,5 +352,5 @@ class MPImageRecordIter(DataIter):
     def __del__(self):  # best-effort
         try:
             self.close()
-        except Exception:  # noqa: BLE001 - interpreter teardown
-            pass
+        except Exception:  # tpulint: disable=swallowed-error
+            pass  # noqa: BLE001 - interpreter teardown

@@ -9,16 +9,33 @@ paths live in gluon.data / image.
 from __future__ import annotations
 
 import os
+import queue as queue_mod
 import threading
 from collections import namedtuple
 
 import numpy as np
 
-from . import telemetry
+from . import resilience, telemetry
 from .base import MXNetError
 from .context import cpu
 from .ndarray import ndarray as nd_mod
 from .ndarray.ndarray import NDArray
+from .resilience import TransientError, chaos
+
+_IO_RETRY = None
+
+
+def _io_policy():
+    """Prefetch retry policy. Retries ONLY explicit :class:`TransientError`
+    (chaos faults — injected before the fetch advances anything — and
+    iterators that raise it to mark a failure retry-safe): re-invoking
+    ``next()`` on an iterator whose cursor already moved is NOT idempotent,
+    so a broad retry would silently skip the faulted sample. Raw OSErrors
+    and the like propagate to the consumer instead."""
+    global _IO_RETRY
+    if _IO_RETRY is None:
+        _IO_RETRY = resilience.RetryPolicy(retry_on=(TransientError,))
+    return _IO_RETRY
 
 # pipeline health: batches staged ahead of the consumer, per pipeline kind —
 # a stalled producer shows up as this counter flatlining while the step
@@ -272,7 +289,14 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Threaded prefetch over one or more iterators (reference io.py:349;
-    the Python-side analogue of the C++ prefetcher iter_prefetcher.h)."""
+    the Python-side analogue of the C++ prefetcher iter_prefetcher.h).
+
+    Worker failure contract: a transient fault in the underlying iterator
+    (chaos site ``io.prefetch``) retries under the resilience policy;
+    a terminal exception is captured and re-raised to the CONSUMER at the
+    next ``__next__`` — never swallowed (which used to truncate the epoch
+    silently) and never left to kill the worker thread (which used to
+    block the consumer forever on ``data_ready``)."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
@@ -291,6 +315,15 @@ class PrefetchingIter(DataIter):
         self.started = True
         self.current_batch = [None for _ in range(self.n_iter)]
         self.next_batch = [None for _ in range(self.n_iter)]
+        self._errors = [None for _ in range(self.n_iter)]
+        self._failed = False
+
+        def fetch_one(i):
+            def attempt():
+                chaos.maybe_fail("io.prefetch")
+                return self.iters[i].next()
+
+            return _io_policy().call(attempt, site="io.prefetch")
 
         def prefetch_func(self, i):
             while True:
@@ -298,9 +331,12 @@ class PrefetchingIter(DataIter):
                 if not self.started:
                     break
                 try:
-                    self.next_batch[i] = self.iters[i].next()
+                    self.next_batch[i] = fetch_one(i)
                 except StopIteration:
                     self.next_batch[i] = None
+                except Exception as exc:  # noqa: BLE001 - delivered at next()
+                    self.next_batch[i] = None
+                    self._errors[i] = exc
                 if self.next_batch[i] is not None:
                     _T_PREFETCH.inc(pipeline="PrefetchingIter")
                 self.data_taken[i].clear()
@@ -343,14 +379,26 @@ class PrefetchingIter(DataIter):
             e.wait()
         for i in self.iters:
             i.reset()
+        self._errors = [None for _ in range(self.n_iter)]
+        self._failed = False
         for e in self.data_ready:
             e.clear()
         for e in self.data_taken:
             e.set()
 
     def iter_next(self):
+        if self._failed:
+            return False
         for e in self.data_ready:
             e.wait()
+        errors = [e for e in self._errors if e is not None]
+        if errors:
+            # terminal worker failure: surface it on the consumer thread.
+            # The stream then reads as ended (until reset()), so a consumer
+            # that keeps iterating sees end-of-epoch instead of a hang.
+            self._errors = [None for _ in range(self.n_iter)]
+            self._failed = True
+            raise errors[0]
         if self.next_batch[0] is None:
             for i in self.next_batch:
                 assert i is None, "Number of entry mismatches between iterators"
@@ -659,17 +707,15 @@ class DevicePrefetchIter(DataIter):
 
     def __init__(self, base_iter, ctx=None, depth=2):
         super().__init__(base_iter.batch_size)
-        import queue
-        import threading as _threading
-
         from .context import current_context
 
         self.base = base_iter
         self.ctx = ctx or current_context()
         self._depth = max(1, depth)
-        self._queue = queue.Queue(maxsize=self._depth)
+        self._queue = queue_mod.Queue(maxsize=self._depth)
         self._sentinel = object()
         self._thread = None
+        self._done = False
         self._start()
 
     @property
@@ -698,9 +744,21 @@ class DevicePrefetchIter(DataIter):
     def _start(self):
         import threading as _threading
 
+        def fetch(it):
+            def attempt():
+                chaos.maybe_fail("io.prefetch")
+                return next(it)
+
+            return _io_policy().call(attempt, site="io.prefetch")
+
         def worker():
+            it = iter(self.base)
             try:
-                for batch in self.base:
+                while True:
+                    try:
+                        batch = fetch(it)
+                    except StopIteration:
+                        break
                     self._queue.put(self._stage(batch))
                     _T_PREFETCH.inc(pipeline="DevicePrefetchIter")
             except Exception as exc:  # noqa: BLE001 - delivered at next()
@@ -717,18 +775,25 @@ class DevicePrefetchIter(DataIter):
         while self._thread is not None and self._thread.is_alive():
             try:
                 self._queue.get(timeout=0.1)
-            except Exception:  # noqa: BLE001 - queue.Empty
+            except queue_mod.Empty:
                 continue
         while not self._queue.empty():
             self._queue.get_nowait()
         self.base.reset()
+        self._done = False
         self._start()
 
     def next(self):
+        # a finished or failed stream stays finished (until reset()):
+        # re-polling the queue after the worker exited would hang forever
+        if self._done:
+            raise StopIteration
         item = self._queue.get()
         if item is self._sentinel:
+            self._done = True
             raise StopIteration
         if isinstance(item, Exception):
+            self._done = True
             raise item
         return item
 

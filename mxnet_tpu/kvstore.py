@@ -35,9 +35,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import optimizer as opt
+from . import resilience
 from . import telemetry
 from .base import MXNetError, get_env
 from .ndarray.ndarray import NDArray
+from .resilience import chaos
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreTPU", "create"]
 
@@ -111,8 +113,19 @@ class KVStore(object):
                 if k not in self._store:
                     raise MXNetError("key %s has not been initialized" % k)
                 vals = v if isinstance(v, (list, tuple)) else [v]
-                agg = self._reduce([x._data for x in vals])
-                agg = self._to_store_sharding(agg, self._store[k]._data)
+
+                # the aggregate phase (collective/transfer work) is where
+                # transient faults live; it is pure over the inputs, so the
+                # retry policy re-runs it transparently. Commit below
+                # (compression residuals, updater, store write) mutates
+                # state and is deliberately OUTSIDE the retry.
+                def attempt(_vals=vals, _k=k):
+                    chaos.maybe_fail("kvstore.push")
+                    agg = self._reduce([x._data for x in _vals])
+                    return self._to_store_sharding(agg,
+                                                   self._store[_k]._data)
+
+                agg = resilience.call("kvstore.push", attempt)
                 if self._compression is not None:
                     agg = self._compression.compress(k, agg)
                 if self._updater is not None:
@@ -131,8 +144,14 @@ class KVStore(object):
                 if k not in self._store:
                     raise MXNetError("key %s has not been initialized" % k)
                 outs = o if isinstance(o, (list, tuple)) else [o]
+
+                def attempt(_k=k):
+                    chaos.maybe_fail("kvstore.pull")
+                    return self._store[_k]._data
+
+                data = resilience.call("kvstore.pull", attempt)
                 for dst in outs:
-                    dst._data = self._store[k]._data
+                    dst._data = data
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull selected rows (reference kvstore.py:314). XLA has no sparse
@@ -271,14 +290,22 @@ class KVStoreTPU(KVStore):
                 "dist_async with a server-side updater is single-process "
                 "only on this runtime; use dist_sync for multi-host "
                 "training (fused allreduce over ICI/DCN)")
-        _T_OPS.inc(op="push")
+        _T_OPS.inc(op="push_async")
         with telemetry.span("kvstore.push_async", "kvstore"):
             for k, v in _key_value_pairs(key, value):
                 if k not in self._store:
                     raise MXNetError("key %s has not been initialized" % k)
                 vals = v if isinstance(v, (list, tuple)) else [v]
                 for x in vals:
-                    g = self._to_store_sharding(x._data, self._store[k]._data)
+                    # only the pure placement transform retries; the
+                    # updater below steps the optimizer (a mutation) and
+                    # must apply exactly once per gradient copy
+                    def attempt(_x=x, _k=k):
+                        chaos.maybe_fail("kvstore.push")
+                        return self._to_store_sharding(
+                            _x._data, self._store[_k]._data)
+
+                    g = resilience.call("kvstore.push", attempt)
                     if self._compression is not None:
                         g = self._compression.compress(k, g)
                     self._updater(int(k) if k.isdigit() else k,
@@ -344,8 +371,16 @@ class KVStoreTPU(KVStore):
                 if kk not in self._store:
                     raise MXNetError("key %s has not been initialized" % kk)
                 norm.append((kk, v if isinstance(v, (list, tuple)) else [v]))
-            totals = parallel.all_reduce_multi([[x._data for x in v]
-                                                for _, v in norm])
+
+            # the fused allreduce is the collective phase: pure over the
+            # gradient copies, so a transient ICI/DCN fault (or injected
+            # chaos) re-runs it; store/out commits follow outside the retry
+            def attempt():
+                chaos.maybe_fail("kvstore.pushpull")
+                return parallel.all_reduce_multi([[x._data for x in v]
+                                                  for _, v in norm])
+
+            totals = resilience.call("kvstore.pushpull", attempt)
             for (kk, _), total, o in zip(norm, totals, out_lists):
                 self._store[kk]._data = self._to_store_sharding(
                     total, self._store[kk]._data)

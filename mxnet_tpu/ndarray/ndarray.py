@@ -91,7 +91,19 @@ class NDArray:
     # conversion / sync
     # ------------------------------------------------------------------
     def asnumpy(self) -> np.ndarray:
-        out = np.asarray(self._data)
+        # chaos site transfer.asnumpy: under an active schedule the
+        # device->host copy can fault and retries under the policy (the
+        # copy reads committed buffers, so a re-run is identical); with
+        # chaos off this is one module-global boolean — asnumpy is far too
+        # hot for anything more
+        if _resilience.chaos.ENABLED:
+            def attempt():
+                _resilience.chaos.maybe_fail("transfer.asnumpy")
+                return np.asarray(self._data)
+
+            out = _resilience.call("transfer.asnumpy", attempt)
+        else:
+            out = np.asarray(self._data)
         # host-sync accounting: asnumpy is THE implicit device->host sync
         # tpulint can only flag statically; the telemetry counter measures
         # how much of it a run actually does (free when telemetry is off)
@@ -527,6 +539,7 @@ class NDArray:
 
 from .. import profiler as _profiler
 from .. import engine as _engine
+from .. import resilience as _resilience
 from .. import telemetry as _telemetry
 
 

@@ -134,8 +134,14 @@ def _stop_jax_trace():
             import jax
 
             jax.profiler.stop_trace()
-        except Exception:
-            pass
+        except Exception as exc:  # noqa: BLE001 - stop is best-effort
+            # a failed stop loses the device timeline but must not take
+            # the run down with it; say so instead of hiding it
+            import logging
+
+            logging.getLogger("mxnet_tpu.profiler").warning(
+                "jax.profiler.stop_trace() failed: %s (device trace for "
+                "this session may be missing or truncated)", exc)
         _jax_trace_active = False
 
 

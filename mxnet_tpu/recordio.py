@@ -83,8 +83,8 @@ class MXRecordIO(object):
     def __del__(self):
         try:
             self.close()
-        except Exception:  # noqa: BLE001 - never raise from a destructor
-            pass
+        except Exception:  # tpulint: disable=swallowed-error
+            pass  # noqa: BLE001 - never raise from a destructor
 
     def __getstate__(self):
         d = dict(self.__dict__)

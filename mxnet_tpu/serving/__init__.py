@@ -15,7 +15,9 @@ Pieces
   loaded ``aot`` StableHLO artifact (:class:`StableHLOEngine`);
 * :mod:`~mxnet_tpu.serving.batcher`  — :class:`Server`: bounded submit
   queue, deadline-driven micro-batcher, load shedding, per-request
-  timeout, error isolation, graceful drain;
+  timeout, error isolation, graceful drain — plus engine-level
+  resilience (retry under the ``mxnet_tpu.resilience`` policy, a circuit
+  breaker per engine, AOT→Block fallback, engine load-shed);
 * :mod:`~mxnet_tpu.serving.stats`    — counters + latency reservoir
   behind ``Server.stats()``, bridged to ``profiler`` Counters/Markers.
 
@@ -35,8 +37,9 @@ registry lives in ``docs/env_var.md`` and ``docs/serving.md``.
 """
 from __future__ import annotations
 
-from .batcher import (QueueFullError, RequestTimeoutError, Server,
-                      ServerClosedError, ServingError)
+from .batcher import (EngineUnavailableError, QueueFullError,
+                      RequestTimeoutError, Server, ServerClosedError,
+                      ServingError)
 from .buckets import bucket_ladder, pad_to_bucket, select_bucket
 from .engine import BlockEngine, Engine, StableHLOEngine
 from .stats import ServingStats
@@ -44,7 +47,7 @@ from .stats import ServingStats
 __all__ = [
     "Engine", "BlockEngine", "StableHLOEngine",
     "Server", "ServingError", "QueueFullError", "RequestTimeoutError",
-    "ServerClosedError",
+    "ServerClosedError", "EngineUnavailableError",
     "ServingStats",
     "bucket_ladder", "select_bucket", "pad_to_bucket",
     "serve_block", "serve_stablehlo",
@@ -63,13 +66,18 @@ def serve_block(block, sample_shape, dtype="float32", **kwargs) -> Server:
                   dtype=dtype, **kwargs)
 
 
-def serve_stablehlo(out_dir: str, **kwargs) -> Server:
+def serve_stablehlo(out_dir: str, fallback_block=None, **kwargs) -> Server:
     """Serve a loaded ``aot.export_model`` artifact.
 
     Reads ``manifest.json`` for the sample shape/dtype. Artifacts exported
     with ``poly_batch=True`` serve every bucket from one serialization;
     fixed-shape artifacts serve only the bucket equal to their exported
     batch size (pass ``buckets=[that_size]``).
+
+    ``fallback_block`` (a live initialized Gluon block) arms degraded
+    mode: if the artifact engine's circuit breaker trips, traffic falls
+    to a :class:`BlockEngine` over that block — the AOT→Block fallback
+    chain — before the server load-sheds.
     """
     import json
     import os
@@ -82,5 +90,7 @@ def serve_stablehlo(out_dir: str, **kwargs) -> Server:
         # a fixed-shape artifact runs exactly one batch size: serve it as
         # the single bucket instead of failing every other rung
         kwargs["buckets"] = [int(manifest["input_shape"][0])]
+    if fallback_block is not None and kwargs.get("fallback_engine") is None:
+        kwargs["fallback_engine"] = BlockEngine(fallback_block, dtype=dtype)
     return Server(StableHLOEngine(out_dir), sample_shape, dtype=dtype,
                   **kwargs)

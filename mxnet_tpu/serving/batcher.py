@@ -19,9 +19,30 @@ Robustness policy, in the order a request meets it:
   deadline (``MXNET_SERVING_TIMEOUT_MS``) fail with
   :class:`RequestTimeoutError` at batch-assembly time instead of wasting
   a bucket slot on an answer nobody is waiting for;
-* **error isolation** — if the engine raises on a batch, the batcher
-  re-runs each member alone: only the poisoned request(s) receive the
-  exception, innocent bystanders still get answers;
+* **engine retry** — each engine run is the ``serving.engine`` chaos site
+  and executes under the resilience retry policy: a transient fault (real
+  or injected) re-runs the same padded batch against a warm jit cache
+  instead of failing user requests;
+* **breaker + fallback** — every engine carries a
+  :class:`~mxnet_tpu.resilience.CircuitBreaker`
+  (site ``serving.<name>.<role>``, role ``primary``/``fallback``); when
+  the primary exhausts its retries
+  the batch falls to the next engine in the chain (``fallback_engine`` —
+  canonically a :class:`BlockEngine` behind a
+  :class:`StableHLOEngine`), and an open breaker skips its engine
+  entirely until the reset timeout admits a half-open probe;
+* **engine load-shed** — with every breaker open the batch fails fast
+  with :class:`EngineUnavailableError` (an explicit answer, not a hang),
+  counted in ``stats()['unavailable']``;
+* **error isolation** — if a non-transient error poisons a batch, the
+  batcher re-runs each member alone: only the poisoned request(s) receive
+  the exception, innocent bystanders still get answers. Request-caused
+  failures do count toward the engine's breaker (the engine layer cannot
+  tell a poisoned input from a sick engine), but any successful serve
+  resets the consecutive-failure count — so isolated poison fails only
+  itself, while an unbroken FLOOD of poison (``breaker_threshold``
+  consecutive failures, no success in between) deliberately trips the
+  breaker and sheds: at that point the traffic is the fault;
 * **graceful drain** — ``close()`` stops intake, serves everything queued,
   then joins the batcher thread; ``close(drain=False)`` fails queued
   requests with :class:`ServerClosedError` immediately.
@@ -36,14 +57,15 @@ from typing import Deque, List, Optional, Sequence
 
 import numpy as np
 
-from .. import telemetry
+from .. import resilience, telemetry
 from ..base import MXNetError, get_env, np_dtype
+from ..resilience import CircuitBreaker, chaos
 from .buckets import bucket_ladder, pad_to_bucket, select_bucket
 from .engine import Engine
 from .stats import ServingStats
 
 __all__ = ["Server", "ServingError", "QueueFullError", "RequestTimeoutError",
-           "ServerClosedError"]
+           "ServerClosedError", "EngineUnavailableError"]
 
 _DEFAULT_MAX_DELAY_MS = 2.0
 _DEFAULT_QUEUE_DEPTH = 256
@@ -66,6 +88,12 @@ class ServerClosedError(ServingError):
     """Submitted to (or still queued in) a closed server."""
 
 
+class EngineUnavailableError(ServingError):
+    """Every engine's circuit breaker is open: the request is shed at the
+    engine layer (explicit fast failure instead of queueing work no engine
+    will run)."""
+
+
 class _Request:
     __slots__ = ("data", "future", "t_submit", "deadline")
 
@@ -76,6 +104,18 @@ class _Request:
         self.deadline = deadline
 
 
+class _EngineSlot:
+    """One engine in the serve chain: the engine, its circuit breaker and
+    the name both report under."""
+
+    __slots__ = ("name", "engine", "breaker")
+
+    def __init__(self, name: str, engine: Engine, breaker: CircuitBreaker):
+        self.name = name
+        self.engine = engine
+        self.breaker = breaker
+
+
 class Server:
     """Thread-safe dynamic-batching inference service over one Engine.
 
@@ -83,13 +123,30 @@ class Server:
     given explicitly; ``sample_shape`` is the per-request shape without the
     batch axis. Results delivered through futures are views into the
     batched output array (zero-copy); copy before mutating.
+
+    ``name`` must be unique among live servers in the process: serving
+    stats series and the per-engine breaker gauge
+    (``serving.<name>.<role>``) key on it, and a second server reusing a
+    name writes over the first one's series.
+
+    ``fallback_engine`` extends the serve chain for degraded mode (the
+    canonical pairing: a StableHLO artifact primary with the live
+    BlockEngine behind it); each engine gets its own circuit breaker
+    (``breaker_threshold`` consecutive batch failures open it,
+    ``breaker_reset_s`` later a half-open probe may close it — defaults
+    from ``MXNET_RESILIENCE_BREAKER_*``). ``retry_policy`` overrides the
+    shared resilience policy for engine runs.
     """
 
     def __init__(self, engine: Engine, sample_shape: Sequence[int],
                  dtype="float32", buckets: Optional[Sequence[int]] = None,
                  max_delay_ms: Optional[float] = None,
                  queue_depth: Optional[int] = None,
-                 timeout_ms: Optional[float] = None, name: str = "serving"):
+                 timeout_ms: Optional[float] = None, name: str = "serving",
+                 fallback_engine: Optional[Engine] = None,
+                 retry_policy: Optional["resilience.RetryPolicy"] = None,
+                 breaker_threshold: Optional[int] = None,
+                 breaker_reset_s: Optional[float] = None):
         self._engine = engine
         self._sample_shape = tuple(int(d) for d in sample_shape)
         self._dtype = np.dtype(np_dtype(dtype))
@@ -108,6 +165,16 @@ class Server:
         self._timeout_s = float(timeout_ms) / 1e3
         self._stats = ServingStats(name)
         self._name = name
+        self._retry = retry_policy
+        engines = [("primary", engine)]
+        if fallback_engine is not None:
+            engines.append(("fallback", fallback_engine))
+        self._slots = [
+            _EngineSlot(role, eng, CircuitBreaker(
+                "serving.%s.%s" % (name, role),
+                failure_threshold=breaker_threshold,
+                reset_timeout_s=breaker_reset_s))
+            for role, eng in engines]
         self._warm_compiles: Optional[int] = None
         self._queue: Deque[_Request] = collections.deque()
         self._cv = threading.Condition()
@@ -158,11 +225,14 @@ class Server:
 
     def warmup(self) -> int:
         """Run one dummy batch per bucket so every rung's executable is
-        compiled before traffic arrives; returns the engine compile count.
+        compiled before traffic arrives — on EVERY engine in the chain, so
+        a breaker trip degrades onto a warm fallback instead of paying its
+        compiles under duress; returns the primary engine compile count.
         After warmup, a steady-state serve performs zero compiles."""
-        for b in self._ladder:
-            self._engine.run(np.zeros((b,) + self._sample_shape,
-                                      self._dtype))
+        for slot in self._slots:
+            for b in self._ladder:
+                slot.engine.run(np.zeros((b,) + self._sample_shape,
+                                         self._dtype))
         count = self._engine.compile_count
         # anchor for the steady-state-recompile gauge: any compile the
         # engine does past this point violates the compile-once promise.
@@ -184,6 +254,8 @@ class Server:
         count = self._engine.compile_count
         out["compile_count"] = count
         out["buckets"] = list(self._ladder)
+        out["breakers"] = {slot.name: slot.breaker.state
+                           for slot in self._slots}
         if self._warm_compiles is not None and count >= 0:
             steady = count - self._warm_compiles
             out["steady_state_recompiles"] = steady
@@ -270,9 +342,56 @@ class Server:
                 for req in batch:
                     self._fail(req, exc)
 
+    def _engine_run(self, padded: np.ndarray):
+        """One padded batch through the engine chain.
+
+        Each admitted engine runs under the retry policy at chaos site
+        ``serving.engine``; an engine that still fails reports to its
+        breaker and the batch degrades to the next slot. With no slot
+        admitted (every breaker open) the batch is shed with
+        :class:`EngineUnavailableError` — serving answers *something* for
+        every request, it never wedges on a dead engine.
+        """
+        # explicit retry_policy wins; otherwise look the shared default up
+        # per batch so reset_default_policy()/changed knobs reach a live
+        # server (default_policy() is a cached read — no per-batch cost)
+        policy = self._retry or resilience.default_policy()
+        last_exc: Optional[BaseException] = None
+        for slot in self._slots:
+            if not slot.breaker.allow():
+                continue
+
+            def attempt(engine=slot.engine):
+                chaos.maybe_fail("serving.engine")
+                return engine.run(padded)
+
+            try:
+                out = policy.call(attempt, site="serving.engine")
+            except Exception as exc:  # noqa: BLE001 - degrade, don't die
+                slot.breaker.on_failure()
+                self._stats.on_engine_failure(slot.name)
+                last_exc = exc
+                continue
+            slot.breaker.on_success()
+            if slot is not self._slots[0]:
+                self._stats.on_fallback(slot.name)
+            return out
+        if last_exc is not None:
+            raise last_exc
+        raise EngineUnavailableError(
+            "every engine breaker is open (%s): request shed"
+            % {s.name: s.breaker.state for s in self._slots})
+
     def _run_batch(self, reqs: List[_Request], padded: np.ndarray):
         try:
-            out = self._engine.run(padded)
+            out = self._engine_run(padded)
+        except EngineUnavailableError as exc:
+            # engine-layer load shed: per-request reruns would ask the same
+            # open breakers again — answer every future explicitly now
+            self._stats.on_unavailable(len(reqs))
+            for req in reqs:
+                self._fail(req, exc)
+            return
         except Exception as exc:  # noqa: BLE001 - isolation boundary
             if len(reqs) == 1:
                 self._stats.on_error()

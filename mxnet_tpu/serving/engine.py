@@ -32,6 +32,11 @@ BatchOut = Union[np.ndarray, Tuple[np.ndarray, ...]]
 class Engine:
     """Interface: run one fixed-shape batch, report compile activity."""
 
+    #: Short identifier used in breaker sites and engine-event telemetry
+    #: (``mxnet_breaker_state{site="serving.<server>.<kind>"}``); concrete
+    #: engines override it so a tripped breaker names what tripped.
+    kind = "engine"
+
     def run(self, batch: np.ndarray) -> BatchOut:
         """Execute one padded batch; return host output(s) whose leading
         axis aligns with the input batch axis."""
@@ -74,6 +79,8 @@ class BlockEngine(Engine):
     deployment semantics, matching ``aot.export_model``; call
     :meth:`refresh_params` after retraining to re-snapshot.
     """
+
+    kind = "block"
 
     def __init__(self, block, dtype="float32"):
         import jax
@@ -144,6 +151,8 @@ class StableHLOEngine(Engine):
     so bucketed traffic against a ``poly_batch`` export is compile-once
     with the same countable cache as :class:`BlockEngine`.
     """
+
+    kind = "stablehlo"
 
     def __init__(self, out_dir: str):
         import jax

@@ -57,6 +57,11 @@ _T_BATCHES = telemetry.counter(
     "mxnet_serving_batches_total",
     "device batch executions per bucket rung",
     labels=("server", "bucket"))
+_T_ENGINE = telemetry.counter(
+    "mxnet_serving_engine_events_total",
+    "engine-level resilience events (failure after retries, fallback "
+    "serve, load-shed with every breaker open)",
+    labels=("server", "engine", "event"))
 
 
 class ServingStats:
@@ -77,6 +82,9 @@ class ServingStats:
         self.padded_rows = 0
         self.served_rows = 0
         self.isolation_retries = 0
+        self.fallbacks = 0
+        self.unavailable = 0
+        self.engine_failures: Dict[str, int] = {}
         self.bucket_counts: Dict[int, int] = {}
         self._queue_depth = 0
         self.name = name
@@ -142,6 +150,29 @@ class ServingStats:
             self.isolation_retries += 1
         _T_REQS.inc(server=self.name, event="isolation_retry")
 
+    def on_engine_failure(self, engine: str):
+        """One engine exhausted its retries on a batch (the breaker for it
+        has already been told); the batch may still be served by the next
+        engine in the chain."""
+        with self._lock:
+            self.engine_failures[engine] = \
+                self.engine_failures.get(engine, 0) + 1
+        _T_ENGINE.inc(server=self.name, engine=engine, event="failure")
+
+    def on_fallback(self, engine: str):
+        """A batch was served by a non-primary engine (degraded mode)."""
+        with self._lock:
+            self.fallbacks += 1
+        _T_ENGINE.inc(server=self.name, engine=engine, event="fallback")
+
+    def on_unavailable(self, n_requests: int):
+        """Load shed at the engine layer: every breaker open, ``n``
+        requests answered with :class:`EngineUnavailableError`."""
+        with self._lock:
+            self.unavailable += n_requests
+        _T_ENGINE.inc(n_requests, server=self.name, engine="all",
+                      event="unavailable")
+
     # -- consumer ----------------------------------------------------------
     def snapshot(self) -> Dict:
         """Point-in-time dict of every serving metric (``Server.stats()``)."""
@@ -156,6 +187,9 @@ class ServingStats:
                 "errors": self.errors,
                 "batches": self.batches,
                 "isolation_retries": self.isolation_retries,
+                "fallbacks": self.fallbacks,
+                "unavailable": self.unavailable,
+                "engine_failures": dict(self.engine_failures),
                 "bucket_counts": dict(self.bucket_counts),
                 "batch_fill": (self.served_rows /
                                (self.served_rows + self.padded_rows)

@@ -72,6 +72,20 @@ def jit_cache_size(jitted) -> int:
         return -1
 
 
+_CHAOS = None
+
+
+def _chaos():
+    """The chaos module, resolved lazily: telemetry loads before resilience
+    in the package import sequence. One module-global check thereafter."""
+    global _CHAOS
+    if _CHAOS is None:
+        from ..resilience import chaos as _c
+
+        _CHAOS = _c
+    return _CHAOS
+
+
 def jit_call(site: str, jitted, *args, **kwargs):
     """Invoke ``jitted(*args, **kwargs)`` recording recompiles at ``site``.
 
@@ -80,7 +94,15 @@ def jit_call(site: str, jitted, *args, **kwargs):
     time is noise next to an XLA compile). Repeated same-shape calls grow
     nothing and record nothing, so a steady-state loop through here is
     probe-only overhead (two int reads on the jit cache).
+
+    Every wrapped invocation is also the ``jit.compile`` chaos injection
+    site: under an ``MXNET_CHAOS`` schedule matching it, the synthetic
+    fault surfaces to the caller's retry policy (serving engines retry it;
+    an uncovered call site propagates it like a real compile failure).
     """
+    c = _chaos()
+    if c.ENABLED:
+        c.maybe_fail("jit.compile")
     if not _registry.ENABLED:
         return jitted(*args, **kwargs)
     before = jit_cache_size(jitted)

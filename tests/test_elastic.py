@@ -81,7 +81,10 @@ def test_run_elastic_resumes(tmp_path):
             manager.save(epoch, params={"w": mx.nd.full((1,), float(epoch))})
         return "finished"
 
-    assert elastic.run_elastic(train_fn, cm, max_restarts=2) == "finished"
+    # restart_delay=0: the backoff schedule has its own test
+    # (test_resilience.test_run_elastic_backoff_schedule)
+    assert elastic.run_elastic(train_fn, cm, max_restarts=2,
+                               restart_delay=0) == "finished"
     # epochs 0-2 trained, crash, resume from 3 (last committed was 2)
     assert trained_epochs == [0, 1, 2, 3, 4, 5]
     assert cm.latest_epoch() == 5
@@ -94,7 +97,8 @@ def test_run_elastic_gives_up(tmp_path):
         raise RuntimeError("permanent")
 
     with pytest.raises(RuntimeError, match="permanent"):
-        elastic.run_elastic(always_fail, cm, max_restarts=2)
+        elastic.run_elastic(always_fail, cm, max_restarts=2,
+                            restart_delay=0)
 
 
 def test_dead_nodes_single_process():

@@ -1,0 +1,690 @@
+"""mxnet_tpu.resilience — policies, breaker, chaos harness, and the
+end-to-end survival contracts (ISSUE-4 acceptance surface).
+
+Tier-1 fast: the chaos schedules are seeded, so every test here is a
+deterministic experiment — the "10% faults" training/serving runs either
+always pass or always fail, never flake.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, elastic, gluon, nd, resilience, serving, telemetry
+from mxnet_tpu.resilience import (CircuitBreaker, CircuitOpenError, Deadline,
+                                  FaultInjected, RetryPolicy, TransientError,
+                                  chaos)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Chaos off, fresh metric series, default policy rebuilt per test."""
+    chaos.disable()
+    resilience.reset_default_policy()
+    telemetry.REGISTRY.clear_data()
+    yield
+    chaos.disable()
+    resilience.reset_default_policy()
+    telemetry.REGISTRY.clear_data()
+
+
+def _fast_policy(**kw):
+    kw.setdefault("base_delay_ms", 0.0)
+    kw.setdefault("jitter", 0.0)
+    return RetryPolicy(**kw)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy / Deadline
+# ---------------------------------------------------------------------------
+
+def test_backoff_schedule_exponential_and_capped():
+    p = RetryPolicy(max_attempts=5, base_delay_ms=10, multiplier=2.0,
+                    jitter=0.0, max_delay_ms=35, budget_ms=1e6)
+    assert p.delays() == [0.010, 0.020, 0.035, 0.035]  # capped at max_delay
+
+
+def test_retry_recovers_and_counts():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TransientError("transient")
+        return "ok"
+
+    assert _fast_policy(max_attempts=4).call(flaky, site="t.site") == "ok"
+    assert calls["n"] == 3
+    c = telemetry.REGISTRY.get("mxnet_retries_total")
+    assert c.value(site="t.site", outcome="retry") == 2
+    assert c.value(site="t.site", outcome="recovered") == 1
+
+
+def test_retry_exhausts_and_reraises_original():
+    def always():
+        raise TransientError("still down")
+
+    with pytest.raises(TransientError):
+        _fast_policy(max_attempts=3).call(always, site="t.exh")
+    c = telemetry.REGISTRY.get("mxnet_retries_total")
+    assert c.value(site="t.exh", outcome="exhausted") == 1
+    assert c.value(site="t.exh", outcome="retry") == 2
+
+
+def test_non_transient_fails_fast():
+    calls = {"n": 0}
+
+    def bug():
+        calls["n"] += 1
+        raise ValueError("a bug, not a fault")
+
+    with pytest.raises(ValueError):
+        _fast_policy(max_attempts=5).call(bug, site="t.bug")
+    assert calls["n"] == 1  # no retries for programming errors
+
+
+def test_retry_budget_caps_total_sleep():
+    slept = []
+    p = RetryPolicy(max_attempts=10, base_delay_ms=40, multiplier=1.0,
+                    jitter=0.0, budget_ms=100, sleep=slept.append)
+
+    def always():
+        raise TransientError("down")
+
+    with pytest.raises(TransientError):
+        p.call(always, site="t.budget")
+    # 40ms per retry, 100ms budget -> exactly 2 sleeps before giving up
+    assert slept == [0.04, 0.04]
+
+
+def test_retry_respects_deadline():
+    p = _fast_policy(max_attempts=10, base_delay_ms=50, sleep=lambda s: None)
+
+    def always():
+        raise TransientError("down")
+
+    with pytest.raises(TransientError):
+        p.call(always, site="t.deadline", deadline=Deadline(0.0))
+
+
+def test_policy_env_knobs(monkeypatch):
+    monkeypatch.setenv("MXNET_RESILIENCE_MAX_ATTEMPTS", "7")
+    monkeypatch.setenv("MXNET_RESILIENCE_BASE_DELAY_MS", "3")
+    p = RetryPolicy.from_env()
+    assert p.max_attempts == 7
+    assert p.base_delay_s == 0.003
+
+
+def test_deadline():
+    assert Deadline().remaining() == float("inf")
+    assert not Deadline().expired()
+    d = Deadline(0.0)
+    assert d.expired() and d.remaining() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+def test_breaker_state_machine():
+    br = CircuitBreaker("t.br", failure_threshold=2, reset_timeout_s=0.05)
+    assert br.state == "closed" and br.allow()
+    br.on_failure()
+    assert br.state == "closed"  # below threshold
+    br.on_failure()
+    assert br.state == "open" and not br.allow()
+    time.sleep(0.06)
+    assert br.allow()  # admits the half-open probe
+    assert br.state == "half_open"
+    assert not br.allow()  # only one probe in flight
+    br.on_failure()
+    assert br.state == "open" and not br.allow()  # probe failed: re-open
+    time.sleep(0.06)
+    assert br.allow()
+    br.on_success()
+    assert br.state == "closed" and br.allow()
+
+
+def test_breaker_success_resets_consecutive_failures():
+    br = CircuitBreaker("t.br2", failure_threshold=3, reset_timeout_s=30)
+    for _ in range(5):
+        br.on_failure()
+        br.on_success()
+    assert br.state == "closed"
+
+
+def test_breaker_call_and_open_error():
+    br = CircuitBreaker("t.br3", failure_threshold=1, reset_timeout_s=30)
+    with pytest.raises(ValueError):
+        br.call(lambda: (_ for _ in ()).throw(ValueError("x")))
+    with pytest.raises(CircuitOpenError):
+        br.call(lambda: "never runs")
+
+
+def test_breaker_telemetry_gauge_and_transitions():
+    br = CircuitBreaker("t.gauge", failure_threshold=1, reset_timeout_s=30)
+    g = telemetry.REGISTRY.get("mxnet_breaker_state")
+    assert g.value(site="t.gauge") == 0
+    br.on_failure()
+    assert g.value(site="t.gauge") == 2  # open
+    c = telemetry.REGISTRY.get("mxnet_breaker_transitions_total")
+    assert c.value(site="t.gauge", to="open") == 1
+
+
+def test_breaker_registry_get_or_create():
+    a = resilience.breaker("t.shared", failure_threshold=9)
+    b = resilience.breaker("t.shared")
+    assert a is b and a.failure_threshold == 9
+
+
+# ---------------------------------------------------------------------------
+# chaos harness
+# ---------------------------------------------------------------------------
+
+def _hits(site, n, spec=None):
+    """Indices (1-based) of calls to ``site`` that fault under the ACTIVE
+    schedule (or a fresh ``spec``)."""
+    out = []
+
+    def roll():
+        for i in range(1, n + 1):
+            try:
+                chaos.maybe_fail(site)
+            except FaultInjected:
+                out.append(i)
+
+    if spec is None:
+        roll()
+    else:
+        with chaos.active(spec):
+            roll()
+    return out
+
+
+def test_chaos_seeded_determinism():
+    a = _hits("s.x", 200, "seed=7,site=s.*,p=0.1")
+    b = _hits("s.x", 200, "seed=7,site=s.*,p=0.1")
+    assert a and a == b  # same seed -> identical schedule
+    c = _hits("s.x", 200, "seed=8,site=s.*,p=0.1")
+    assert a != c  # different seed -> different schedule
+
+
+def test_chaos_per_site_streams_independent():
+    """Interleaving other sites' calls must not shift a site's schedule."""
+    with chaos.active("seed=7,site=s.*,p=0.1"):
+        alone = _hits("s.x", 100)
+    with chaos.active("seed=7,site=s.*,p=0.1"):
+        interleaved = []
+        for i in range(1, 101):
+            try:
+                chaos.maybe_fail("s.other")
+            except FaultInjected:
+                pass
+            try:
+                chaos.maybe_fail("s.x")
+            except FaultInjected:
+                interleaved.append(i)
+    assert alone == interleaved
+
+
+def test_chaos_at_schedule_and_max():
+    assert _hits("x", 6, "site=x,at=2:5") == [2, 5]
+    assert _hits("x", 6, "site=x,at=1:2:3,max=2") == [1, 2]
+
+
+def test_chaos_site_scoping():
+    with chaos.active("seed=1,site=kvstore.*,at=1"):
+        assert _hits("kvstore.push", 1) == [1]
+        assert _hits("serving.engine", 5) == []
+
+
+def test_chaos_multi_rule_spec():
+    with chaos.active("seed=1,site=a,at=1;site=b,at=2"):
+        assert _hits("a", 2) == [1]
+        assert _hits("b", 2) == [2]
+
+
+def test_chaos_injection_counts_and_telemetry():
+    with chaos.active("site=x,at=1:3"):
+        _hits("x", 3)
+        assert chaos.injected_counts() == {"x": 2}
+        assert chaos.summary()["faults_injected"] == {"x": 2}
+    c = telemetry.REGISTRY.get("mxnet_faults_injected_total")
+    assert c.value(site="x") == 2
+
+
+def test_chaos_spec_validation():
+    for bad in ("p=0.1,extra", "frobnicate=1", "site=x,p=2.0",
+                "site=x,at=0", "site=x", "site=x,p=zz"):
+        with pytest.raises(mx.MXNetError):
+            chaos.parse_spec(bad)
+
+
+class _Poison:
+    """Fails the test if the disabled path touches chaos state at all."""
+
+    def __getattr__(self, name):
+        raise AssertionError("disabled chaos path touched state.%s" % name)
+
+
+def test_chaos_disabled_path_is_one_boolean_check(monkeypatch):
+    """MXNET_CHAOS unset => maybe_fail is a single module-global boolean
+    read: no lock, no env read, no state access (the poisoned-state proof,
+    same style as test_telemetry's poisoned-lock test)."""
+    assert chaos.ENABLED is False
+    monkeypatch.setattr(chaos, "_STATE", _Poison())
+
+    def poisoned_get_env(*a, **kw):
+        raise AssertionError("disabled chaos path read the environment")
+
+    monkeypatch.setattr(chaos, "get_env", poisoned_get_env)
+    for site in ("kvstore.push", "transfer.fetch_host", "serving.engine",
+                 "io.prefetch", "ckpt.commit", "jit.compile"):
+        chaos.maybe_fail(site)
+
+
+def test_chaos_active_restores_previous_schedule():
+    with chaos.active("site=a,at=1"):
+        with chaos.active("site=b,at=1"):
+            assert _hits("b", 1) == [1]
+        assert _hits("a", 1) == [1]
+    assert chaos.ENABLED is False
+
+
+# ---------------------------------------------------------------------------
+# chaos end-to-end: training survives with bit-identical results
+# ---------------------------------------------------------------------------
+
+def _train_once(steps=30):
+    """Tiny but real training loop over the hardened paths: tpu-kvstore
+    fused pushpull per step, a fetch_host metric read, an asnumpy probe."""
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential(prefix="chaos_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(1))
+    net.initialize()
+    net(nd.ones((4, 4)))  # materialize
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore="tpu")
+    loss_fn = gluon.loss.L2Loss()
+    rs = np.random.RandomState(3)
+    xs = rs.rand(steps, 4, 4).astype(np.float32)
+    ys = rs.rand(steps, 4).astype(np.float32)
+    losses = []
+    for i in range(steps):
+        x, y = nd.array(xs[i]), nd.array(ys[i])
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(4)
+        # transfer.fetch_host + transfer.asnumpy sites, every step
+        losses.append(float(mx.base.fetch_host([loss.sum()])[0]))
+        _ = loss.asnumpy()
+    params = {k: p.data().asnumpy().tobytes()
+              for k, p in net.collect_params().items()}
+    return params, losses
+
+
+def test_chaos_training_bit_identical():
+    """ISSUE-4 acceptance: with seed=7, p=0.1 faults on transfer.* and
+    kvstore.*, the training loop completes and the final params match the
+    fault-free run BIT FOR BIT — retries are transparent."""
+    clean_params, clean_losses = _train_once()
+    with chaos.active("seed=7,site=transfer.*,p=0.1;site=kvstore.*,p=0.1"):
+        chaos_params, chaos_losses = _train_once()
+        injected = chaos.injected_counts()
+    # the experiment must actually have injected faults in BOTH groups
+    assert any(s.startswith("transfer.") for s in injected), injected
+    assert any(s.startswith("kvstore.") for s in injected), injected
+    assert clean_losses == chaos_losses
+    assert set(clean_params) == set(chaos_params)
+    for k in clean_params:
+        assert clean_params[k] == chaos_params[k], "params differ at %s" % k
+
+
+def test_chaos_kvstore_push_pull_transparent():
+    kv = mx.kv.create("tpu")
+    kv.init("w", nd.zeros((4, 4)))
+    with chaos.active("seed=5,site=kvstore.*,p=0.2"):
+        for i in range(10):
+            kv.push("w", nd.ones((4, 4)) * (i + 1))
+            out = nd.zeros((4, 4))
+            kv.pull("w", out=out)
+            np.testing.assert_allclose(out.asnumpy(), i + 1.0)
+        assert chaos.injected_counts()  # the schedule really fired
+
+
+# ---------------------------------------------------------------------------
+# chaos end-to-end: serving soak
+# ---------------------------------------------------------------------------
+
+class _DoubleEngine(serving.Engine):
+    kind = "double"
+
+    def __init__(self):
+        self.runs = 0
+
+    def run(self, batch):
+        self.runs += 1
+        return batch * 2.0
+
+
+def test_chaos_serving_soak_every_request_answered():
+    """ISSUE-4 acceptance: with p=0.1 faults on serving.engine the server
+    answers EVERY request — success or an explicit error — none hang, and
+    the retry/fault accounting is visible in stats and telemetry."""
+    n = 120
+    with chaos.active("seed=7,site=serving.engine,p=0.1"):
+        srv = serving.Server(_DoubleEngine(), (4,), buckets=[1, 4, 8],
+                             max_delay_ms=1.0, timeout_ms=0, name="soak")
+        rs = np.random.RandomState(0)
+        reqs = rs.rand(n, 4).astype(np.float32)
+        futures = []
+
+        def client(lo, hi):
+            for i in range(lo, hi):
+                futures.append((i, srv.submit(reqs[i])))
+
+        threads = [threading.Thread(target=client, args=(c * 30, (c + 1) * 30))
+                   for c in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        answered = errored = 0
+        for i, fut in futures:
+            try:
+                out = fut.result(timeout=30)  # a hang fails the test here
+                np.testing.assert_allclose(out, reqs[i] * 2.0, rtol=1e-6)
+                answered += 1
+            except Exception:
+                errored += 1
+        stats = srv.stats()
+        srv.close(timeout=10)
+        injected = chaos.injected_counts()
+    assert answered + errored == n  # every request got an explicit answer
+    assert injected.get("serving.engine", 0) > 0
+    # retries absorbed nearly everything: losing >10% would mean the
+    # policy is not engaging
+    assert answered >= int(n * 0.9)
+    assert stats["completed"] == answered
+    assert "breakers" in stats and stats["breakers"]["primary"] in (
+        "closed", "half_open", "open")
+    retries = telemetry.REGISTRY.get("mxnet_retries_total")
+    assert retries.value(site="serving.engine", outcome="retry") > 0
+
+
+def test_serving_breaker_trips_falls_back_and_recovers():
+    """Primary engine dies -> breaker opens -> fallback serves (degraded);
+    primary heals -> half-open probe -> breaker closes -> primary serves."""
+
+    class _FlakyEngine(serving.Engine):
+        kind = "flaky"
+
+        def __init__(self):
+            self.broken = True
+
+        def run(self, batch):
+            if self.broken:
+                raise ValueError("engine down")
+            return batch * 10.0
+
+    primary = _FlakyEngine()
+    srv = serving.Server(primary, (3,), buckets=[1, 4], max_delay_ms=1.0,
+                         fallback_engine=_DoubleEngine(),
+                         breaker_threshold=2, breaker_reset_s=0.2,
+                         name="brk",
+                         retry_policy=RetryPolicy(max_attempts=1))
+    x = np.ones(3, np.float32)
+    for _ in range(4):
+        np.testing.assert_allclose(srv.submit(x).result(10), x * 2.0)
+    st = srv.stats()
+    assert st["breakers"]["primary"] in ("open", "half_open")
+    assert st["breakers"]["fallback"] == "closed"
+    assert st["fallbacks"] == 4
+    assert st["engine_failures"]["primary"] == 2  # then the breaker opened
+    # breaker state is on the telemetry gauge too
+    g = telemetry.REGISTRY.get("mxnet_breaker_state")
+    assert g.value(site="serving.brk.primary") in (1, 2)
+
+    primary.broken = False
+    time.sleep(0.25)  # past reset_timeout: next batch is the probe
+    np.testing.assert_allclose(srv.submit(x).result(10), x * 10.0)
+    assert srv.stats()["breakers"]["primary"] == "closed"
+    srv.close()
+
+
+def test_serving_load_sheds_when_all_breakers_open():
+    class _DeadEngine(serving.Engine):
+        def run(self, batch):
+            raise ValueError("permanently down")
+
+    srv = serving.Server(_DeadEngine(), (3,), buckets=[1], max_delay_ms=0.5,
+                         breaker_threshold=1, breaker_reset_s=30.0,
+                         name="dead",
+                         retry_policy=RetryPolicy(max_attempts=1))
+    x = np.ones(3, np.float32)
+    with pytest.raises(ValueError):
+        srv.submit(x).result(10)  # the tripping failure keeps its type
+    with pytest.raises(serving.EngineUnavailableError):
+        srv.submit(x).result(10)  # now shed fast: breaker open, no retry
+    st = srv.stats()
+    srv.close()
+    assert st["unavailable"] == 1
+    assert st["breakers"]["primary"] == "open"
+
+
+# ---------------------------------------------------------------------------
+# io prefetch failure propagation
+# ---------------------------------------------------------------------------
+
+class _PoisonedIter(mx.io.DataIter):
+    """Yields ``good`` batches, then raises (a decode error mid-epoch);
+    ``poison=False`` ends the epoch cleanly instead."""
+
+    def __init__(self, good=2, batch_size=2, poison=True):
+        super().__init__(batch_size)
+        self.served = 0
+        self.good = good
+        self.poison = poison
+
+    @property
+    def provide_data(self):
+        return [mx.io.DataDesc("data", (self.batch_size, 3))]
+
+    @property
+    def provide_label(self):
+        return [mx.io.DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self.served = 0
+
+    def next(self):
+        if self.served >= self.good:
+            if self.poison:
+                raise ValueError("poisoned record")
+            raise StopIteration
+        self.served += 1
+        return mx.io.DataBatch([nd.ones((self.batch_size, 3))],
+                               [nd.zeros((self.batch_size,))], pad=0)
+
+
+def test_prefetching_iter_propagates_worker_error():
+    """Regression (ISSUE-4 satellite): a worker-thread exception used to
+    leave the consumer blocked forever on data_ready; now it surfaces at
+    the next __next__, and the stream then reads as ended, not hung."""
+    it = mx.io.PrefetchingIter(_PoisonedIter(good=2))
+    got = 0
+    with pytest.raises(ValueError, match="poisoned record"):
+        while True:
+            next(it)  # must raise, not hang and not StopIteration early
+            got += 1
+    assert got == 2  # the good batches were served before the poison
+    with pytest.raises(StopIteration):
+        next(it)  # ...and the epoch is over, still no hang
+
+
+def test_prefetching_iter_retries_transient_faults():
+    with chaos.active("seed=2,site=io.prefetch,at=1:3"):
+        it = mx.io.PrefetchingIter(_PoisonedIter(good=4, poison=False))
+        got = sum(1 for _ in it)
+    assert got == 4  # injected faults retried, epoch NOT truncated
+
+
+def test_device_prefetch_iter_propagates_and_ends():
+    it = mx.io.DevicePrefetchIter(_PoisonedIter(good=2), depth=1)
+    got = 0
+    with pytest.raises(ValueError, match="poisoned record"):
+        while True:
+            next(it)
+            got += 1
+    assert got == 2
+    with pytest.raises(StopIteration):
+        next(it)  # terminal state sticks; no deadlock on an empty queue
+    it.reset()  # reset clears the terminal state for a fresh epoch
+    next(it)
+    next(it)
+    with pytest.raises(ValueError, match="poisoned record"):
+        next(it)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint commit + elastic restart
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_save_survives_commit_faults(tmp_path):
+    cm = elastic.CheckpointManager(str(tmp_path))
+    with chaos.active("seed=1,site=ckpt.*,p=0.3"):
+        for e in range(4):
+            cm.save(e, params={"w": nd.full((2,), float(e))})
+        assert chaos.injected_counts().get("ckpt.commit", 0) > 0
+    assert cm.latest_epoch() == 3
+    np.testing.assert_allclose(cm.load_params()["w"].asnumpy(), 3.0)
+    # retried commits never leave partial tmp files behind
+    assert not [f for f in os.listdir(str(tmp_path)) if ".tmp." in f]
+
+
+def test_atomic_write_fsyncs_file_and_directory(tmp_path, monkeypatch):
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync", lambda fd: synced.append(fd) or
+                        real_fsync(fd))
+    cm = elastic.CheckpointManager(str(tmp_path))
+    cm.save(0, params={"w": nd.ones((2,))})
+    # params + manifest commits, each fsyncing tmp file AND directory
+    assert len(synced) >= 4
+
+
+def test_atomic_write_failure_leaves_no_tmp(tmp_path):
+    cm = elastic.CheckpointManager(str(tmp_path))
+
+    def bad_writer(p):
+        open(p, "w").write("partial")
+        raise ValueError("disk died mid-write")
+
+    with pytest.raises(ValueError):
+        cm._atomic_write(str(tmp_path / "x.bin"), bad_writer)
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_run_elastic_backoff_schedule(tmp_path, monkeypatch):
+    """Restart delays grow exponentially and are capped — no tight crash
+    loop — and each restart ticks the elastic.restart retry counter."""
+    slept = []
+    monkeypatch.setattr(elastic.time, "sleep", slept.append)
+    cm = elastic.CheckpointManager(str(tmp_path))
+    attempts = {"n": 0}
+
+    def crashy(start_epoch, manager):
+        attempts["n"] += 1
+        if attempts["n"] <= 3:
+            raise RuntimeError("boom %d" % attempts["n"])
+        return "done"
+
+    out = elastic.run_elastic(crashy, cm, max_restarts=3, restart_delay=1.0,
+                              restart_backoff=2.0, max_restart_delay=3.0)
+    assert out == "done"
+    assert slept == [1.0, 2.0, 3.0]  # 1, 2, then capped (not 4)
+    c = telemetry.REGISTRY.get("mxnet_retries_total")
+    assert c.value(site="elastic.restart", outcome="retry") == 3
+
+
+# ---------------------------------------------------------------------------
+# model zoo download (atomic, verified, retried)
+# ---------------------------------------------------------------------------
+
+def test_model_store_download_retries_partial_fetch(tmp_path):
+    from mxnet_tpu.gluon.model_zoo import model_store
+
+    payload = b"weights-payload"
+    import hashlib
+
+    digest = hashlib.sha1(payload).hexdigest()
+    calls = {"n": 0}
+
+    def flaky_fetcher(url, dest):
+        calls["n"] += 1
+        with open(dest, "wb") as f:
+            # two truncated transfers, then the real thing
+            f.write(payload[:4] if calls["n"] < 3 else payload)
+
+    target = str(tmp_path / "m.params")
+    resilience.reset_default_policy()
+    out = model_store.download("mirror://m", target, sha1_hash=digest[:8],
+                               fetcher=flaky_fetcher)
+    assert out == target and calls["n"] == 3
+    assert open(target, "rb").read() == payload
+    assert not [f for f in os.listdir(str(tmp_path)) if ".part." in f]
+
+
+def test_model_store_download_never_commits_corrupt(tmp_path, monkeypatch):
+    from mxnet_tpu.gluon.model_zoo import model_store
+
+    monkeypatch.setenv("MXNET_RESILIENCE_MAX_ATTEMPTS", "2")
+    monkeypatch.setenv("MXNET_RESILIENCE_BASE_DELAY_MS", "0")
+    resilience.reset_default_policy()
+
+    def bad_fetcher(url, dest):
+        open(dest, "wb").write(b"garbage")
+
+    target = str(tmp_path / "m.params")
+    with pytest.raises(TransientError):
+        model_store.download("mirror://m", target, sha1_hash="0" * 8,
+                             fetcher=bad_fetcher)
+    # the cache directory holds neither the bad file nor a partial
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_model_store_get_model_file_downloads_on_miss(tmp_path):
+    from mxnet_tpu.gluon.model_zoo import model_store
+
+    payload = b"zoo-bytes"
+    import hashlib
+
+    digest = hashlib.sha1(payload).hexdigest()
+
+    def fetcher(url, dest):
+        open(dest, "wb").write(payload)
+
+    got = model_store.get_model_file("resnet_t", root=str(tmp_path),
+                                     url="mirror://resnet_t",
+                                     sha1_hash=digest, fetcher=fetcher)
+    assert os.path.basename(got) == "resnet_t-%s.params" % digest[:8]
+    # second lookup hits the verified cache, no fetcher needed
+    assert model_store.get_model_file("resnet_t", root=str(tmp_path)) == got
+
+
+# ---------------------------------------------------------------------------
+# snapshot surface
+# ---------------------------------------------------------------------------
+
+def test_resilience_snapshot_shape():
+    with chaos.active("site=x,at=1"):
+        _hits("x", 1)
+        snap = resilience.snapshot()
+        assert snap["faults_injected"].get("x") == 1
+        assert snap["chaos"]["enabled"] is True
+    assert "retries" in snap and "breakers" in snap

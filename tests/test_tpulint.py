@@ -306,6 +306,77 @@ def test_env_knob_scoped_to_mxnet_tpu():
 
 
 # ---------------------------------------------------------------------------
+# swallowed-error
+# ---------------------------------------------------------------------------
+
+def test_swallowed_error_positive_variants():
+    found = lint("""
+        def f(q):
+            try:
+                q.get()
+            except Exception:
+                pass
+            while True:
+                try:
+                    q.get()
+                except:
+                    continue
+            try:
+                q.get()
+            except (ValueError, BaseException):
+                ...
+    """, "swallowed-error")
+    assert len(found) == 3
+
+
+def test_swallowed_error_negative_handled_or_narrow():
+    assert not lint("""
+        import queue
+
+        def f(q, log):
+            try:
+                q.get()
+            except queue.Empty:
+                pass
+            try:
+                q.get()
+            except Exception as exc:
+                log.warning("boom: %s", exc)
+            try:
+                q.get()
+            except Exception:
+                return None
+            try:
+                q.get()
+            except Exception:
+                raise
+    """, "swallowed-error")
+
+
+def test_swallowed_error_scoped_to_runtime_package():
+    src = """
+        def f(q):
+            try:
+                q.get()
+            except Exception:
+                pass
+    """
+    assert lint(src, "swallowed-error", relpath="mxnet_tpu/x.py")
+    assert not lint(src, "swallowed-error", relpath="tools/x.py")
+
+
+def test_swallowed_error_suppressible():
+    found = lint("""
+        def __del__(self):
+            try:
+                self.close()
+            except Exception:  # tpulint: disable=swallowed-error
+                pass
+    """, "swallowed-error")
+    assert not found
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,76 @@
+"""swallowed-error: broad exception suppression in runtime paths.
+
+A ``try: ... except Exception: pass`` (or bare ``except:``/
+``except BaseException:`` with a body that only ``pass``/``continue``\\ s)
+silently eats every failure class — including the transient faults the
+resilience layer exists to retry and the programming errors that should
+fail loudly. On this stack that pattern is how an io worker "finishes" an
+epoch early, a checkpoint "commits" nothing, or a serving thread wedges
+with no trace. The fix is one of: narrow the exception type to what the
+site actually expects (``queue.Empty``, ``OSError``), route it through a
+``resilience.RetryPolicy``, or at minimum log before suppressing.
+
+Scope: ``mxnet_tpu/`` only (the runtime package); ``tools/`` scripts own
+their CLI error handling. Handlers that *do something* — re-raise,
+return, log, assign — are not flagged: the rule targets pure suppression.
+Legitimate suppressions (destructors, interpreter teardown) carry a
+``# tpulint: disable=swallowed-error`` with their justification or ride
+the baseline.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, Pass, dotted_name, register
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node) -> bool:
+    """Bare except, Exception/BaseException, or a tuple containing one."""
+    if type_node is None:
+        return True
+    name = dotted_name(type_node)
+    if name in _BROAD:
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(elt) for elt in type_node.elts)
+    return False
+
+
+def _only_suppresses(body) -> bool:
+    """True when the handler body does nothing with the error: just
+    ``pass``/``continue``/``...`` (a docstring-style constant counts as
+    nothing too)."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue
+        return False
+    return True
+
+
+@register
+class SwallowedErrorPass(Pass):
+    name = "swallowed-error"
+    description = ("broad `except ...: pass`-style suppression in "
+                   "mxnet_tpu/ runtime paths")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node.type) and _only_suppresses(node.body):
+                what = "bare `except:`" if node.type is None else \
+                    "`except %s:`" % (dotted_name(node.type)
+                                      or "<broad tuple>")
+                yield ctx.finding(
+                    node, self.name,
+                    "%s with a body that only suppresses — narrow the "
+                    "exception type, retry via resilience.RetryPolicy, or "
+                    "log before dropping it" % what)
