@@ -51,6 +51,7 @@ for _m in (
     "monitor",
     "profiler",
     "telemetry",
+    "fastpath",
     "rtc",
     "runtime",
     "visualization",

@@ -227,6 +227,10 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             leaf._grad._data = leaf._grad._data + g
         else:
             leaf._grad._data = g
+        # reference NDArray fresh-grad bit (ndarray.py:fresh_grad): a leaf
+        # whose grad was produced by this backward is "fresh" until an
+        # optimizer consumes it — Trainer's ignore_stale_grad keys off it
+        leaf._fresh_grad = True
 
 
 def _build_replay(heads, variables):

@@ -159,15 +159,27 @@ class Executor(object):
             cell["bwd"] = vjp_pure
             return outs, aux, res
 
-        def bwd(res, cts):
-            if "bwd_jit" not in cell:
+        def bwd(res, cts, donate=False):
+            # residual donation: the vjp residuals (the stored-activation
+            # set, the largest training buffer) are consumed exactly once —
+            # donating them lets XLA reuse that HBM for the gradient
+            # computation. Only when the caller proved no residual aliases
+            # a buffer it still holds (forward() checks — XLA may alias
+            # identical jit outputs) and the backend implements donation.
+            key = "bwd_jit_donate" if donate else "bwd_jit"
+            if key not in cell:
                 raw = cell["bwd"]
-                cell["bwd_jit"] = jax.jit(lambda res, cts: raw(res, *cts))
+                cell[key] = jax.jit(
+                    lambda res, cts: raw(res, *cts),
+                    donate_argnums=(0,) if donate else ())
             (grads,) = _telemetry.jit_call("executor.backward",
-                                           cell["bwd_jit"],
+                                           cell[key],
                                            list(res), list(cts))
             return grads
 
+        # fwd deliberately donates nothing: every input (params, aux, rng)
+        # outlives the call — params persist across steps, aux buffers are
+        # replaced (not consumed) after the call returns
         pair = {"fwd": jax.jit(fwd), "bwd": bwd}
         self._fwd_cache[key] = pair
         return pair
@@ -203,6 +215,8 @@ class Executor(object):
                 [arg_vals[n] for n in diff_names], const_args, aux_vals, rng)
             self._bwd_pair = pair
             self._diff_names = diff_names
+            self._bwd_donate = self._residuals_donatable(
+                outputs, aux_updates, list(arg_vals.values()))
         else:
             outputs, aux_updates = _telemetry.jit_call(
                 "executor.forward", self._graph_fn(False),
@@ -219,6 +233,29 @@ class Executor(object):
                 self._monitor_callback(name, out)
         return self.outputs
 
+    def _residuals_donatable(self, outputs, aux_updates, inputs):
+        """Donation-safety guard for the backward jit: a runtime may alias
+        jit outputs onto one buffer (identical outputs, or an unmodified
+        input passed through), so a residual can share device memory with
+        a forward output/input the caller still holds — donating it would
+        corrupt that live array — or with ANOTHER residual — donating the
+        same buffer at two argument positions is a runtime error.
+        Residuals are donatable only when their buffers are pairwise
+        distinct AND disjoint from every output/aux/param buffer (and
+        donation is on for a backend that implements it)."""
+        from . import fastpath
+        from .fastpath.fused import _buf_ptr
+
+        if not fastpath.donation_argnums_ok():
+            return False
+        held = [_buf_ptr(b) for b in
+                list(outputs) + list(aux_updates.values()) + list(inputs)]
+        ptrs = [_buf_ptr(r) for r in self._residuals]
+        if None in ptrs or None in held:  # unprobeable => no donation
+            return False
+        return len(set(ptrs)) == len(ptrs) and \
+            not set(ptrs) & set(held)
+
     @_telemetry.traced(
         "executor", lambda self, *a, **kw: "backward(%s)" % self._symbol.name)
     def backward(self, out_grads=None, is_train=True):
@@ -227,7 +264,10 @@ class Executor(object):
         import jax.numpy as jnp
 
         if self._residuals is None:
-            raise MXNetError("backward called before forward(is_train=True)")
+            raise MXNetError(
+                "backward needs a fresh forward(is_train=True): none has "
+                "run, or the previous backward consumed (donated) the "
+                "residuals")
         if out_grads is None:
             cts = tuple(jnp.ones(s, dtype=o._data.dtype)
                         for s, o in zip(self._output_shapes, self.outputs))
@@ -236,7 +276,13 @@ class Executor(object):
                 out_grads = [out_grads]
             cts = tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
                         for g in out_grads)
-        grads = self._bwd_pair["bwd"](self._residuals, list(cts))
+        donate = bool(getattr(self, "_bwd_donate", False))
+        grads = self._bwd_pair["bwd"](self._residuals, list(cts),
+                                      donate=donate)
+        if donate:
+            # residuals were donated: invalidate the handle so a second
+            # backward raises cleanly instead of replaying dead buffers
+            self._residuals = None
         for name, g in zip(self._diff_names, grads):
             tgt = self.grad_dict.get(name)
             if tgt is None:

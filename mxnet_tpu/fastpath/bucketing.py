@@ -1,0 +1,166 @@
+"""DDP-style gradient bucketing for the kvstore aggregate phase.
+
+A ResNet's gradient exchange is dominated by *count*, not bytes: dozens of
+sub-megabyte BatchNorm/bias tensors each cost a collective launch while the
+wire sits idle. Bucketing coalesces small same-dtype gradients into flat
+contiguous buckets (knob ``MXNET_KVSTORE_BUCKET_MB``; ``0`` disables) so
+the aggregate phase reduces a handful of large buffers instead of a long
+tail of tiny ones — the strategy PyTorch DDP ships as its default 25 MB
+gradient buckets, applied here inside ``kvstore.pushpull_multi`` *before*
+the retried aggregate phase.
+
+Pack and unpack each compile to ONE jitted call per layout (concatenate of
+ravels / slice-and-reshape), so bucketing never re-inflates the dispatch
+count it exists to shrink. Summation is elementwise, so
+``unpack(reduce(pack(x)))`` is bit-identical to ``reduce(x)`` — the PR-4
+chaos-training bit-for-bit guarantee survives with bucketing on.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import get_env
+
+__all__ = ["bucket_cap_bytes", "plan_for", "Plan"]
+
+_MB = 1 << 20
+
+
+def bucket_cap_bytes() -> int:
+    """Per-bucket byte cap (``MXNET_KVSTORE_BUCKET_MB``, default 4 MB;
+    ``0`` disables bucketing). Read per call — tests and tuning flip it on
+    a live process."""
+    mb = get_env("MXNET_KVSTORE_BUCKET_MB", 4.0, float, cache=False)
+    return int(mb * _MB) if mb and mb > 0 else 0
+
+
+class Plan:
+    """One coalescing layout for a fixed leaf signature.
+
+    ``buckets`` — tuples of leaf positions packed flat per dtype (len ≥ 2);
+    ``solo`` — positions that ride unpacked (bigger than the cap, or alone
+    in their dtype). Pack/unpack jits are cached on the plan, which is
+    itself cached per (signature, cap) in :data:`_PLANS`.
+    """
+
+    def __init__(self, sig: Tuple, buckets: List[Tuple[int, ...]],
+                 solo: List[int]):
+        self.sig = sig            # ((shape, dtype_str), ...) per leaf
+        self.buckets = buckets
+        self.solo = solo
+        # static per-leaf flat sizes: trace-time constants of the
+        # pack/unpack jits, computed once on the host
+        self.sizes = [int(np.prod(s, dtype=np.int64))  # tpulint: disable=host-sync - static shape tuples, pure host math
+                      for s, _ in sig]
+        self._pack_jit = None
+        self._unpack_jit = None
+
+    @property
+    def n_out(self) -> int:
+        """Aggregate groups after coalescing (buckets + solo leaves)."""
+        return len(self.buckets) + len(self.solo)
+
+    # ------------------------------------------------------------------
+    def pack(self, leaves: Sequence[Any]) -> List[Any]:
+        """Coalesce ``leaves`` (one copy's full leaf list) into the packed
+        layout: bucket arrays first, then solo leaves. ONE jitted call for
+        all concatenations; solo leaves pass through untouched (no copy)
+        and never enter the jit — only the bucketed leaves pay argument
+        processing."""
+        if self._pack_jit is None:
+            lens = [len(b) for b in self.buckets]
+
+            def _pack(pruned):
+                out, k = [], 0
+                for n in lens:
+                    out.append(jnp.concatenate(
+                        [p.ravel() for p in pruned[k:k + n]]))
+                    k += n
+                return out
+
+            self._pack_jit = jax.jit(_pack)
+        pruned = [leaves[i] for b in self.buckets for i in b]
+        packed = self._pack_jit(pruned)
+        return list(packed) + [leaves[i] for i in self.solo]
+
+    def unpack(self, packed: Sequence[Any]) -> List[Any]:
+        """Invert :meth:`pack`: returns the leaves in original order. ONE
+        jitted call slices + reshapes every bucketed leaf."""
+        if self._unpack_jit is None:
+            buckets = self.buckets
+            shapes = [s for s, _ in self.sig]
+            sizes = self.sizes
+
+            def _unpack(bs):
+                out = []
+                for b, flat in zip(buckets, bs):
+                    off = 0
+                    for i in b:
+                        out.append(flat[off:off + sizes[i]].reshape(shapes[i]))
+                        off += sizes[i]
+                return out
+
+            self._unpack_jit = jax.jit(_unpack)
+        unpacked = self._unpack_jit(list(packed[:len(self.buckets)]))
+        leaves: List[Any] = [None] * len(self.sig)
+        k = 0
+        for b in self.buckets:
+            for i in b:
+                leaves[i] = unpacked[k]
+                k += 1
+        for j, i in enumerate(self.solo):
+            leaves[i] = packed[len(self.buckets) + j]
+        return leaves
+
+
+_PLANS: Dict[Tuple, Optional[Plan]] = {}
+
+
+def plan_for(leaves: Sequence[Any],
+             cap_bytes: Optional[int] = None) -> Optional[Plan]:
+    """Build (or fetch the cached) coalescing plan for this leaf layout.
+
+    Greedy per dtype, preserving order: leaves at or above the cap go solo;
+    smaller ones fill the current bucket until it would overflow. Returns
+    ``None`` when bucketing is disabled or nothing coalesces (every dtype
+    has at most one small leaf) — callers then skip the pack/unpack."""
+    cap = bucket_cap_bytes() if cap_bytes is None else cap_bytes
+    if cap <= 0 or len(leaves) < 2:
+        return None
+    sig = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+    key = (sig, cap)
+    if key in _PLANS:
+        return _PLANS[key]
+
+    by_dtype: Dict[str, List[Tuple[int, int]]] = {}  # dtype -> [(pos, bytes)]
+    solo: List[int] = []
+    for pos, l in enumerate(leaves):
+        nbytes = getattr(l, "nbytes", 0)
+        if nbytes >= cap:
+            solo.append(pos)
+        else:
+            by_dtype.setdefault(str(l.dtype), []).append((pos, nbytes))
+
+    buckets: List[Tuple[int, ...]] = []
+    for _dtype, items in by_dtype.items():
+        cur: List[int] = []
+        cur_bytes = 0
+        for pos, nbytes in items:
+            if cur and cur_bytes + nbytes > cap:
+                (buckets if len(cur) > 1 else solo).append(
+                    tuple(cur) if len(cur) > 1 else cur[0])
+                cur, cur_bytes = [], 0
+            cur.append(pos)
+            cur_bytes += nbytes
+        if len(cur) > 1:
+            buckets.append(tuple(cur))
+        elif cur:
+            solo.append(cur[0])
+
+    plan = Plan(sig, buckets, sorted(solo)) if buckets else None
+    _PLANS[key] = plan
+    return plan

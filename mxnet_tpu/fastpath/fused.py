@@ -1,0 +1,212 @@
+"""Tree-level fused optimizer apply + buffer donation.
+
+The pre-fastpath update plane dispatched one jitted kernel *per parameter
+per step* (``Optimizer.update`` via ``Updater.__call__`` in a python loop —
+~160 dispatches/step on ResNet-50, the regime BENCH_TPU_PARTIAL_r05 died
+in). Here the SAME pure per-parameter kernel (``Optimizer._leaf_step``,
+shared with the per-param path so the two cannot drift numerically) is
+composed over the whole ``(params, grads, states)`` pytree and compiled as
+ONE jit per optimizer: XLA sees every parameter's rescale → clip → wd →
+momentum → assign chain in a single module and the python loop disappears
+from the hot path.
+
+Buffer donation: the params and optimizer states are dead the moment the
+fused apply returns — donating them lets XLA update weights in place in
+HBM (halves peak parameter memory, removes the copy kernels). PJRT only
+implements donation on tpu/gpu, so ``donate_argnums`` is attached there;
+the *semantics* — a stale ``NDArray`` handle over a donated buffer must
+raise instead of reading garbage — are enforced on every backend by
+explicitly deleting the consumed buffers after the call
+(:func:`_invalidate`). ``jax.Array.delete`` is idempotent, so this is a
+no-op where the runtime already reclaimed the buffer via donation.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import telemetry
+from ..base import MXNetError
+
+__all__ = ["FusedApplyError", "fused_apply", "apply_updater"]
+
+
+class FusedApplyError(MXNetError):
+    """Misuse of the fused tree apply (incapable optimizer, ragged input)."""
+
+
+def _f32(x):
+    return jnp.asarray(x, dtype=jnp.float32)
+
+
+def _is_mp_state(optimizer, index, weight, state):
+    """Whether ``state`` is a (fp32 master, base_state) multi-precision
+    pair for this weight (created by ``create_state_multi_precision``)."""
+    from ..optimizer import _is_mp_dtype, _is_mp_pair
+
+    return (optimizer.multi_precision and _is_mp_dtype(weight.dtype)
+            and _is_mp_pair(optimizer, index, weight, state))
+
+
+def _tree_fn(optimizer, mp_flags: Tuple[bool, ...], donate_argnums: bool):
+    # the jit variants live ON the optimizer (like its _step_cache) so they
+    # die with it — an external map would keep every optimizer alive via
+    # the tree_step closure below. Keys carry everything the closure reads
+    # from the optimizer at trace time (rescale/clip) plus the per-leaf mp
+    # layout and the donation mode; Optimizer.__getstate__ drops the cache.
+    key = (mp_flags, optimizer.rescale_grad, optimizer.clip_gradient,
+           donate_argnums)
+    per_opt = optimizer.__dict__.setdefault("_tree_cache", {})
+    fn = per_opt.get(key)
+    if fn is not None:
+        return fn
+
+    def tree_step(ws, gs, sts, ts, lrs, wds, extras):
+        new_ws: List[Any] = []
+        new_sts: List[Any] = []
+        for w, g, s, t, lr, wd, ex, mp in zip(
+                ws, gs, sts, ts, lrs, wds, extras, mp_flags):
+            if mp:
+                # fp16/bf16 weight: step the fp32 master, cast back — the
+                # traced twin of Optimizer.update_multi_precision
+                master, base = s
+                nm, nb = optimizer._leaf_step(
+                    master, g.astype(jnp.float32), base, t, lr, wd, *ex)
+                new_ws.append(nm.astype(w.dtype))
+                new_sts.append((nm, nb))
+            else:
+                nw, ns = optimizer._leaf_step(w, g, s, t, lr, wd, *ex)
+                new_ws.append(nw)
+                new_sts.append(ns)
+        return new_ws, new_sts
+
+    fn = jax.jit(tree_step,
+                 donate_argnums=(0, 2) if donate_argnums else ())
+    per_opt[key] = fn
+    return fn
+
+
+def _leaf_buffers(tree) -> List[Any]:
+    return [l for l in jax.tree_util.tree_leaves(tree)
+            if hasattr(l, "delete")]
+
+
+def _buf_ptr(b):
+    """Device buffer address, or None when unprobeable (already deleted,
+    multi-shard, backend without the probe). Identity must be judged by
+    buffer, not python object: XLA can alias two identical jit outputs
+    onto one buffer behind distinct jax.Array objects."""
+    try:
+        return b.unsafe_buffer_pointer()
+    except Exception:  # noqa: BLE001 - probe failure => caller plays safe
+        return None
+
+
+def _invalidate(buffers: Sequence[Any], keep_ptrs) -> None:
+    """Delete consumed device buffers so any stale handle raises a clear
+    'Array has been deleted' instead of reading reused memory. Idempotent
+    with real donation (the runtime already invalidated them)."""
+    for b in buffers:
+        if _buf_ptr(b) in keep_ptrs:  # None never collides: keep set is
+            continue                  # built from live probed buffers only
+        try:
+            b.delete()
+        except RuntimeError:
+            # already reclaimed by real donation — exactly the goal
+            continue
+
+
+def fused_apply(optimizer, indices, grads, weights, states):
+    """Apply ``optimizer`` to every parameter in ONE device dispatch.
+
+    Parameters
+    ----------
+    indices : per-parameter optimizer indices (lr/wd multiplier keys)
+    grads / weights : NDArrays, parallel to ``indices``
+    states : per-parameter optimizer state pytrees (entries from
+        ``create_state_multi_precision``; mp pairs are handled in-trace)
+
+    Returns the list of new states; weights are updated in place. The
+    host-side prologue (update counting, lr/wd multipliers, schedule
+    scalars) runs exactly as the per-parameter loop would — ``_leaf_step``
+    composed over the tree is the only thing that moved into one jit.
+    """
+    from . import donation_argnums_ok, donation_enabled
+
+    n = len(indices)
+    if not (n == len(grads) == len(weights) == len(states)):
+        raise FusedApplyError("fused_apply: ragged inputs")
+    if n == 0:
+        return []
+    if not getattr(optimizer, "fastpath_capable", False):
+        raise FusedApplyError(
+            "%s has no pure _leaf_step kernel; use the per-parameter path"
+            % type(optimizer).__name__)
+
+    ts, lrs, wds, extras, mp_flags = [], [], [], [], []
+    for i, w, s in zip(indices, weights, states):
+        optimizer._update_count(i)
+        lr, wd, ex = optimizer._host_scalars(i)
+        ts.append(_f32(optimizer._index_update_count[i]))
+        lrs.append(_f32(lr))
+        wds.append(_f32(wd))
+        extras.append(tuple(ex))
+        mp_flags.append(_is_mp_state(optimizer, i, w, s))
+
+    ws = [w._data for w in weights]
+    gs = [g._data for g in grads]
+
+    donate = donation_enabled()
+    consumed = _leaf_buffers(ws) + _leaf_buffers(states) if donate else []
+    # a buffer appearing twice among the donated args (e.g. DCASGD's
+    # `prev` state starts as the weight itself, or XLA aliased two
+    # identical previous-step outputs onto one buffer) cannot be donated
+    # twice; an unprobeable buffer disables donation conservatively
+    ptrs = [_buf_ptr(b) for b in consumed]
+    duplicated = None in ptrs or len(set(ptrs)) != len(ptrs)
+    argnums = not duplicated and donation_argnums_ok()
+
+    fn = _tree_fn(optimizer, tuple(mp_flags), argnums)
+    telemetry.OPT_DISPATCHES.inc(path="fused")
+    new_ws, new_sts = telemetry.jit_call(
+        "fastpath.fused_apply", fn, ws, gs, list(states), ts, lrs, wds,
+        extras)
+
+    for w, nw in zip(weights, new_ws):
+        w._data = nw
+    if donate and not duplicated:
+        keep = {p for p in map(_buf_ptr, _leaf_buffers(new_ws)
+                               + _leaf_buffers(new_sts)
+                               + _leaf_buffers(gs)) if p is not None}
+        _invalidate(consumed, keep)
+    return new_sts
+
+
+def apply_updater(updater, triples):
+    """Run an ``optimizer.Updater`` over many ``(index, grad, weight)``
+    triples in one fused dispatch — the drop-in replacement for the
+    ``for ...: updater(i, g, w)`` loop in Trainer/model/module. Creates
+    missing states exactly as ``Updater.__call__`` would."""
+    if not triples:
+        return
+    from ..optimizer import ensure_mp_state
+
+    opt = updater.optimizer
+    for index, _grad, weight in triples:
+        if index not in updater.states:
+            updater.states[index] = opt.create_state_multi_precision(
+                index, weight)
+            updater.states_synced[index] = True
+        else:
+            # restored states may predate the fp32-master layout for this
+            # weight dtype — migrate exactly as update_multi_precision does
+            updater.states[index] = ensure_mp_state(
+                opt, index, weight, updater.states[index])
+    indices = [t[0] for t in triples]
+    new_states = fused_apply(
+        opt, indices, [t[1] for t in triples], [t[2] for t in triples],
+        [updater.states[i] for i in indices])
+    for i, ns in zip(indices, new_states):
+        updater.states[i] = ns

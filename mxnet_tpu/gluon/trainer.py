@@ -161,15 +161,62 @@ class Trainer(object):
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        """Optimizer apply (reference trainer.py:_update).
+
+        With fastpath on, every parameter's update folds into ONE fused
+        dispatch per context (``fastpath.apply_updater``) instead of the
+        per-parameter re-zip over the updaters; ``MXNET_FASTPATH=0``
+        restores the legacy loop. Both paths honor the reference's
+        fresh-grad contract: a gradient not renewed by backward since the
+        last step raises unless ``ignore_stale_grad``, which instead skips
+        that parameter's update."""
+        from .. import fastpath
+
+        rows = []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
+            if not ignore_stale_grad:
+                for data in param.list_data():
+                    if not getattr(data, "_fresh_grad", True):
+                        raise UserWarning(
+                            "Gradient of Parameter `%s` on context %s has "
+                            "not been updated by backward since last "
+                            "`step`. This could mean a bug in your model "
+                            "that made it only use a subset of the "
+                            "Parameters for this iteration. If you are "
+                            "intentionally only using a subset, call "
+                            "step with ignore_stale_grad=True to suppress "
+                            "this warning and skip updating of Parameters "
+                            "with stale gradient"
+                            % (param.name, str(data.context)))
             if self._update_on_kvstore:
                 self._kvstore.pull(i, param.list_data(), priority=-i)
                 continue
+            rows.append((i, param))
+
+        if fastpath.enabled() and fastpath.supports(
+                self._optimizer, n_positions=len(self._updaters)):
+            for j, upd in enumerate(self._updaters):
+                triples = []
+                for i, param in rows:
+                    arr = param.list_data()[j]
+                    if ignore_stale_grad and \
+                            not getattr(arr, "_fresh_grad", True):
+                        continue
+                    triples.append((i, param.list_grad()[j], arr))
+                    arr._fresh_grad = False
+                fastpath.apply_updater(upd, triples)
+            return
+
+        for i, param in rows:
             for upd, arr, grad in zip(
                     self._updaters, param.list_data(), param.list_grad()):
+                if ignore_stale_grad and \
+                        not getattr(arr, "_fresh_grad", True):
+                    continue
                 upd(i, grad, arr)
+                arr._fresh_grad = False
 
     def save_states(self, fname):
         """Save optimizer/updater states (reference trainer.py:save_states)."""

@@ -55,6 +55,14 @@ def _key(k):
     return str(k)
 
 
+def _updater_key(k):
+    """Store keys are strings; updater state dicts key integer-named
+    parameters by int (reference updater semantics). ONE home for the
+    normalization — a site that diverged would silently fork a parameter's
+    optimizer state across two dict entries."""
+    return int(k) if k.isdigit() else k
+
+
 class _TwoBitCompression(object):
     """2-bit stochastic quantization with error-feedback residual
     (reference gradient_compression.h:52-134)."""
@@ -130,8 +138,7 @@ class KVStore(object):
                     agg = self._compression.compress(k, agg)
                 if self._updater is not None:
                     grad = NDArray(agg, vals[0].context)
-                    self._updater(int(k) if k.isdigit() else k, grad,
-                                  self._store[k])
+                    self._updater(_updater_key(k), grad, self._store[k])
                 else:
                     self._store[k]._data = agg
 
@@ -186,12 +193,148 @@ class KVStore(object):
             acc = acc + d
         return acc
 
+    def _reduce_multi(self, groups: List[List[Any]]):
+        """Reduce many keys' copy lists; the host store reduces key by key
+        (the TPU store overrides with one fused XLA module)."""
+        return [self._reduce(g) for g in groups]
+
+    def _aggregate_multi(self, groups: List[List[Any]]):
+        """The pure aggregate phase of ``pushpull_multi``: reduce every
+        key's per-device copies, with small same-dtype gradients coalesced
+        into flat contiguous buckets first (``MXNET_KVSTORE_BUCKET_MB``,
+        DDP-style — fastpath.bucketing) so the reduce runs over a handful
+        of large buffers instead of a long tail of tiny ones. Pure over the
+        inputs — the caller's retry policy re-runs it transparently, and
+        bucketed sums are bit-identical to unbucketed ones (summation is
+        elementwise)."""
+        n_copies = len(groups[0]) if groups else 0
+        bucketable = (len(groups) > 1
+                      and all(len(g) == n_copies for g in groups)
+                      and (n_copies > 1 or jax.process_count() > 1))
+        if bucketable:
+            # concat needs every copy-position's leaves on one device
+            for j in range(n_copies):
+                devs = set()
+                for g in groups:
+                    ds = g[j].devices() if hasattr(g[j], "devices") else None
+                    if not ds or len(ds) != 1:
+                        bucketable = False
+                        break
+                    devs |= ds
+                if not bucketable or len(devs) != 1:
+                    bucketable = False
+                    break
+        plan = None
+        if bucketable:
+            from .fastpath import bucketing
+
+            plan = bucketing.plan_for([g[0] for g in groups])
+        if plan is None:
+            return self._reduce_multi(groups)
+        packed = [plan.pack([g[j] for g in groups]) for j in range(n_copies)]
+        slot_groups = [[packed[j][s] for j in range(n_copies)]
+                       for s in range(plan.n_out)]
+        return plan.unpack(self._reduce_multi(slot_groups))
+
     def _to_store_sharding(self, agg, ref):
         """Reconcile the reduced gradient's placement with the stored value's
         so the subsequent combine is a single-sharding jit (no-op here; the
         TPU store overrides it — its allreduce output is replicated over all
         participating devices while the store entry is single-device)."""
         return agg
+
+    def _commit_pull(self, total, dst):
+        """Write one reduced value into one out buffer (the TPU store
+        overrides to hand each destination its device-resident replica)."""
+        dst._data = total
+
+    def pushpull_multi(self, keys, value_lists, out_lists):
+        """Fused push+pull over MANY keys: one retried pure aggregate phase
+        reduces every key's per-device copies (bucketed —
+        ``_aggregate_multi``), then the commit phase replaces the store
+        entries and fills the out buffers. This is the Trainer/Module fast
+        path — the answer to the reference's batched NCCL push/pull
+        (kvstore_nccl.h:285) without per-key dispatch; on the host store it
+        collapses ``2 × n_params`` push/pull calls into one batched
+        exchange.
+
+        Not valid with a server-side updater or gradient compression (both
+        are per-key transformations); callers fall back to push/pull then
+        (``_can_fuse_pushpull``), or to :meth:`pushpull_update_multi` for
+        the updater case.
+        """
+        assert self._updater is None and self._compression is None
+        _T_OPS.inc(op="pushpull_multi")
+        with telemetry.span("kvstore.pushpull_multi", "kvstore"):
+            norm = self._norm_multi(keys, value_lists)
+
+            # the fused aggregate is the collective phase: pure over the
+            # gradient copies, so a transient ICI/DCN fault (or injected
+            # chaos) re-runs it; store/out commits follow outside the retry
+            def attempt():
+                chaos.maybe_fail("kvstore.pushpull")
+                return self._aggregate_multi([[x._data for x in v]
+                                              for _, v in norm])
+
+            totals = resilience.call("kvstore.pushpull", attempt)
+            for (kk, _), total, o in zip(norm, totals, out_lists):
+                self._store[kk]._data = self._to_store_sharding(
+                    total, self._store[kk]._data)
+                outs = o if isinstance(o, (list, tuple)) else [o]
+                for dst in outs:
+                    self._commit_pull(total, dst)
+
+    def pushpull_update_multi(self, keys, grad_lists, weight_lists):
+        """Fused push(grad) → server-side update → pull(weight) over MANY
+        keys — the batched ``update_on_kvstore`` exchange behind
+        ``model._update_params_on_kvstore``. One retried pure aggregate
+        phase reduces every key's gradient copies (bucketed); the commit
+        applies the store's updater to ALL keys in one fused optimizer
+        dispatch (``fastpath.apply_updater`` — legacy per-key loop when
+        fastpath is off or the optimizer lacks a pure kernel) and fills the
+        weight out-buffers from the updated store. The updater/store
+        mutations stay OUTSIDE the retry, preserving the PR-4 exactly-once
+        commit structure."""
+        assert self._updater is not None and self._compression is None
+        from . import fastpath
+
+        _T_OPS.inc(op="pushpull_update_multi")
+        with telemetry.span("kvstore.pushpull_update_multi", "kvstore"):
+            norm = self._norm_multi(keys, grad_lists)
+
+            def attempt():
+                chaos.maybe_fail("kvstore.pushpull")
+                return self._aggregate_multi([[x._data for x in v]
+                                              for _, v in norm])
+
+            totals = resilience.call("kvstore.pushpull", attempt)
+            triples = []
+            for (kk, v), total in zip(norm, totals):
+                agg = self._to_store_sharding(total, self._store[kk]._data)
+                triples.append((_updater_key(kk),
+                                NDArray(agg, v[0].context), self._store[kk]))
+            # _set_updater accepts any callable; only a real opt.Updater
+            # (with .optimizer/.states) can take the fused dispatch
+            opt_obj = getattr(self._updater, "optimizer", None)
+            if opt_obj is not None and fastpath.enabled() and \
+                    fastpath.supports(opt_obj):
+                fastpath.apply_updater(self._updater, triples)
+            else:
+                for idx, g, w in triples:
+                    self._updater(idx, g, w)
+            for (kk, _), o in zip(norm, weight_lists):
+                outs = o if isinstance(o, (list, tuple)) else [o]
+                for dst in outs:
+                    self._commit_pull(self._store[kk]._data, dst)
+
+    def _norm_multi(self, keys, value_lists):
+        norm = []
+        for k, v in zip(keys, value_lists):
+            kk = _key(k)
+            if kk not in self._store:
+                raise MXNetError("key %s has not been initialized" % kk)
+            norm.append((kk, v if isinstance(v, (list, tuple)) else [v]))
+        return norm
 
     # ------------------------------------------------------------------
     def set_optimizer(self, optimizer):
@@ -207,8 +350,14 @@ class KVStore(object):
     def _can_fuse_pushpull(self):
         """Whether callers may use the batched ``pushpull_multi`` fast path;
         mirrors that method's preconditions (updater and compression are
-        per-key transformations)."""
-        return (self._updater is None and self._compression is None
+        per-key transformations). ``MXNET_FASTPATH=0`` gates this too: the
+        escape hatch must restore the whole legacy exchange plane (per-key
+        push/pull), not just the update loops, so a suspected regression in
+        the batched path can actually be ruled out."""
+        from . import fastpath
+
+        return (fastpath.enabled()
+                and self._updater is None and self._compression is None
                 and hasattr(self, "pushpull_multi"))
 
     def set_gradient_compression(self, compression_params):
@@ -308,7 +457,7 @@ class KVStoreTPU(KVStore):
                     g = resilience.call("kvstore.push", attempt)
                     if self._compression is not None:
                         g = self._compression.compress(k, g)
-                    self._updater(int(k) if k.isdigit() else k,
+                    self._updater(_updater_key(k),
                                   NDArray(g, x.context), self._store[k])
 
     @property
@@ -349,52 +498,28 @@ class KVStoreTPU(KVStore):
             return parallel.shard_for_device(agg, next(iter(ref_devs)))
         return jax.device_put(agg, ref.sharding)
 
-    def pushpull_multi(self, keys, value_lists, out_lists):
-        """Fused push+pull over MANY keys: every key's per-device copies are
-        reduced inside ONE compiled XLA module (parallel.all_reduce_multi),
-        the reduced value replaces the store entry, and each out buffer gets
-        the replica already resident on its device (zero-copy). This is the
-        Trainer/Module fast path — the TPU answer to the reference's batched
-        NCCL push/pull (kvstore_nccl.h:285) without per-key dispatch.
-
-        Not valid with a server-side updater or gradient compression (both
-        are per-key transformations); callers fall back to push/pull then.
-        """
-        assert self._updater is None and self._compression is None
+    def _reduce_multi(self, groups: List[List[Any]]):
+        """Every key's (or bucket's) copies reduce inside ONE compiled XLA
+        module (parallel.all_reduce_multi) — the TPU answer to the
+        reference's batched NCCL key grouping (kvstore_nccl.h:285)."""
         from . import parallel
 
-        _T_OPS.inc(op="pushpull_multi")
-        with telemetry.span("kvstore.pushpull_multi", "kvstore"):
-            norm = []
-            for k, v in zip(keys, value_lists):
-                kk = _key(k)
-                if kk not in self._store:
-                    raise MXNetError("key %s has not been initialized" % kk)
-                norm.append((kk, v if isinstance(v, (list, tuple)) else [v]))
+        return parallel.all_reduce_multi(groups)
 
-            # the fused allreduce is the collective phase: pure over the
-            # gradient copies, so a transient ICI/DCN fault (or injected
-            # chaos) re-runs it; store/out commits follow outside the retry
-            def attempt():
-                chaos.maybe_fail("kvstore.pushpull")
-                return parallel.all_reduce_multi([[x._data for x in v]
-                                                  for _, v in norm])
+    def _commit_pull(self, total, dst):
+        """Each out buffer gets the replica already resident on its device
+        (zero-copy extraction from the replicated allreduce output)."""
+        from . import parallel
 
-            totals = resilience.call("kvstore.pushpull", attempt)
-            for (kk, _), total, o in zip(norm, totals, out_lists):
-                self._store[kk]._data = self._to_store_sharding(
-                    total, self._store[kk]._data)
-                outs = o if isinstance(o, (list, tuple)) else [o]
-                for dst in outs:
-                    dst_devs = dst._data.devices() \
-                        if hasattr(dst._data, "devices") else None
-                    if dst_devs and len(dst_devs) == 1 \
-                            and hasattr(total, "devices") \
-                            and dst_devs != total.devices():
-                        dst._data = parallel.shard_for_device(
-                            total, next(iter(dst_devs)))
-                    else:
-                        dst._data = total
+        dst_devs = dst._data.devices() \
+            if hasattr(dst._data, "devices") else None
+        if dst_devs and len(dst_devs) == 1 \
+                and hasattr(total, "devices") \
+                and dst_devs != total.devices():
+            dst._data = parallel.shard_for_device(
+                total, next(iter(dst_devs)))
+        else:
+            dst._data = total
 
     def _barrier(self):
         """Block until all local work completes (reference

@@ -46,25 +46,70 @@ def _create_kvstore(kvstore, num_device, arg_params):
 
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
-    """push grad → pull weight (reference model.py:145-155)."""
+    """push grad → server update → pull weight (reference model.py:145-155).
+
+    With fastpath on and a server-side updater set, every key batches
+    through ONE ``kvstore.pushpull_update_multi`` exchange (one retried
+    aggregate phase + one fused optimizer dispatch) instead of a per-key
+    push/pull pair; ``MXNET_FASTPATH=0`` restores the loop."""
+    from . import fastpath
+
+    entries = []
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:
             continue
+        entries.append((index, arg_list, grad_list))
+    if (fastpath.enabled() and getattr(kvstore, "_updater", None) is not None
+            and getattr(kvstore, "_compression", None) is None
+            and hasattr(kvstore, "pushpull_update_multi")):
+        kvstore.pushpull_update_multi(
+            [i for i, _, _ in entries],
+            [g for _, _, g in entries],
+            [a for _, a, _ in entries])
+        return
+    for index, arg_list, grad_list in entries:
         kvstore.push(index, grad_list, priority=-index)
         kvstore.pull(index, arg_list, priority=-index)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None,
                    param_names=None):
+    """kvstore reduce (optional) + host-side updater (reference
+    model.py:_update_params). Fastpath: the gradient exchange fuses into
+    one ``pushpull_multi`` and the updater applies once per device position
+    over the whole parameter tree (``fastpath.apply_updater``) instead of
+    one jitted call per parameter."""
+    from . import fastpath
+    from . import optimizer as opt_mod
+
+    entries = []
     for i, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:
             continue
-        index = i
-        if kvstore:
-            kvstore.push(index, grad_list, priority=-index)
-            kvstore.pull(index, grad_list, priority=-index)
+        entries.append((i, arg_list, grad_list))
+    if kvstore:
+        if kvstore._can_fuse_pushpull():
+            grad_lists = [g for _, _, g in entries]
+            kvstore.pushpull_multi([i for i, _, _ in entries],
+                                   grad_lists, grad_lists)
+        else:
+            for index, _, grad_list in entries:
+                kvstore.push(index, grad_list, priority=-index)
+                kvstore.pull(index, grad_list, priority=-index)
+    n_pos = max((len(a) for _, a, _ in entries), default=1)
+    if (fastpath.enabled() and isinstance(updater, opt_mod.Updater)
+            and fastpath.supports(updater.optimizer, n_positions=n_pos)):
+        by_pos = {}
+        for index, arg_list, grad_list in entries:
+            for k, (w, g) in enumerate(zip(arg_list, grad_list)):
+                by_pos.setdefault(k, []).append(
+                    (index * num_device + k, g, w))
+        for k in sorted(by_pos):
+            fastpath.apply_updater(updater, by_pos[k])
+        return
+    for index, arg_list, grad_list in entries:
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
             updater(index * num_device + k, g, w)

@@ -303,6 +303,24 @@ class Module(BaseModule):
                     grads = [g for _, g in pairs]
                     kv.push(idx, grads, priority=-idx)
                     kv.pull(idx, grads, priority=-idx)
+        from .. import fastpath
+        from .. import optimizer as opt_mod
+
+        n_pos = max((len(pairs) for _, _, pairs in entries), default=1)
+        if (fastpath.enabled() and isinstance(self._updater, opt_mod.Updater)
+                and fastpath.supports(self._updater.optimizer,
+                                      n_positions=n_pos)):
+            # fastpath: ONE fused optimizer dispatch per executor position
+            # over the whole parameter tree (per-exec grouping keeps each
+            # call's indices unique — replicas of a param share state)
+            by_pos = {}
+            for idx, name, pairs in entries:
+                for k, (e, g) in enumerate(pairs):
+                    by_pos.setdefault(k, []).append(
+                        (idx, g, e.arg_dict[name]))
+            for k in sorted(by_pos):
+                fastpath.apply_updater(self._updater, by_pos[k])
+            return
         for idx, name, pairs in entries:
             for e, g in pairs:
                 self._updater(idx, g, e.arg_dict[name])

@@ -28,7 +28,8 @@ __all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty", "aran
 
 
 class NDArray:
-    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_entry", "_marked", "__weakref__")
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_entry", "_marked",
+                 "_fresh_grad", "__weakref__")
 
     def __init__(self, data, ctx: Optional[Context] = None):
         if isinstance(data, NDArray):
@@ -210,6 +211,10 @@ class NDArray:
         self._grad_req = grad_req
         self._entry = None  # attaching grad detaches from any recorded graph
         self._grad = NDArray(jnp.zeros_like(self._data), self._ctx)
+        # reference fresh_grad starts False: a leaf no backward has reached
+        # yet is stale, so Trainer's ignore_stale_grad contract holds from
+        # the FIRST step (autograd.backward flips it True)
+        self._fresh_grad = False
 
     def detach(self) -> "NDArray":
         out = NDArray(self._data, self._ctx)

@@ -7,12 +7,23 @@ state (de)serialization).
 
 TPU-native design: the reference accelerates updates with hand-fused CUDA ops
 (reference ``src/operator/optimizer_op.cc`` — sgd_mom_update, adam_update, …).
-Here every optimizer expresses its update as a *pure jax function*
-``step(weight, grad, *state, lr, wd) -> (new_weight, *new_state)`` which is
-``jax.jit``-compiled once per parameter shape — XLA fuses the whole update
-chain (rescale → clip → wd → momentum → assign) into one kernel, the direct
-equivalent of the reference's fused ops. lr/wd enter as traced scalars so LR
-schedules never trigger recompilation.
+Here every optimizer is split into two pieces:
+
+* a host-side scalar prologue :meth:`Optimizer._host_scalars` — per-index
+  lr/wd multipliers plus any schedule transform computed in python (Adam's
+  bias correction, Nadam's momentum schedule);
+* a pure per-parameter kernel :meth:`Optimizer._leaf_step`
+  ``(w, g, state, t, lr, wd, *extras) -> (new_w, new_state)`` on jax arrays
+  only.
+
+The generic :meth:`Optimizer.update` jits the kernel once per optimizer
+(lr/wd/t enter as traced scalars, so LR schedules never retrace) — XLA fuses
+the whole rescale → clip → wd → momentum → assign chain into one kernel, the
+direct equivalent of the reference's fused ops. The SAME kernel is what
+``mxnet_tpu.fastpath`` composes over the whole parameter tree (ONE jit per
+step instead of one per parameter) and — where the math permits — what
+``parallel.TrainStep`` traces in-graph, so the three update paths cannot
+drift apart numerically: they are one function traced in three places.
 """
 from __future__ import annotations
 
@@ -24,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import telemetry as _telemetry
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
 
@@ -43,13 +55,68 @@ def _f32(x):
     return jnp.asarray(x, dtype=jnp.float32)
 
 
+def _is_mp_dtype(dtype):
+    """Dtypes that keep an fp32 master copy under ``multi_precision``:
+    float16 (reference mp_sgd_update) and bfloat16 (the TPU-native low
+    precision — same master-weight rationale, MXU-rate storage)."""
+    return dtype == np.float16 or dtype == jnp.bfloat16
+
+
+def _base_state_structure(optimizer, index, weight):
+    """Pytree structure of ``create_state`` for this weight, without
+    allocating (eval_shape); cached per (shape, dtype) on the instance."""
+    cache = optimizer.__dict__.setdefault("_state_struct_cache", {})
+    key = (tuple(weight.shape), str(_as_jax(weight).dtype))
+    if key not in cache:
+        cache[key] = jax.tree_util.tree_structure(jax.eval_shape(
+            lambda: optimizer.create_state(index, weight)))
+    return cache[key]
+
+
+def _is_mp_pair(optimizer, index, weight, state):
+    """Whether ``state`` is an ``(fp32 master, base_state)`` pair for this
+    weight — the layout ``create_state_multi_precision`` produces.
+
+    A structural dtype/shape test alone is ambiguous: Adam-family plain
+    states are ALSO 2-tuples of fp32 weight-shaped arrays, and treating a
+    resumed ``(m, v)`` as ``(master, base)`` would silently install the
+    first moment as the weight. Disambiguation: in a true pair the SECOND
+    element has ``create_state``'s pytree structure while the whole state
+    does not."""
+    if not (isinstance(state, tuple) and len(state) == 2
+            and getattr(state[0], "dtype", None) == jnp.float32
+            and getattr(state[0], "shape", None) == tuple(weight.shape)):
+        return False
+    expected = _base_state_structure(optimizer, index, weight)
+    whole = jax.tree_util.tree_structure(state)
+    second = jax.tree_util.tree_structure(state[1])
+    if whole == expected and second != expected:
+        return False  # the state IS a plain create_state tuple (Adam (m,v))
+    return second == expected
+
+
+def ensure_mp_state(optimizer, index, weight, state):
+    """Adopt the fp32-master layout for a low-precision weight whose state
+    predates it (e.g. a bf16 optimizer checkpoint saved before
+    ``multi_precision`` covered bfloat16, when bf16 silently took the
+    non-master branch, or an fp32 run resumed onto bf16-cast weights): the
+    current weight becomes the master, the loaded state stays as the base.
+    No-op when mp doesn't apply or the state is already a pair."""
+    if not (optimizer.multi_precision and _is_mp_dtype(weight.dtype)):
+        return state
+    if _is_mp_pair(optimizer, index, weight, state):
+        return state
+    return (jnp.asarray(_as_jax(weight), dtype=jnp.float32), state)
+
+
 class Optimizer(object):
     """Base optimizer (reference optimizer.py:35).
 
-    Subclasses implement :meth:`create_state` and a pure :meth:`_step`
-    returning ``(new_weight, new_states)``; the base class handles registry,
-    per-index lr/wd multipliers, update counting, gradient rescale/clip, and
-    jit caching.
+    Subclasses implement :meth:`create_state` and the pure
+    :meth:`_leaf_step` kernel (plus :meth:`_host_scalars` when the update
+    needs host-computed schedule scalars); the base class handles registry,
+    per-index lr/wd multipliers, update counting, jit caching and the
+    generic :meth:`update` dispatch.
     """
 
     opt_registry: Dict[str, type] = {}
@@ -102,38 +169,96 @@ class Optimizer(object):
         return None
 
     def create_state_multi_precision(self, index, weight):
-        """fp16 weights get an fp32 master copy (reference
+        """fp16/bf16 weights get an fp32 master copy (reference
         create_state_multi_precision; mp_sgd_update parity)."""
         weight_master_copy = None
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and _is_mp_dtype(weight.dtype):
             weight_master_copy = jnp.asarray(_as_jax(weight), dtype=jnp.float32)
             return (weight_master_copy, self.create_state(index, weight))
         return self.create_state(index, weight)
 
+    # ------------------------------------------------------------------
+    # the update protocol: host scalar prologue + pure per-leaf kernel
+    # ------------------------------------------------------------------
+    def _host_scalars(self, index):
+        """Host-side scalar prologue for one parameter's update, run AFTER
+        :meth:`_update_count`: returns ``(lr, wd, extras)``. ``lr`` carries
+        any host-computed schedule transform (Adam's bias correction,
+        Adamax's warmup divisor); ``extras`` are additional traced operands
+        :meth:`_leaf_step` consumes (Nadam's momentum schedule, SGLD's rng
+        key). Shared verbatim by the per-parameter path and the fastpath
+        fused tree-apply, so the two stay bit-identical."""
+        return self._get_lr(index), self._get_wd(index), ()
+
+    def _leaf_step(self, w, g, state, t, lr, wd, *extras):
+        """Pure per-parameter kernel on jax arrays:
+        ``(new_weight, new_state)``. ``t`` is the traced 1-based update
+        count of this index; ``lr``/``wd`` come from :meth:`_host_scalars`.
+        Traced by :meth:`update` (one jit per parameter), by
+        ``fastpath.fused_apply`` (one jit per tree) and — via
+        :meth:`pure_step` where aliased — by the in-graph SPMD step."""
+        raise NotImplementedError(
+            "%s does not implement _leaf_step" % self.__class__.__name__)
+
+    #: True when :meth:`_host_scalars` mutates optimizer state or consumes
+    #: a host stream (Nadam's ``m_schedule`` recurrence, SGLD's rng keys):
+    #: its call ORDER is then observable, so the fused path must preserve
+    #: the legacy param-outer/device-inner ordering — with multiple device
+    #: positions it cannot, and ``fastpath.supports`` falls back.
+    _host_scalars_stateful = False
+
+    @property
+    def fastpath_capable(self):
+        """Whether ``fastpath.fused_apply`` can fold this optimizer's whole
+        update into one tree-level jit."""
+        return type(self)._leaf_step is not Optimizer._leaf_step
+
     def update(self, index, weight, grad, state):
-        raise NotImplementedError()
+        """Apply one parameter's update (reference optimizer.py:update).
+
+        Generic over the protocol above: bookkeeping + host scalars, then
+        ONE jitted fused kernel per optimizer class (cached across
+        parameters and steps; lr/wd/t are traced operands)."""
+        if not self.fastpath_capable:
+            raise NotImplementedError()
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd, extras = self._host_scalars(index)
+
+        def step(w, g, s, t, lr, wd, *ex):
+            return self._leaf_step(w, g, s, t, lr, wd, *ex)
+
+        _telemetry.OPT_DISPATCHES.inc(path="perparam")
+        new_w, new_state = self._fused(type(self).__name__, step)(
+            _as_jax(weight), _as_jax(grad), state, _f32(t), _f32(lr),
+            _f32(wd), *extras)
+        weight._data = new_w
+        return new_state
 
     def pure_step(self, w, g, state, t, lr, wd):
         """Pure functional update used by the in-graph SPMD training step
         (``mxnet_tpu.parallel.TrainStep``): returns ``(new_w, new_state)``
         from jax arrays only. ``t`` is the traced 1-based update count so
         bias-corrected optimizers (Adam family) compile once and stay
-        correct on every step. Subclasses implementing ``update`` via a
-        pure inner kernel override this with the same math."""
+        correct on every step. Optimizers whose kernel needs no host-side
+        schedule work alias this to :meth:`_leaf_step`; the Adam family
+        overrides it with the bias correction traced on-device."""
         raise MXNetError(
             "%s does not implement pure_step; it cannot be fused into an "
             "SPMD train step — use Trainer/Updater instead"
             % self.__class__.__name__)
 
     def update_multi_precision(self, index, weight, grad, state):
-        """fp16 weights: run the update on the fp32 master copy, then cast
-        back (reference mp_sgd_update semantics). Returns the new state."""
-        if self.multi_precision and weight.dtype == np.float16:
+        """fp16/bf16 weights: run the update on the fp32 master copy, then
+        cast back (reference mp_sgd_update semantics). Returns the new
+        state."""
+        if self.multi_precision and _is_mp_dtype(weight.dtype):
+            state = ensure_mp_state(self, index, weight, state)
             master, base_state = state
             g32 = NDArray(jnp.asarray(_as_jax(grad), jnp.float32), weight._ctx)
             w32 = NDArray(master, weight._ctx)
             new_base = self.update(index, w32, g32, base_state)
-            weight._data = jnp.asarray(w32._data, dtype=jnp.float16)
+            weight._data = jnp.asarray(w32._data, dtype=_as_jax(weight).dtype)
             return (w32._data, new_base if new_base is not None else base_state)
         new_state = self.update(index, weight, grad, state)
         return new_state if new_state is not None else state
@@ -240,7 +365,9 @@ class Optimizer(object):
         rescale_grad/clip_gradient are read by the step closures at trace
         time, so they are part of the cache key: Trainer.step() mutates
         rescale_grad per batch size, and a changed value must retrace rather
-        than silently reuse the first-traced constant."""
+        than silently reuse the first-traced constant. (State-structure
+        variants — momentum on/off, centered RMSProp — need no key of their
+        own: jax.jit retraces per input pytree structure.)"""
         key = (key, self.rescale_grad, self.clip_gradient)
         if key not in self._step_cache:
             self._step_cache[key] = jax.jit(fn)
@@ -249,6 +376,8 @@ class Optimizer(object):
     def __getstate__(self):
         st = self.__dict__.copy()
         st["_step_cache"] = {}
+        st.pop("_tree_cache", None)  # fastpath jit variants (fused.py)
+        st.pop("_state_struct_cache", None)
         return st
 
 
@@ -267,9 +396,10 @@ class Test(Optimizer):
     def create_state(self, index, weight):
         return jnp.zeros_like(_as_jax(weight))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        weight._data = _as_jax(weight) - self.learning_rate * _as_jax(grad) * self.rescale_grad
+    def _leaf_step(self, w, g, state, t, lr, wd):
+        return w - lr * g * self.rescale_grad, state
+
+    pure_step = _leaf_step
 
 
 @register
@@ -287,30 +417,14 @@ class SGD(Optimizer):
             return None
         return jnp.zeros_like(_as_jax(weight))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = _f32(self._get_lr(index))
-        wd = _f32(self._get_wd(index))
-        w, g = _as_jax(weight), _as_jax(grad)
-        if state is None:
-            def step(w, g, lr, wd):
-                g = self._preprocess(g, w, wd)
-                return w - lr * g
-            weight._data = self._fused("sgd", step)(w, g, lr, wd)
-        else:
-            def step(w, g, m, lr, wd):
-                g = self._preprocess(g, w, wd)
-                m = self.momentum * m - lr * g
-                return w + m, m
-            weight._data, new_m = self._fused("sgd_mom", step)(w, g, _as_jax(state), lr, wd)
-            return new_m
-
-    def pure_step(self, w, g, state, t, lr, wd):
+    def _leaf_step(self, w, g, state, t, lr, wd):
         g = self._preprocess(g, w, wd)
-        if self.momentum == 0.0:
-            return w - lr * g, state
+        if state is None:
+            return w - lr * g, None
         m = self.momentum * state - lr * g
         return w + m, m
+
+    pure_step = _leaf_step
 
 
 @register
@@ -331,75 +445,49 @@ class NAG(Optimizer):
             return None
         return jnp.zeros_like(_as_jax(weight))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = _f32(self._get_lr(index))
-        wd = _f32(self._get_wd(index))
-        w, g = _as_jax(weight), _as_jax(grad)
-        if state is None:
-            def step(w, g, lr, wd):
-                g = self._preprocess(g, w, wd)
-                return w - lr * g
-            weight._data = self._fused("nag0", step)(w, g, lr, wd)
-        else:
-            def step(w, g, m, lr, wd):
-                g = self._preprocess(g, w, wd)
-                m = self.momentum * m + g
-                g2 = self.momentum * m + g
-                return w - lr * g2, m
-            weight._data, new_m = self._fused("nag", step)(w, g, _as_jax(state), lr, wd)
-            return new_m
-
-    def pure_step(self, w, g, state, t, lr, wd):
+    def _leaf_step(self, w, g, state, t, lr, wd):
         g = self._preprocess(g, w, wd)
-        if self.momentum == 0.0:
-            return w - lr * g, state
+        if state is None:
+            return w - lr * g, None
         m = self.momentum * state + g
         return w - lr * (self.momentum * m + g), m
+
+    pure_step = _leaf_step
 
 
 @register
 class SGLD(Optimizer):
-    """Stochastic gradient Langevin dynamics (reference optimizer.py:SGLD)."""
+    """Stochastic gradient Langevin dynamics (reference optimizer.py:SGLD).
+    The injected-noise key is drawn on the host per update (one
+    ``_global.next_key()`` per parameter per step, the same stream the
+    per-parameter path always consumed) and enters the kernel as a traced
+    extra."""
 
-    def update(self, index, weight, grad, state):
+    _host_scalars_stateful = True  # consumes the host rng stream in order
+
+    def _host_scalars(self, index):
         from . import _global
 
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = _f32(self._get_wd(index))
-        w, g = _as_jax(weight), _as_jax(grad)
+        return (self._get_lr(index), self._get_wd(index),
+                (_global.next_key(),))
 
-        def step(w, g, key, lr, wd):
-            g = self._preprocess(g, w, wd)
-            noise = jax.random.normal(key, w.shape, dtype=w.dtype) * jnp.sqrt(lr)
-            return w - lr / 2 * g + noise
-
-        weight._data = self._fused("sgld", step)(w, g, _global.next_key(), _f32(lr), wd)
+    def _leaf_step(self, w, g, state, t, lr, wd, key):
+        g = self._preprocess(g, w, wd)
+        noise = jax.random.normal(key, w.shape, dtype=w.dtype) * jnp.sqrt(lr)
+        return w - lr / 2 * g + noise, state
 
 
 @register
 class SignSGD(Optimizer):
     """Take the sign of the gradient (reference optimizer.py:Signum family)."""
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = _f32(self._get_lr(index))
-        wd = _f32(self._get_wd(index))
-
-        def step(w, g, lr, wd):
-            g = g * self.rescale_grad
-            if self.clip_gradient is not None:
-                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-            return w - lr * (jnp.sign(g) + wd * w)
-
-        weight._data = self._fused("signsgd", step)(_as_jax(weight), _as_jax(grad), lr, wd)
-
-    def pure_step(self, w, g, state, t, lr, wd):
+    def _leaf_step(self, w, g, state, t, lr, wd):
         g = g * self.rescale_grad
         if self.clip_gradient is not None:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
         return w - lr * (jnp.sign(g) + wd * w), state
+
+    pure_step = _leaf_step
 
 
 @register
@@ -416,35 +504,17 @@ class Signum(Optimizer):
             return None
         return jnp.zeros_like(_as_jax(weight))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = _f32(self._get_lr(index))
-        wd = _f32(self._get_wd(index))
-        w, g = _as_jax(weight), _as_jax(grad)
+    def _leaf_step(self, w, g, state, t, lr, wd):
         if state is None:
-            def step(w, g, lr, wd):
-                g = g * self.rescale_grad
-                if self.clip_gradient is not None:
-                    g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-                return w - lr * (jnp.sign(g) + wd * w)
-            weight._data = self._fused("signsgd", step)(w, g, lr, wd)
-        else:
-            def step(w, g, m, lr, wd):
-                g = self._preprocess(g, w, wd)
-                m = self.momentum * m - (1 - self.momentum) * g
-                return w + lr * jnp.sign(m) - lr * self.wd_lh * w, m
-            weight._data, new_m = self._fused("signum", step)(w, g, _as_jax(state), lr, wd)
-            return new_m
-
-    def pure_step(self, w, g, state, t, lr, wd):
-        if self.momentum == 0.0:
             g = g * self.rescale_grad
             if self.clip_gradient is not None:
                 g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-            return w - lr * (jnp.sign(g) + wd * w), state
+            return w - lr * (jnp.sign(g) + wd * w), None
         g = self._preprocess(g, w, wd)
         m = self.momentum * state - (1 - self.momentum) * g
         return w + lr * jnp.sign(m) - lr * self.wd_lh * w, m
+
+    pure_step = _leaf_step
 
 
 @register
@@ -461,28 +531,19 @@ class FTML(Optimizer):
         w = _as_jax(weight)
         return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros_like(w))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
-        lr = _f32(self._get_lr(index))
-        wd = _f32(self._get_wd(index))
+    def _leaf_step(self, w, g, state, t, lr, wd):
         b1, b2, eps = self.beta1, self.beta2, self.epsilon
-
-        def step(w, g, d, v, z, lr, wd, t):
-            g = self._preprocess_wd_in_clip(g, w, wd)
-            v = b2 * v + (1 - b2) * g * g
-            bc1 = 1 - jnp.power(b1, t)
-            bc2 = 1 - jnp.power(b2, t)
-            d_t = bc1 / lr * (jnp.sqrt(v / bc2) + eps)
-            sigma = d_t - b1 * d
-            z = b1 * z + (1 - b1) * g - sigma * w
-            return -z / d_t, d_t, v, z
-
         d, v, z = state
-        new_w, d, v, z = self._fused("ftml", step)(
-            _as_jax(weight), _as_jax(grad), d, v, z, lr, wd, _f32(t))
-        weight._data = new_w
-        return (d, v, z)
+        g = self._preprocess_wd_in_clip(g, w, wd)
+        v = b2 * v + (1 - b2) * g * g
+        bc1 = 1 - jnp.power(b1, t)
+        bc2 = 1 - jnp.power(b2, t)
+        d_t = bc1 / lr * (jnp.sqrt(v / bc2) + eps)
+        sigma = d_t - b1 * d
+        z = b1 * z + (1 - b1) * g - sigma * w
+        return -z / d_t, (d_t, v, z)
+
+    pure_step = _leaf_step
 
 
 @register
@@ -501,31 +562,17 @@ class DCASGD(Optimizer):
             return (None, jnp.asarray(w))
         return (jnp.zeros_like(w), jnp.asarray(w))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = _f32(self._get_lr(index))
-        wd = _f32(self._get_wd(index))
+    def _leaf_step(self, w, g, state, t, lr, wd):
         mom, prev = state
-        w, g = _as_jax(weight), _as_jax(grad)
-
+        g = self._preprocess_no_wd(g)
         if mom is None:
-            def step(w, g, prev, lr, wd):
-                g = self._preprocess_no_wd(g)
-                upd = -lr * (g + wd * w + self.lamda * g * g * (w - prev))
-                return w + upd, w
-            new_w, new_prev = self._fused("dcasgd0", step)(w, g, prev, lr, wd)
-            weight._data = new_w
-            return (None, new_prev)
+            upd = -lr * (g + wd * w + self.lamda * g * g * (w - prev))
+            return w + upd, (None, w)
+        m = self.momentum * mom - lr * (
+            g + wd * w + self.lamda * g * g * (w - prev))
+        return w + m, (m, w)
 
-        def step(w, g, m, prev, lr, wd):
-            g = self._preprocess_no_wd(g)
-            m = self.momentum * m - lr * (
-                g + wd * w + self.lamda * g * g * (w - prev))
-            return w + m, m, w
-
-        new_w, new_m, new_prev = self._fused("dcasgd", step)(w, g, mom, prev, lr, wd)
-        weight._data = new_w
-        return (new_m, new_prev)
+    pure_step = _leaf_step
 
 
 @register
@@ -544,36 +591,29 @@ class LBSGD(Optimizer):
             return None
         return jnp.zeros_like(_as_jax(weight))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = _f32(self._get_lr(index))
-        wd = _f32(self._get_wd(index))
-        w, g = _as_jax(weight), _as_jax(grad)
-
-        def step(w, g, m, lr, wd):
-            g = self._preprocess(g, w, wd)
-            wnorm = jnp.linalg.norm(w.ravel())
-            gnorm = jnp.linalg.norm(g.ravel())
-            lars = jnp.where(
-                (wnorm > 0) & (gnorm > 0), wnorm / (gnorm + 1e-9), 1.0)
-            eff_lr = lr * lars
-            if m is None:
-                return w - eff_lr * g, jnp.zeros(())
-            m = self.momentum * m - eff_lr * g
-            return w + m, m
-
+    def _leaf_step(self, w, g, state, t, lr, wd):
+        g = self._preprocess(g, w, wd)
+        wnorm = jnp.linalg.norm(w.ravel())
+        gnorm = jnp.linalg.norm(g.ravel())
+        lars = jnp.where(
+            (wnorm > 0) & (gnorm > 0), wnorm / (gnorm + 1e-9), 1.0)
+        eff_lr = lr * lars
         if state is None:
-            new_w, _ = self._fused("lbsgd0", step)(w, g, None, lr, wd)
-            weight._data = new_w
-        else:
-            new_w, new_m = self._fused("lbsgd", step)(w, g, state, lr, wd)
-            weight._data = new_w
-            return new_m
+            return w - eff_lr * g, None
+        m = self.momentum * state - eff_lr * g
+        return w + m, m
+
+    pure_step = _leaf_step
 
 
 @register
 class Adam(Optimizer):
-    """Adam (reference optimizer.py:1014; fused-op parity adam_update)."""
+    """Adam (reference optimizer.py:1014; fused-op parity adam_update).
+
+    The bias correction is a host-side scalar transform of the learning
+    rate (:meth:`_host_scalars`, reference optimizer.py:1037) so the kernel
+    itself stays schedule-free; the in-graph :meth:`pure_step` traces the
+    same correction on-device from the scanned ``t``."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  lazy_update=True, **kwargs):
@@ -587,34 +627,24 @@ class Adam(Optimizer):
         w = _as_jax(weight)
         return (jnp.zeros_like(w), jnp.zeros_like(w))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
+    def _host_scalars(self, index):
         t = self._index_update_count[index]
         lr = self._get_lr(index)
-        wd = _f32(self._get_wd(index))
         lr = lr * math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        return lr, self._get_wd(index), ()
+
+    def _leaf_step(self, w, g, state, t, lr, wd):
         b1, b2, eps = self.beta1, self.beta2, self.epsilon
-
-        def step(w, g, m, v, lr, wd):
-            g = self._preprocess_wd_in_clip(g, w, wd)
-            m = b1 * m + (1 - b1) * g
-            v = b2 * v + (1 - b2) * g * g
-            return w - lr * m / (jnp.sqrt(v) + eps), m, v
-
         m, v = state
-        new_w, m, v = self._fused("adam", step)(
-            _as_jax(weight), _as_jax(grad), m, v, _f32(lr), wd)
-        weight._data = new_w
-        return (m, v)
-
-    def pure_step(self, w, g, state, t, lr, wd):
-        b1, b2, eps = self.beta1, self.beta2, self.epsilon
-        lr = lr * jnp.sqrt(1.0 - jnp.power(b2, t)) / (1.0 - jnp.power(b1, t))
         g = self._preprocess_wd_in_clip(g, w, wd)
-        m, v = state
         m = b1 * m + (1 - b1) * g
         v = b2 * v + (1 - b2) * g * g
         return w - lr * m / (jnp.sqrt(v) + eps), (m, v)
+
+    def pure_step(self, w, g, state, t, lr, wd):
+        b1, b2 = self.beta1, self.beta2
+        lr = lr * jnp.sqrt(1.0 - jnp.power(b2, t)) / (1.0 - jnp.power(b1, t))
+        return self._leaf_step(w, g, state, t, lr, wd)
 
 
 @register
@@ -629,26 +659,12 @@ class AdaGrad(Optimizer):
     def create_state(self, index, weight):
         return jnp.zeros_like(_as_jax(weight))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = _f32(self._get_lr(index))
-        wd = _f32(self._get_wd(index))
-        eps = self.float_stable_eps
-
-        def step(w, g, h, lr, wd):
-            g = self._preprocess_no_wd(g)
-            h = h + g * g
-            return w - lr * (g / jnp.sqrt(h + eps) + wd * w), h
-
-        new_w, new_h = self._fused("adagrad", step)(
-            _as_jax(weight), _as_jax(grad), _as_jax(state), lr, wd)
-        weight._data = new_w
-        return new_h
-
-    def pure_step(self, w, g, state, t, lr, wd):
+    def _leaf_step(self, w, g, state, t, lr, wd):
         g = self._preprocess_no_wd(g)
         h = state + g * g
         return w - lr * (g / jnp.sqrt(h + self.float_stable_eps) + wd * w), h
+
+    pure_step = _leaf_step
 
 
 @register
@@ -670,46 +686,10 @@ class RMSProp(Optimizer):
             return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros_like(w))
         return (jnp.zeros_like(w),)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = _f32(self._get_lr(index))
-        wd = _f32(self._get_wd(index))
-        g1, g2, eps = self.gamma1, self.gamma2, self.epsilon
-        cw = self.clip_weights
-
-        if not self.centered:
-            def step(w, g, n, lr, wd):
-                g = self._preprocess_wd_in_clip(g, w, wd)
-                n = (1 - g1) * g * g + g1 * n
-                w = w - lr * g / jnp.sqrt(n + eps)
-                if cw:
-                    w = jnp.clip(w, -cw, cw)
-                return w, n
-            new_w, n = self._fused("rmsprop", step)(
-                _as_jax(weight), _as_jax(grad), state[0], lr, wd)
-            weight._data = new_w
-            return (n,)
-
-        def step(w, g, n, mg, delta, lr, wd):
-            g = self._preprocess_wd_in_clip(g, w, wd)
-            n = (1 - g1) * g * g + g1 * n
-            mg = (1 - g1) * g + g1 * mg
-            delta = g2 * delta - lr * g / jnp.sqrt(n - mg * mg + eps)
-            w = w + delta
-            if cw:
-                w = jnp.clip(w, -cw, cw)
-            return w, n, mg, delta
-
-        n, mg, delta = state
-        new_w, n, mg, delta = self._fused("rmsprop_c", step)(
-            _as_jax(weight), _as_jax(grad), n, mg, delta, lr, wd)
-        weight._data = new_w
-        return (n, mg, delta)
-
-    def pure_step(self, w, g, state, t, lr, wd):
+    def _leaf_step(self, w, g, state, t, lr, wd):
         g1, g2, eps = self.gamma1, self.gamma2, self.epsilon
         g = self._preprocess_wd_in_clip(g, w, wd)
-        if not self.centered:
+        if len(state) == 1:
             (n,) = state
             n = (1 - g1) * g * g + g1 * n
             w = w - lr * g / jnp.sqrt(n + eps)
@@ -725,6 +705,8 @@ class RMSProp(Optimizer):
             w = jnp.clip(w, -self.clip_weights, self.clip_weights)
         return w, (n, mg, delta)
 
+    pure_step = _leaf_step
+
 
 @register
 class AdaDelta(Optimizer):
@@ -739,25 +721,7 @@ class AdaDelta(Optimizer):
         w = _as_jax(weight)
         return (jnp.zeros_like(w), jnp.zeros_like(w))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        wd = _f32(self._get_wd(index))
-        rho, eps = self.rho, self.epsilon
-
-        def step(w, g, acc_g, acc_d, wd):
-            g = self._preprocess_no_wd(g)
-            acc_g = rho * acc_g + (1 - rho) * g * g
-            delta = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g + eps) * g
-            acc_d = rho * acc_d + (1 - rho) * delta * delta
-            return w - (delta + wd * w), acc_g, acc_d
-
-        acc_g, acc_d = state
-        new_w, acc_g, acc_d = self._fused("adadelta", step)(
-            _as_jax(weight), _as_jax(grad), acc_g, acc_d, wd)
-        weight._data = new_w
-        return (acc_g, acc_d)
-
-    def pure_step(self, w, g, state, t, lr, wd):
+    def _leaf_step(self, w, g, state, t, lr, wd):
         rho, eps = self.rho, self.epsilon
         g = self._preprocess_no_wd(g)
         acc_g, acc_d = state
@@ -765,6 +729,8 @@ class AdaDelta(Optimizer):
         delta = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g + eps) * g
         acc_d = rho * acc_d + (1 - rho) * delta * delta
         return w - (delta + wd * w), (acc_g, acc_d)
+
+    pure_step = _leaf_step
 
 
 @register
@@ -780,33 +746,7 @@ class Ftrl(Optimizer):
         w = _as_jax(weight)
         return (jnp.zeros_like(w), jnp.zeros_like(w))  # (z, n)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = _f32(self._get_lr(index))
-        wd = _f32(self._get_wd(index))
-        l1, beta = self.lamda1, self.beta
-
-        def step(w, g, z, n, lr, wd):
-            g = g * self.rescale_grad
-            if self.clip_gradient is not None:
-                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-            sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr
-            z = z + g - sigma * w
-            n = n + g * g
-            w = jnp.where(
-                jnp.abs(z) > l1,
-                -(z - jnp.sign(z) * l1) / ((beta + jnp.sqrt(n)) / lr + wd),
-                0.0,
-            ).astype(w.dtype)
-            return w, z, n
-
-        z, n = state
-        new_w, z, n = self._fused("ftrl", step)(
-            _as_jax(weight), _as_jax(grad), z, n, lr, wd)
-        weight._data = new_w
-        return (z, n)
-
-    def pure_step(self, w, g, state, t, lr, wd):
+    def _leaf_step(self, w, g, state, t, lr, wd):
         l1, beta = self.lamda1, self.beta
         g = g * self.rescale_grad
         if self.clip_gradient is not None:
@@ -822,6 +762,8 @@ class Ftrl(Optimizer):
         ).astype(w.dtype)
         return w, (z, n)
 
+    pure_step = _leaf_step
+
 
 @register
 class Adamax(Optimizer):
@@ -832,42 +774,34 @@ class Adamax(Optimizer):
         self.beta1 = beta1
         self.beta2 = beta2
 
+    def _host_scalars(self, index):
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
+        return lr, self._get_wd(index), ()
+
     def create_state(self, index, weight):
         w = _as_jax(weight)
         return (jnp.zeros_like(w), jnp.zeros_like(w))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
-        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
-        wd = _f32(self._get_wd(index))
+    def _leaf_step(self, w, g, state, t, lr, wd):
         b1, b2 = self.beta1, self.beta2
-
-        def step(w, g, m, u, lr, wd):
-            g = self._preprocess_wd_in_clip(g, w, wd)
-            m = b1 * m + (1 - b1) * g
-            u = jnp.maximum(b2 * u, jnp.abs(g))
-            return w - lr * m / (u + 1e-8), m, u
-
-        m, u = state
-        new_w, m, u = self._fused("adamax", step)(
-            _as_jax(weight), _as_jax(grad), m, u, _f32(lr), wd)
-        weight._data = new_w
-        return (m, u)
-
-    def pure_step(self, w, g, state, t, lr, wd):
-        b1, b2 = self.beta1, self.beta2
-        lr = lr / (1.0 - jnp.power(b1, t))
         g = self._preprocess_wd_in_clip(g, w, wd)
         m, u = state
         m = b1 * m + (1 - b1) * g
         u = jnp.maximum(b2 * u, jnp.abs(g))
         return w - lr * m / (u + 1e-8), (m, u)
 
+    def pure_step(self, w, g, state, t, lr, wd):
+        lr = lr / (1.0 - jnp.power(self.beta1, t))
+        return self._leaf_step(w, g, state, t, lr, wd)
+
 
 @register
 class Nadam(Optimizer):
-    """Nesterov Adam (reference optimizer.py:Nadam)."""
+    """Nesterov Adam (reference optimizer.py:Nadam). The momentum schedule
+    is a host-side recurrence (``m_schedule`` multiplies up across updates),
+    so its scalars enter the kernel as traced extras via
+    :meth:`_host_scalars` — time-varying values never retrace."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  schedule_decay=0.004, **kwargs):
@@ -878,40 +812,33 @@ class Nadam(Optimizer):
         self.schedule_decay = schedule_decay
         self.m_schedule = 1.0
 
+    _host_scalars_stateful = True  # m_schedule multiplies up per call
+
     def create_state(self, index, weight):
         w = _as_jax(weight)
         return (jnp.zeros_like(w), jnp.zeros_like(w))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
+    def _host_scalars(self, index):
         t = self._index_update_count[index]
-        lr = _f32(self._get_lr(index))
-        wd = _f32(self._get_wd(index))
-        b1, b2, eps = self.beta1, self.beta2, self.epsilon
-
-        momentum_t = b1 * (1.0 - 0.5 * (0.96 ** (t * self.schedule_decay)))
-        momentum_t_1 = b1 * (1.0 - 0.5 * (0.96 ** ((t + 1) * self.schedule_decay)))
+        momentum_t = self.beta1 * (1.0 - 0.5 * (0.96 ** (t * self.schedule_decay)))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * (0.96 ** ((t + 1) * self.schedule_decay)))
         self.m_schedule = self.m_schedule * momentum_t
         m_schedule_next = self.m_schedule * momentum_t_1
+        return (self._get_lr(index), self._get_wd(index),
+                (_f32(momentum_t), _f32(momentum_t_1), _f32(self.m_schedule),
+                 _f32(m_schedule_next)))
 
-        # time-varying scalars enter as traced args so the kernel compiles once
-        def step(w, g, m, v, lr, wd, t, mt, mt1, ms, msn):
-            g = self._preprocess_wd_in_clip(g, w, wd)
-            g_prime = g / (1.0 - ms)
-            m = b1 * m + (1.0 - b1) * g
-            m_prime = m / (1.0 - msn)
-            v = b2 * v + (1.0 - b2) * g * g
-            v_prime = v / (1.0 - jnp.power(b2, t))
-            m_bar = (1.0 - mt) * g_prime + mt1 * m_prime
-            return w - lr * m_bar / (jnp.sqrt(v_prime) + eps), m, v
-
+    def _leaf_step(self, w, g, state, t, lr, wd, mt, mt1, ms, msn):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
         m, v = state
-        new_w, m, v = self._fused("nadam", step)(
-            _as_jax(weight), _as_jax(grad), m, v, lr, wd, _f32(t),
-            _f32(momentum_t), _f32(momentum_t_1), _f32(self.m_schedule),
-            _f32(m_schedule_next))
-        weight._data = new_w
-        return (m, v)
+        g = self._preprocess_wd_in_clip(g, w, wd)
+        g_prime = g / (1.0 - ms)
+        m = b1 * m + (1.0 - b1) * g
+        m_prime = m / (1.0 - msn)
+        v = b2 * v + (1.0 - b2) * g * g
+        v_prime = v / (1.0 - jnp.power(b2, t))
+        m_bar = (1.0 - mt) * g_prime + mt1 * m_prime
+        return w - lr * m_bar / (jnp.sqrt(v_prime) + eps), (m, v)
 
 
 # ---------------------------------------------------------------------------

@@ -64,6 +64,19 @@ def _cache_size(jitted) -> int:
     return jit_cache_size(jitted)
 
 
+def _donate_batch_argnums(argnum: int):
+    """Donate the padded input batch where safe: the engine materializes a
+    fresh device array per request batch (``jnp.asarray`` of host data), so
+    the buffer is dead after the serve — donation lets XLA reuse it as
+    scratch. Parameters are NEVER donated (one shared device copy serves
+    every bucket rung). Only on backends whose PJRT implements donation."""
+    from .. import fastpath
+
+    if fastpath.donation_argnums_ok():
+        return (argnum,)
+    return ()
+
+
 class BlockEngine(Engine):
     """Serve a live (initialized, materialized) Gluon block.
 
@@ -111,7 +124,9 @@ class BlockEngine(Engine):
                 return out._data
 
             self._fwd = fwd_const
-        self._fn = jax.jit(self._fwd)
+        self._donate_argnum = 1 if self._functional else 0
+        self._jits = {}
+        self._active_fn()
         self._pvals = None
         self.refresh_params()
 
@@ -124,23 +139,52 @@ class BlockEngine(Engine):
             params = self._block.collect_params()
             self._pvals = {n: p.data()._data for n, p in params.items()}
         else:
-            import jax
+            self._jits = {}
+            self._active_fn()
 
-            self._fn = jax.jit(self._fwd)
+    def _active_fn(self):
+        """The jit variant for the CURRENT donation mode.
+        ``MXNET_FASTPATH_DONATE`` is a live knob (docs/env_var.md), but
+        ``donate_argnums`` bakes into a jit — so the mode is re-read per
+        run and each mode's executable is built once on demand. Flipping
+        the knob on a live server costs at most one recompile per shape."""
+        import jax
+
+        donate = _donate_batch_argnums(self._donate_argnum)
+        key = bool(donate)
+        fn = self._jits.get(key)
+        if fn is None:
+            fn = jax.jit(self._fwd, donate_argnums=donate)
+            self._jits[key] = fn
+        self._fn = fn  # compile_count tracks the active variant
+        return fn, key
 
     def run(self, batch: np.ndarray) -> BatchOut:
         from .. import telemetry
 
+        fn, donating = self._active_fn()
         x = self._jnp.asarray(batch, self._dtype)
+        if donating and x is batch:
+            # asarray was a no-copy alias (caller passed a device array of
+            # the engine dtype): donating it would consume CALLER-owned
+            # memory — donate a private copy instead
+            x = self._jnp.array(x, copy=True)
         if self._functional:
-            return _host(telemetry.jit_call("serving.block_engine", self._fn,
+            return _host(telemetry.jit_call("serving.block_engine", fn,
                                             self._pvals, x,
                                             self._global.next_key()))
-        return _host(telemetry.jit_call("serving.block_engine", self._fn, x))
+        return _host(telemetry.jit_call("serving.block_engine", fn, x))
 
     @property
     def compile_count(self) -> int:
-        return _cache_size(self._fn)
+        # sum over ALL donation-mode variants: flipping the live
+        # MXNET_FASTPATH_DONATE knob builds a fresh jit, and a count that
+        # reset with it would drive Server's steady-state-recompile gauge
+        # negative and hide real recompiles below zero
+        counts = [_cache_size(fn) for fn in self._jits.values()]
+        if not counts or any(c < 0 for c in counts):
+            return -1
+        return sum(counts)
 
 
 class StableHLOEngine(Engine):

@@ -24,6 +24,7 @@ from . import registry as _registry
 
 __all__ = ["RECOMPILES", "COMPILE_SECONDS", "STEADY_STATE_RECOMPILES",
            "TRANSFERS", "TRANSFER_BYTES", "PROFILER_COUNTER",
+           "OPT_DISPATCHES", "COMPILE_CACHE_HITS", "COMPILE_CACHE_MISSES",
            "jit_call", "jit_cache_size", "note_recompile",
            "record_transfer", "set_steady_state_recompiles"]
 
@@ -52,6 +53,23 @@ TRANSFER_BYTES = _registry.counter(
     "mxnet_host_transfer_bytes_total",
     "bytes moved device->host per path",
     labels=("path",))
+
+OPT_DISPATCHES = _registry.counter(
+    "mxnet_optimizer_update_dispatches_total",
+    "optimizer-update device dispatches by path: perparam = one jitted "
+    "call per parameter (the pre-fastpath regime), fused = one call per "
+    "whole (params, grads, states) tree, ingraph accounted by the step jit",
+    labels=("path",))
+
+COMPILE_CACHE_HITS = _registry.counter(
+    "mxnet_compile_cache_hits_total",
+    "XLA executables served from the persistent compilation cache "
+    "(MXNET_COMPILE_CACHE_DIR) instead of recompiled")
+
+COMPILE_CACHE_MISSES = _registry.counter(
+    "mxnet_compile_cache_misses_total",
+    "compilations the persistent cache could not serve (first-ever trace "
+    "of that program on this machine)")
 
 PROFILER_COUNTER = _registry.gauge(
     "mxnet_profiler_counter",

@@ -421,6 +421,80 @@ def test_baseline_counts_keys_have_no_line_numbers():
 # repo gate + CLI contract
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# perparam-jit
+# ---------------------------------------------------------------------------
+
+def test_perparam_jit_immediate_and_cached_dispatch():
+    f = lint("""
+        import jax
+        def apply(params, fns, cache):
+            for p in params:
+                jax.jit(lambda x: x + 1)(p)
+            for k, p in params.items():
+                cache._step_cache[k](p)
+        """, rule="perparam-jit")
+    assert len(f) == 2
+    assert all(x.rule == "perparam-jit" for x in f)
+
+
+def test_perparam_jit_fused_invocation_and_bound_name():
+    f = lint("""
+        import jax
+        def update_all(self, params, g, lr, wd):
+            step = jax.jit(lambda w: w - lr * w)
+            for w in params:
+                self._fused("sgd", None)(w, g, lr, wd)
+            for w in params:
+                step(w)
+        """, rule="perparam-jit")
+    assert len(f) == 2
+
+
+def test_perparam_jit_optimizer_and_kvstore_dispatch():
+    f = lint("""
+        def update(self, params, grads):
+            for i, (w, g) in enumerate(zip(params, grads)):
+                self._updater(i, g, w)
+            for i, g in enumerate(grads):
+                self._kvstore.push(i, g)
+                self._kvstore.pull(i, g)
+            for i, (w, g) in enumerate(zip(params, grads)):
+                self.optimizer.update(i, w, g, None)
+        """, rule="perparam-jit")
+    assert len(f) == 4
+
+
+def test_perparam_jit_negative_outside_loop_and_scope():
+    # one-shot dispatches and non-loop calls are fine
+    f = lint("""
+        import jax
+        def apply(self, tree, g):
+            fn = jax.jit(lambda x: x)
+            fn(tree)
+            self._updater(0, g, tree)
+            self._kvstore.push(0, g)
+        """, rule="perparam-jit")
+    assert f == []
+    # dict/set merges named `opt`/`cfg` are NOT optimizer dispatch
+    f = lint("""
+        def merge(configs):
+            opt = {}
+            for cfg in configs:
+                opt.update(cfg)
+            return opt
+        """, rule="perparam-jit")
+    assert f == []
+    # the pass polices mxnet_tpu/ only (user tools keep their loops)
+    f = lint("""
+        import jax
+        def bench(params):
+            for p in params:
+                jax.jit(lambda x: x)(p)
+        """, rule="perparam-jit", relpath="tools/bench_thing.py")
+    assert f == []
+
+
 def test_gate_repo_is_clean_against_committed_baseline():
     """The acceptance gate: zero non-baselined findings across mxnet_tpu/
     and tools/. A new hazard in a PR lands here as a failure."""
