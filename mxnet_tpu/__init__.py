@@ -57,6 +57,7 @@ for _m in (
     "visualization",
     "image",
     "parallel",
+    "trainplane",
     "sequence_parallel",
     "resilience",
     "serving",

@@ -34,11 +34,11 @@ from __future__ import annotations
 import jax
 
 from ..base import get_env
-from .fused import FusedApplyError, apply_updater, fused_apply
+from .fused import FusedApplyError, apply_updater, fused_apply, tree_kernel
 from . import bucketing, cache  # noqa: F401  - cache wires itself at import
 
 __all__ = ["enabled", "donation_enabled", "donation_argnums_ok", "supports",
-           "fused_apply", "apply_updater", "FusedApplyError",
+           "fused_apply", "apply_updater", "FusedApplyError", "tree_kernel",
            "bucketing", "cache"]
 
 

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from .. import telemetry
 from ..base import MXNetError
 
-__all__ = ["FusedApplyError", "fused_apply", "apply_updater"]
+__all__ = ["FusedApplyError", "fused_apply", "apply_updater", "tree_kernel"]
 
 
 class FusedApplyError(MXNetError):
@@ -50,18 +50,17 @@ def _is_mp_state(optimizer, index, weight, state):
             and _is_mp_pair(optimizer, index, weight, state))
 
 
-def _tree_fn(optimizer, mp_flags: Tuple[bool, ...], donate_argnums: bool):
-    # the jit variants live ON the optimizer (like its _step_cache) so they
-    # die with it — an external map would keep every optimizer alive via
-    # the tree_step closure below. Keys carry everything the closure reads
-    # from the optimizer at trace time (rescale/clip) plus the per-leaf mp
-    # layout and the donation mode; Optimizer.__getstate__ drops the cache.
-    key = (mp_flags, optimizer.rescale_grad, optimizer.clip_gradient,
-           donate_argnums)
-    per_opt = optimizer.__dict__.setdefault("_tree_cache", {})
-    fn = per_opt.get(key)
-    if fn is not None:
-        return fn
+def tree_kernel(optimizer, mp_flags: Tuple[bool, ...]):
+    """Pure traced update over parallel per-parameter lists:
+    ``(ws, gs, sts, ts, lrs, wds, extras) -> (new_ws, new_sts)``.
+
+    The ONE composition of ``Optimizer._leaf_step`` over a parameter tree,
+    consumed by two compilers: :func:`_tree_fn` jits it standalone (the
+    fused update plane), and ``mxnet_tpu.trainplane`` inlines it into the
+    whole-step jit behind ``MXNET_TRAINSTEP`` (the fused *step* plane).
+    Because both trace this same function with the same host-prologue
+    scalars, the update math of the two planes cannot drift apart — the
+    PR-5 bit-identity discipline extended one level up."""
 
     def tree_step(ws, gs, sts, ts, lrs, wds, extras):
         new_ws: List[Any] = []
@@ -82,7 +81,23 @@ def _tree_fn(optimizer, mp_flags: Tuple[bool, ...], donate_argnums: bool):
                 new_sts.append(ns)
         return new_ws, new_sts
 
-    fn = jax.jit(tree_step,
+    return tree_step
+
+
+def _tree_fn(optimizer, mp_flags: Tuple[bool, ...], donate_argnums: bool):
+    # the jit variants live ON the optimizer (like its _step_cache) so they
+    # die with it — an external map would keep every optimizer alive via
+    # the tree_step closure below. Keys carry everything the closure reads
+    # from the optimizer at trace time (rescale/clip) plus the per-leaf mp
+    # layout and the donation mode; Optimizer.__getstate__ drops the cache.
+    key = (mp_flags, optimizer.rescale_grad, optimizer.clip_gradient,
+           donate_argnums)
+    per_opt = optimizer.__dict__.setdefault("_tree_cache", {})
+    fn = per_opt.get(key)
+    if fn is not None:
+        return fn
+
+    fn = jax.jit(tree_kernel(optimizer, mp_flags),
                  donate_argnums=(0, 2) if donate_argnums else ())
     per_opt[key] = fn
     return fn
@@ -118,6 +133,39 @@ def _invalidate(buffers: Sequence[Any], keep_ptrs) -> None:
             continue
 
 
+def donation_prep(*trees):
+    """``(argnums_ok, consumed)`` — the ONE donation-eligibility probe for
+    the fused update and whole-step jits. ``consumed`` is the flat list of
+    device buffers behind ``trees`` (the args about to be donated), empty
+    when donation is off or a buffer appears twice / can't be probed: a
+    duplicated buffer cannot be donated twice, and an unprobeable one
+    disables donation conservatively."""
+    from . import donation_argnums_ok, donation_enabled
+
+    if not donation_enabled():
+        return False, []
+    consumed: List[Any] = []
+    for t in trees:
+        consumed += _leaf_buffers(t)
+    ptrs = [_buf_ptr(b) for b in consumed]
+    duplicated = None in ptrs or len(set(ptrs)) != len(ptrs)
+    return (not duplicated and donation_argnums_ok(),
+            [] if duplicated else consumed)
+
+
+def invalidate_consumed(consumed, live_trees) -> None:
+    """Delete every consumed buffer that did not come back alive in
+    ``live_trees`` (stale-handle-raises discipline; idempotent with real
+    donation, explicit delete() on backends without it)."""
+    if not consumed:
+        return
+    keep = set()
+    for t in live_trees:
+        keep.update(p for p in map(_buf_ptr, _leaf_buffers(t))
+                    if p is not None)
+    _invalidate(consumed, keep)
+
+
 def fused_apply(optimizer, indices, grads, weights, states):
     """Apply ``optimizer`` to every parameter in ONE device dispatch.
 
@@ -133,8 +181,6 @@ def fused_apply(optimizer, indices, grads, weights, states):
     scalars) runs exactly as the per-parameter loop would — ``_leaf_step``
     composed over the tree is the only thing that moved into one jit.
     """
-    from . import donation_argnums_ok, donation_enabled
-
     n = len(indices)
     if not (n == len(grads) == len(weights) == len(states)):
         raise FusedApplyError("fused_apply: ragged inputs")
@@ -158,15 +204,10 @@ def fused_apply(optimizer, indices, grads, weights, states):
     ws = [w._data for w in weights]
     gs = [g._data for g in grads]
 
-    donate = donation_enabled()
-    consumed = _leaf_buffers(ws) + _leaf_buffers(states) if donate else []
-    # a buffer appearing twice among the donated args (e.g. DCASGD's
-    # `prev` state starts as the weight itself, or XLA aliased two
-    # identical previous-step outputs onto one buffer) cannot be donated
-    # twice; an unprobeable buffer disables donation conservatively
-    ptrs = [_buf_ptr(b) for b in consumed]
-    duplicated = None in ptrs or len(set(ptrs)) != len(ptrs)
-    argnums = not duplicated and donation_argnums_ok()
+    # grads are NOT donated, but a consumed buffer can alias one (e.g.
+    # DCASGD's `prev` state starts as the weight itself), so gs rides in
+    # the live set below
+    argnums, consumed = donation_prep(ws, states)
 
     fn = _tree_fn(optimizer, tuple(mp_flags), argnums)
     telemetry.OPT_DISPATCHES.inc(path="fused")
@@ -176,11 +217,7 @@ def fused_apply(optimizer, indices, grads, weights, states):
 
     for w, nw in zip(weights, new_ws):
         w._data = nw
-    if donate and not duplicated:
-        keep = {p for p in map(_buf_ptr, _leaf_buffers(new_ws)
-                               + _leaf_buffers(new_sts)
-                               + _leaf_buffers(gs)) if p is not None}
-        _invalidate(consumed, keep)
+    invalidate_consumed(consumed, (new_ws, new_sts, gs))
     return new_sts
 
 

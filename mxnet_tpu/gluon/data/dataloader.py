@@ -38,13 +38,24 @@ def default_batchify_fn(data):
 
 
 class DataLoader(object):
-    """Iterate a Dataset in mini-batches (reference dataloader.py:DataLoader)."""
+    """Iterate a Dataset in mini-batches (reference dataloader.py:DataLoader).
+
+    ``sharding`` (a ``jax.sharding.Sharding`` or a callable
+    ``ndim -> Sharding``) turns on the device feed path: each batch is
+    staged into device memory — laid out over the given sharding, e.g. the
+    training mesh's ``dp`` axis via ``parallel.batch_sharding`` — as it is
+    yielded, so the consuming step (``trainplane``/``parallel.TrainStep``)
+    finds it already resident and skips its own ``device_put``. Batches
+    already in the target layout pass through untouched.
+    """
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
-                 num_workers=0, pin_memory=False, prefetch=None):
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 sharding=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
+        self._sharding = sharding
 
         if batch_sampler is None:
             if batch_size is None:
@@ -75,10 +86,37 @@ class DataLoader(object):
         else:
             self._batchify_fn = batchify_fn
 
+    def _stage(self, batch):
+        """Device feed: put each NDArray of the batch onto the configured
+        sharding via ``parallel.put_sharded`` (the one home of the skip-put
+        rule ``io.DevicePrefetchIter`` also uses)."""
+        if self._sharding is None:
+            return batch
+        from ... import parallel
+
+        def put(x):
+            if isinstance(x, (list, tuple)):
+                vals = [put(i) for i in x]
+                # namedtuple constructors take positional fields, not an
+                # iterable
+                return type(x)(*vals) if hasattr(x, "_fields") \
+                    else type(x)(vals)
+            if not isinstance(x, NDArray):
+                return x
+            data = x._data
+            tgt = parallel.resolve_sharding(self._sharding, data.ndim)
+            if tgt is None:
+                return x
+            staged = parallel.put_sharded(data, tgt)
+            return x if staged is data else type(x)(staged, x.context)
+
+        return put(batch)
+
     def __iter__(self):
         if self._num_workers == 0:
             for batch in self._batch_sampler:
-                yield self._batchify_fn([self._dataset[idx] for idx in batch])
+                yield self._stage(
+                    self._batchify_fn([self._dataset[idx] for idx in batch]))
             return
 
         # threaded prefetch pipeline (counterpart of the reference's
@@ -89,7 +127,8 @@ class DataLoader(object):
             depth = max(1, self._prefetch)
 
             def fetch(idx_batch):
-                out = self._batchify_fn([self._dataset[i] for i in idx_batch])
+                out = self._stage(
+                    self._batchify_fn([self._dataset[i] for i in idx_batch]))
                 _T_PREFETCH.inc(pipeline="gluon.DataLoader")
                 return out
 

@@ -5,6 +5,14 @@ API parity with reference ``python/mxnet/gluon/trainer.py`` (Trainer :27,
 save/load_states). On this stack the kvstore='device'/'local' reduce
 collapses to a no-op on one chip; a 'tpu'/'dist*' kvstore lowers gradient
 aggregation to ICI psum (SURVEY §5.8).
+
+The Trainer-driven loop has two execution planes (docs/performance.md):
+the eager path below (autograd fwd/bwd + the PR-5 fused update), and the
+in-graph step plane — ``mxnet_tpu.trainplane.TrainPlane(net, loss, trainer)
+.step(data, label)`` compiles the WHOLE step (fwd+loss+bwd+dp-allreduce+
+update) into one SPMD module behind ``MXNET_TRAINSTEP``, with this Trainer
+still owning the optimizer, its state and the step counter — the two
+planes interleave without schedule drift and are bit-identical in fp32.
 """
 from __future__ import annotations
 
@@ -98,6 +106,12 @@ class Trainer(object):
             if self._update_on_kvstore:
                 self._kvstore.set_optimizer(self._optimizer)
         self._kv_initialized = True
+
+    @property
+    def optimizer(self):
+        """The owned Optimizer — the single source of optimizer state and
+        step counting for BOTH execution planes (eager and trainplane)."""
+        return self._optimizer
 
     @property
     def learning_rate(self):

@@ -703,14 +703,25 @@ class DevicePrefetchIter(DataIter):
     itself, so the accelerator never waits on PCIe/DMA — jax dispatch is
     async, and ``jax.device_put`` from the worker thread runs concurrently
     with the in-flight step.
+
+    ``sharding`` (a ``jax.sharding.Sharding``, or a callable
+    ``ndim -> Sharding`` for rank-dependent layouts) makes this the
+    *pre-sharded feed* of the in-graph training plane: batches land
+    already laid out over the mesh's ``dp`` axis, so the step's own
+    shard pass (``parallel.shard_to_mesh``) degenerates to an equivalence
+    check instead of a dispatch-serializing ``device_put``. Arrays already
+    resident in the target layout are passed through untouched — the
+    worker never issues a wasted D2D copy for data that is where it
+    should be (the same ``is_equivalent_to`` skip the step itself uses).
     """
 
-    def __init__(self, base_iter, ctx=None, depth=2):
+    def __init__(self, base_iter, ctx=None, depth=2, sharding=None):
         super().__init__(base_iter.batch_size)
         from .context import current_context
 
         self.base = base_iter
         self.ctx = ctx or current_context()
+        self._sharding = sharding
         self._depth = max(1, depth)
         self._queue = queue_mod.Queue(maxsize=self._depth)
         self._sentinel = object()
@@ -726,14 +737,35 @@ class DevicePrefetchIter(DataIter):
     def provide_label(self):
         return self.base.provide_label
 
-    def _stage(self, batch):
+    def _target(self, data):
+        """Device-put target for one array: the configured sharding, else
+        this iterator's context device."""
+        from . import parallel
+
+        tgt = parallel.resolve_sharding(self._sharding, data.ndim)
+        if tgt is not None:
+            return tgt
         import jax
 
-        dev = self.ctx.jax_device()
+        return jax.sharding.SingleDeviceSharding(self.ctx.jax_device())
+
+    def _stage(self, batch):
+        from . import parallel
 
         def put(arrs):
-            return [type(a)(jax.device_put(a._data, dev), self.ctx)
-                    if isinstance(a, nd_mod.NDArray) else a for a in arrs]
+            out = []
+            for a in arrs:
+                if not isinstance(a, nd_mod.NDArray):
+                    out.append(a)
+                    continue
+                data = a._data
+                # parallel.put_sharded skips the put (returns `data`
+                # itself) when the batch is already resident in the
+                # target layout
+                staged = parallel.put_sharded(data, self._target(data))
+                out.append(a if staged is data
+                           else type(a)(staged, self.ctx))
+            return out
 
         return DataBatch(put(batch.data),
                          put(batch.label) if batch.label else batch.label,

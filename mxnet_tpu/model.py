@@ -196,6 +196,10 @@ class FeedForward(object):
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, monitor=None,
             eval_end_callback=None, eval_batch_end_callback=None):
+        """Train (reference model.py:FeedForward.fit). Rides Module.fit, so
+        the in-graph training plane applies: with ``MXNET_TRAINSTEP`` at
+        auto/1 and a single-context traceable symbol, every step runs as
+        ONE compiled fwd+bwd+update module (``mxnet_tpu.trainplane``)."""
         self._module = self._init_module(X)
         self._module.fit(
             X, eval_data=eval_data, eval_metric=eval_metric,

@@ -165,6 +165,18 @@ class BaseModule(object):
         eval_metric = _as_metric(eval_metric)
         validation_metric = validation_metric or eval_metric
 
+        # training plane selection (docs/performance.md): a traceable
+        # single-context Module routes every step through ONE compiled
+        # fwd+bwd+update module (trainplane.module_plane); anything the
+        # graph plane cannot serve — or MXNET_TRAINSTEP=0 — runs the
+        # classic eager forward_backward/update pair below. A monitor
+        # needs per-op eager visibility, so it forces the eager path.
+        plane = None
+        if monitor is None:
+            from .. import trainplane
+
+            plane = trainplane.module_plane(self)
+
         for epoch in range(begin_epoch, num_epoch):
             started = time.time()
             eval_metric.reset()
@@ -174,8 +186,11 @@ class BaseModule(object):
                 self.prepare(batch, sparse_row_id_fn=sparse_row_id_fn)
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(batch)
-                self.update()
+                if plane is not None:
+                    plane.step(batch)
+                else:
+                    self.forward_backward(batch)
+                    self.update()
                 self.update_metric(eval_metric, batch.label)
                 if monitor is not None:
                     monitor.toc_print()

@@ -304,6 +304,28 @@ class Optimizer(object):
         self._index_update_count[index] += 1
         self.num_update = max(self._index_update_count[index], self.num_update)
 
+    def sync_num_update(self, t):
+        """Single source of truth for the step counter when an in-graph
+        step plane (``parallel.TrainStep`` / ``trainplane``) interleaves
+        with eager ``Trainer.step``/``Updater`` updates (warmup or eval
+        phases mixed into a compiled run): advance ``num_update`` to ``t``
+        AND align every per-index count, so the next eager update continues
+        at ``t + 1`` instead of replaying the eager-only count — without
+        this, an ``lr_scheduler`` reading ``num_update`` would see the two
+        paths drift apart (regression-tested in tests/test_trainplane.py).
+        """
+        t = int(t)
+        self.num_update = max(self.num_update, t)
+        # begin_num_update seeds indices _update_count has not seen yet
+        # (graph-only steps never touch _index_update_count): without
+        # advancing it, a param first updated eagerly AFTER t graph steps
+        # would restart its per-index count — and e.g. Adam's bias
+        # correction — at 1 instead of t + 1.
+        self.begin_num_update = max(self.begin_num_update, t)
+        for idx in self._index_update_count:
+            self._index_update_count[idx] = max(
+                self._index_update_count[idx], t)
+
     def _get_lr(self, index):
         if self.lr_scheduler is not None:
             lr = self.lr_scheduler(self.num_update)

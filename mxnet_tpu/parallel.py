@@ -40,7 +40,8 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["device_mesh", "all_reduce", "all_reduce_multi",
            "broadcast_to_devices", "TrainStep", "InferStep",
-           "pipeline_apply"]
+           "pipeline_apply", "shard_to_mesh", "batch_sharding",
+           "fresh_replicate"]
 
 
 # ---------------------------------------------------------------------------
@@ -321,6 +322,139 @@ def broadcast_to_devices(array, devices):
 
 
 # ---------------------------------------------------------------------------
+# sharding helpers shared by the step executors and the input plane
+# ---------------------------------------------------------------------------
+
+
+def batch_sharding(mesh: Mesh, ndim: int, batch_axis: int = 0,
+                   dp_axis: Optional[str] = None) -> NamedSharding:
+    """The NamedSharding a training batch should arrive in: sharded over the
+    mesh's data-parallel axis at ``batch_axis``, replicated elsewhere. The
+    input plane (``io.DevicePrefetchIter``/``gluon.data.DataLoader``) uses
+    this as its device-put target so batches land pre-sharded and the step's
+    own ``shard_to_mesh`` degenerates to an equivalence check."""
+    spec = [None] * ndim
+    spec[batch_axis] = dp_axis or mesh.axis_names[0]
+    return NamedSharding(mesh, P(*spec))
+
+
+def resolve_sharding(sharding, ndim: int):
+    """Resolve an input-plane sharding spec — a concrete ``Sharding`` or an
+    ``ndim -> Sharding`` callable (how ``batch_sharding`` is usually
+    curried) — to the target for one array, or ``None`` when no target is
+    configured."""
+    if sharding is None:
+        return None
+    return sharding(ndim) if callable(sharding) else sharding
+
+
+def _evenly_shardable(target, shape) -> bool:
+    """Whether ``target`` can lay an array of ``shape`` out without ragged
+    shards (``device_put`` raises on a partitioned dim the mesh axis does
+    not divide)."""
+    mesh = getattr(target, "mesh", None)
+    spec = getattr(target, "spec", None)
+    if mesh is None or spec is None:
+        return True
+    for dim, names in enumerate(spec):
+        if names is None:
+            continue
+        parts = 1
+        for axis in (names if isinstance(names, tuple) else (names,)):
+            parts *= mesh.shape[axis]
+        if dim >= len(shape) or shape[dim] % parts:
+            return False
+    return True
+
+
+def put_sharded(data, target):
+    """THE home of the skip-put discipline: ``device_put`` a jax array onto
+    ``target`` unless it is already laid out equivalently — re-putting
+    issues a copy that serializes dispatch with the device queue (measured
+    74-157ms/step through the TPU relay, and a wasted D2D copy even on
+    directly-attached chips). Returns ``data`` itself on skip, so callers
+    can ``is``-check whether a put happened. Shared by ``shard_to_mesh``,
+    the ``io.DevicePrefetchIter`` worker and the gluon ``DataLoader``
+    feed.
+
+    A batch the target cannot split evenly — the ragged final batch of an
+    epoch on a multi-device mesh — degrades to replication over the same
+    mesh instead of raising: the training plane's never-a-crash contract
+    reaches the input plane too (GSPMD still partitions the step; the odd
+    shape pays one extra compile, which it would anyway)."""
+    sh = getattr(data, "sharding", None)
+    if sh is not None and sh.is_equivalent_to(target, data.ndim):
+        return data
+    if not _evenly_shardable(target, data.shape):
+        target = NamedSharding(target.mesh, P())
+    return jax.device_put(data, target)
+
+
+def shard_to_mesh(data, mesh: Mesh, batch_axis: int = 0,
+                  dp_axis: Optional[str] = None):
+    """Lay a batch out over the mesh's dp axis via ``put_sharded`` (a batch
+    already laid out equivalently — always true for device-resident data on
+    a 1-device mesh, and for the pre-sharded feed path — is returned
+    as-is)."""
+    data = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    return put_sharded(
+        data, batch_sharding(mesh, data.ndim, batch_axis, dp_axis))
+
+
+_REPL_JITS: Dict[Any, Any] = {}
+
+
+def _identity_copy_fn(mesh: Mesh):
+    key = tuple(d.id for d in mesh.devices.flat)
+    fn = _REPL_JITS.get(key)
+    if fn is None:
+        fn = jax.jit(lambda a: a,
+                     out_shardings=NamedSharding(mesh, P()))
+        _REPL_JITS[key] = fn
+    return fn
+
+
+def _buffer_ptrs(a):
+    """Set of device-buffer addresses behind an array, or None when
+    unprobeable."""
+    try:
+        return {s.data.unsafe_buffer_pointer() for s in a.addressable_shards}
+    except Exception:  # noqa: BLE001 - probe failure => caller plays safe
+        return None
+
+
+def fresh_replicate(x, mesh: Mesh):
+    """Replicate ``x`` over ``mesh`` into FRESH buffers, without the eager
+    ``jnp.copy`` intermediate the old TrainStep init paid (a transient
+    second full copy of every parameter — the 2x-HBM init spike): the
+    result must not alias the source, because the step jit donates its
+    param inputs and donation would otherwise delete a buffer the caller
+    still references.
+
+    * host (numpy) source: ``device_put`` allocates fresh device buffers
+      by construction — one copy, done;
+    * resharding device source: ``device_put`` to the replicated layout,
+      then an isolation pass ONLY if a source buffer leaked into the
+      result (a runtime may reuse the source as the co-located replica);
+    * already-replicated source (the alias-guaranteed case ``device_put``
+      would no-op on): one compiled identity copy — jit outputs never
+      alias non-donated inputs.
+    """
+    repl = NamedSharding(mesh, P())
+    sh = getattr(x, "sharding", None)
+    if sh is None:
+        return jax.device_put(x, repl)
+    if sh.is_equivalent_to(repl, x.ndim):
+        return _identity_copy_fn(mesh)(x)
+    src = _buffer_ptrs(x)
+    moved = jax.device_put(x, repl)
+    dst = _buffer_ptrs(moved)
+    if src is None or dst is None or (src & dst):
+        moved = _identity_copy_fn(mesh)(moved)
+    return moved
+
+
+# ---------------------------------------------------------------------------
 # in-graph SPMD training step
 # ---------------------------------------------------------------------------
 
@@ -372,25 +506,16 @@ class TrainStep(object):
 
     # ------------------------------------------------------------------
     def _repl(self, x):
-        # jnp.copy first: device_put to an already-matching sharding is a
-        # no-op alias, and the step jit donates its param inputs — an alias
-        # would let donation delete a buffer the caller still references
-        return jax.device_put(jnp.copy(x), NamedSharding(self._mesh, P()))
+        # fresh buffer (jit outputs never alias non-donated inputs): the
+        # step jit donates its param inputs, and an alias would let that
+        # donation delete a buffer the caller still references. No eager
+        # copy intermediate — peak init memory stays ~1x model size.
+        return fresh_replicate(x, self._mesh)
 
     def _shard_batch(self, x, extra_lead_axes=0):
-        data = x._data if isinstance(x, NDArray) else jnp.asarray(x)
-        spec = [None] * data.ndim
-        spec[self._batch_axis + extra_lead_axes] = self._dp_axis
-        target = NamedSharding(self._mesh, P(*spec))
-        # Skip the put when the batch already lays out equivalently (always
-        # true for device-resident data on a 1-device mesh): device_put
-        # issues a copy that serializes dispatch with the device queue —
-        # measured 74-157ms/step through the TPU relay, and a wasted D2D
-        # copy even on directly-attached chips.
-        sh = getattr(data, "sharding", None)
-        if sh is not None and sh.is_equivalent_to(target, data.ndim):
-            return data
-        return jax.device_put(data, target)
+        return shard_to_mesh(x, self._mesh,
+                             self._batch_axis + extra_lead_axes,
+                             self._dp_axis)
 
     def _ensure_init(self, data_nd):
         if self._pvals is not None:
@@ -518,8 +643,12 @@ class TrainStep(object):
         data_nd = data if isinstance(data, NDArray) else NDArray(
             jnp.asarray(data), cpu())
         self._ensure_init(data_nd)
-        self._t += 1
-        self._optimizer.num_update = self._t
+        # the step counter has ONE source of truth shared with the eager
+        # Updater path (optimizer.num_update): a run that interleaves this
+        # in-graph step with eager Trainer.step calls (warmup/eval) must
+        # not replay or skip schedule steps on either side
+        self._t = max(self._t, self._optimizer.num_update) + 1
+        self._optimizer.sync_num_update(self._t)
 
         d = self._shard_batch(data)
         l = self._shard_batch(label)
@@ -574,8 +703,9 @@ class TrainStep(object):
             jnp.asarray(labels), cpu())
         self._ensure_init(NDArray(datas_nd._data[0], cpu()))
         k = int(datas_nd._data.shape[0])
-        self._t += k
-        self._optimizer.num_update = self._t
+        # counter coherence with eager interleaves — see __call__
+        self._t = max(self._t, self._optimizer.num_update) + k
+        self._optimizer.sync_num_update(self._t)
 
         d = self._shard_batch(datas_nd, extra_lead_axes=1)
         l = self._shard_batch(labels_nd, extra_lead_axes=1)

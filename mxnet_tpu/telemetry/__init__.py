@@ -37,7 +37,8 @@ from __future__ import annotations
 from . import accounting, exporters, registry, spans
 from .accounting import (COMPILE_CACHE_HITS, COMPILE_CACHE_MISSES,
                          COMPILE_SECONDS, OPT_DISPATCHES, PROFILER_COUNTER,
-                         RECOMPILES, STEADY_STATE_RECOMPILES, TRANSFER_BYTES,
+                         RECOMPILES, STEADY_STATE_RECOMPILES, STEP_DISPATCHES,
+                         TRANSFER_BYTES,
                          TRANSFERS, jit_cache_size, jit_call, note_recompile,
                          record_transfer, set_steady_state_recompiles)
 from .exporters import (Emitter, render_prometheus, snapshot, start_emitter,
@@ -54,7 +55,8 @@ __all__ = [
     "set_steady_state_recompiles",
     "RECOMPILES", "COMPILE_SECONDS", "STEADY_STATE_RECOMPILES",
     "TRANSFERS", "TRANSFER_BYTES", "PROFILER_COUNTER",
-    "OPT_DISPATCHES", "COMPILE_CACHE_HITS", "COMPILE_CACHE_MISSES",
+    "OPT_DISPATCHES", "STEP_DISPATCHES",
+    "COMPILE_CACHE_HITS", "COMPILE_CACHE_MISSES",
     "render_prometheus", "snapshot", "Emitter", "start_emitter",
     "stop_emitter",
 ]

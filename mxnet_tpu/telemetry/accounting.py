@@ -24,7 +24,8 @@ from . import registry as _registry
 
 __all__ = ["RECOMPILES", "COMPILE_SECONDS", "STEADY_STATE_RECOMPILES",
            "TRANSFERS", "TRANSFER_BYTES", "PROFILER_COUNTER",
-           "OPT_DISPATCHES", "COMPILE_CACHE_HITS", "COMPILE_CACHE_MISSES",
+           "OPT_DISPATCHES", "STEP_DISPATCHES",
+           "COMPILE_CACHE_HITS", "COMPILE_CACHE_MISSES",
            "jit_call", "jit_cache_size", "note_recompile",
            "record_transfer", "set_steady_state_recompiles"]
 
@@ -60,6 +61,15 @@ OPT_DISPATCHES = _registry.counter(
     "call per parameter (the pre-fastpath regime), fused = one call per "
     "whole (params, grads, states) tree, ingraph accounted by the step jit",
     labels=("path",))
+
+STEP_DISPATCHES = _registry.counter(
+    "mxnet_trainstep_dispatches_total",
+    "training-plane step executions by plane: graph = ONE whole-step jit "
+    "(fwd+loss+bwd+allreduce+update in a single dispatch), eager = the "
+    "per-phase fallback path (forward/backward/update each dispatch "
+    "separately); graph steps with a zero optimizer-dispatch delta prove "
+    "dispatches_per_step == 1",
+    labels=("plane",))
 
 COMPILE_CACHE_HITS = _registry.counter(
     "mxnet_compile_cache_hits_total",

@@ -1,0 +1,791 @@
+"""The training plane: ONE compiled SPMD step behind the high-level APIs.
+
+BENCH_TPU_PARTIAL_r05 measured eager ResNet-50 training at 0.6% MFU on a
+v5e chip; PR 5 collapsed the *update* plane to one fused jit, but the
+forward/backward still ran outside ``parallel.TrainStep``. This module
+turns the fused update plane into a fused *step* plane: the whole training
+step — forward + loss + backward + data-parallel all-reduce + optimizer
+update — compiles into ONE XLA module (the reference framework's single
+scheduled graph per step: GraphExecutor fwd+bwd + kvstore reduce + fused
+optimizer ops; the same end-to-end-compilation argument TVM makes,
+PAPERS.md), and the high-level training APIs route through it:
+
+* ``TrainPlane`` — drives a ``gluon.Trainer``-owned model. ``plane.step``
+  replaces the canonical record/forward/backward/``Trainer.step`` loop
+  body; :func:`fit` is the epoch-loop convenience on top.
+* ``module_plane`` — the same plane for ``Module.fit`` (and therefore
+  ``model.fit``/``FeedForward.fit``), built over the Symbol graph.
+
+Bit-identity discipline (PR-5, one level up): the in-graph step consumes
+the SAME host scalar prologue (``Optimizer._update_count`` +
+``_host_scalars``) and traces the SAME per-parameter kernel
+(``fastpath.tree_kernel`` over ``Optimizer._leaf_step``) as the eager
+fused apply, and seeds the backward with the same all-ones cotangents
+``loss.backward()`` would — so fp32 training through the graph plane is
+bit-identical to the eager fastpath (asserted in tests/test_trainplane.py).
+The optimizer's ``num_update``/per-index counters stay the single source
+of truth, so eager and in-graph steps can interleave without lr-schedule
+drift.
+
+Knobs (docs/env_var.md):
+
+* ``MXNET_TRAINSTEP`` — ``auto`` (default: compile when traceable, fall
+  back silently), ``1`` (compile, warn on fallback), ``0`` (eager always).
+  Non-traceable models — plain ``Block``s, host-dependent control flow —
+  fall back to the eager path automatically; never a crash.
+* ``MXNET_TRAIN_DTYPE`` — ``bf16`` casts the model to bfloat16 at plane
+  activation and turns on the fp32 master-weight multi-precision path in
+  the optimizer (states are kept f32; the MXU-rate training mode).
+* ``MXNET_SHARDED_FEED`` — default on: :func:`fit` stages batches through
+  ``io.DevicePrefetchIter`` pre-laid-out over the mesh's ``dp`` axis, so
+  the step's own shard check is a no-op instead of a dispatch-serializing
+  ``device_put``.
+
+Multi-chip: the default mesh spans every local device whose count divides
+the batch; under a launcher (``MXNET_COORDINATOR_*``) construction joins
+the multi-process jax runtime via ``kvstore.init_distributed`` and the
+same step spans the slice (GSPMD inserts the ICI collectives).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import autograd, telemetry
+from . import optimizer as opt_mod
+from .base import get_env
+from .context import cpu
+from .ndarray.ndarray import NDArray
+
+__all__ = ["TrainPlane", "fit", "module_plane", "mode", "train_dtype",
+           "sharded_feed"]
+
+_LOG = logging.getLogger(__name__)
+
+#: why planes fell back to eager, by coarse reason — the operator-visible
+#: record that MXNET_TRAINSTEP=auto quietly declined to compile something
+FALLBACKS = telemetry.counter(
+    "mxnet_trainplane_fallbacks_total",
+    "training-plane graph compilations declined, by reason",
+    labels=("reason",))
+
+
+def mode() -> str:
+    """``MXNET_TRAINSTEP``: ``auto`` | ``1`` | ``0`` (re-read per call)."""
+    raw = str(get_env("MXNET_TRAINSTEP", "auto", str, cache=False)).lower()
+    return raw if raw in ("auto", "1", "0") else "auto"
+
+
+def train_dtype() -> str:
+    """``MXNET_TRAIN_DTYPE``: ``fp32`` (default) | ``bf16``."""
+    raw = str(get_env("MXNET_TRAIN_DTYPE", "fp32", str, cache=False)).lower()
+    return "bf16" if raw in ("bf16", "bfloat16") else "fp32"
+
+
+def sharded_feed() -> bool:
+    """Whether :func:`fit` pre-shards batches over the mesh
+    (``MXNET_SHARDED_FEED``, default on)."""
+    return bool(get_env("MXNET_SHARDED_FEED", 1, int, cache=False))
+
+
+def _f32(x):
+    return jnp.asarray(x, dtype=jnp.float32)
+
+
+def _default_mesh(batch_size: int):
+    """Mesh over all local devices, shrunk to the largest count that
+    divides the batch (a batch XLA cannot split evenly would otherwise
+    fail to shard)."""
+    from . import parallel
+
+    devices = jax.devices()
+    n = len(devices)
+    while n > 1 and batch_size % n:
+        n -= 1
+    return parallel.device_mesh(n)
+
+
+def _aval(x):
+    return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)) \
+        if not hasattr(x, "dtype") else jax.ShapeDtypeStruct(
+            jnp.shape(x), x.dtype)
+
+
+class _Ineligible(Exception):
+    """Internal: the graph plane cannot serve this model/config."""
+
+
+class _PlaneBase(object):
+    """Shared jit plumbing of the gluon and Module planes: host prologue,
+    donation bookkeeping, dispatch accounting."""
+
+    @staticmethod
+    def _probe_optimizer(opt):
+        """Throwaway copy for the trace probe: ``_update_count`` /
+        ``_host_scalars`` mutate schedule state (Nadam's m_schedule, rng
+        draws), and a failed probe must leave the real optimizer
+        untouched. ``param_dict`` holds live Parameters (device arrays) —
+        shared by reference, it is only read for lr/wd multipliers."""
+        import copy
+
+        pd, opt.param_dict = opt.param_dict, {}
+        try:
+            probe = copy.deepcopy(opt)
+        finally:
+            opt.param_dict = pd
+        probe.param_dict = pd
+        return probe
+
+    def _host_prologue(self, optimizer, indices):
+        """Per-index counting + scalar prologue — EXACTLY the sequence the
+        eager ``fastpath.fused_apply`` runs, in the same order, so the
+        in-graph update consumes bit-identical scalars (Adam's host f64
+        bias correction included)."""
+        ts, lrs, wds, extras = [], [], [], []
+        for i in indices:
+            optimizer._update_count(i)
+            lr, wd, ex = optimizer._host_scalars(i)
+            ts.append(_f32(optimizer._index_update_count[i]))
+            lrs.append(_f32(lr))
+            wds.append(_f32(wd))
+            extras.append(tuple(ex))
+        return ts, lrs, wds, extras
+
+    def _donation(self, diff_vals, states):
+        """(argnums_ok, consumed) — the shared ``fastpath.fused`` donation
+        discipline, single-sourced."""
+        from .fastpath.fused import donation_prep
+
+        return donation_prep(diff_vals, states)
+
+    def _invalidate_consumed(self, consumed, live):
+        from .fastpath.fused import invalidate_consumed
+
+        invalidate_consumed(consumed, (live,))
+
+
+# ---------------------------------------------------------------------------
+# gluon plane
+# ---------------------------------------------------------------------------
+
+
+class TrainPlane(_PlaneBase):
+    """One training step through whichever plane the model supports.
+
+    ``plane = TrainPlane(net, loss_fn, trainer)`` then
+    ``loss = plane.step(data, label)`` replaces the canonical eager loop
+    body::
+
+        with autograd.record():
+            loss = loss_fn(net(data), label)
+        loss.backward()
+        trainer.step(batch_size)
+
+    With ``MXNET_TRAINSTEP`` at ``auto``/``1`` and a traceable
+    (hybridizable) net, the step runs as ONE compiled SPMD module —
+    forward, loss, backward, dp all-reduce over the mesh and the optimizer
+    update — with the batch sharded over the mesh's ``dp`` axis and
+    parameters/optimizer state replicated. Otherwise the exact eager loop
+    above runs, so the call site never changes.
+
+    The trainer stays the owner of the optimizer and its state
+    (``trainer._updaters[0].states``): checkpoints via
+    ``Trainer.save_states`` keep working, and eager/in-graph steps can be
+    mixed freely (one step counter, no schedule drift).
+
+    Parameters
+    ----------
+    net : Block — trained model (HybridBlock for the compiled plane)
+    loss_fn : gluon Loss (or callable ``(out, label) -> loss`` NDArray)
+    trainer : gluon.Trainer over ``net.collect_params()``
+    mesh : optional jax Mesh; default spans all local devices whose count
+        divides the batch size
+    batch_axis : batch axis of data/label
+    """
+
+    def __init__(self, net, loss_fn, trainer, mesh=None, batch_axis=0):
+        from . import kvstore as kvs_mod
+
+        self._net = net
+        self._loss = loss_fn
+        self._trainer = trainer
+        self._mesh = mesh
+        self._batch_axis = batch_axis
+        self._plane: Optional[str] = None  # 'graph' | 'eager'
+        self._why_eager: Optional[str] = None
+        self._cast = None                  # jnp.bfloat16 under bf16 mode
+        self._rows = None                  # [(trainer idx, Parameter)]
+        self._const_names = None
+        self._jits: Dict[Any, Any] = {}
+        self.step_count = 0
+        # multi-host: join the distributed runtime when a launcher planted
+        # MXNET_COORDINATOR_*; no-op (False) in single-process mode
+        kvs_mod.init_distributed()
+
+    # -- plane selection -----------------------------------------------
+    @property
+    def plane(self) -> str:
+        return self._plane or "undecided"
+
+    def _demote(self, reason: str):
+        FALLBACKS.inc(reason=reason)
+        self._plane = "eager"
+        self._why_eager = reason
+        if mode() == "1":
+            _LOG.warning(
+                "MXNET_TRAINSTEP=1 but the graph plane is unavailable "
+                "(%s); training continues on the eager path", reason)
+
+    def _ineligible_reason(self, data_nd) -> Optional[str]:
+        from . import fastpath
+
+        tr = self._trainer
+        if not fastpath.enabled():
+            # the legacy escape hatch must reach ALL the way down: with
+            # MXNET_FASTPATH=0 an operator is ruling out the fused kernels,
+            # and the graph plane is built on the same tree_kernel
+            return "MXNET_FASTPATH=0 (legacy escape hatch)"
+        if not hasattr(self._net, "_base_fn"):
+            return "net is not a HybridBlock (no traceable base_fn)"
+        if len(tr._contexts) != 1:
+            return "multi-context trainer (eager split_and_load path)"
+        if not tr._kv_initialized:
+            tr._init_kvstore()
+        if tr._update_on_kvstore:
+            return "update_on_kvstore"
+        opt = tr._optimizer
+        if not getattr(opt, "fastpath_capable", False):
+            return "optimizer has no pure _leaf_step kernel"
+        params = self._net.collect_params()
+        for name, p in params.items():
+            if p.grad_req not in ("null", "write"):
+                return "grad_req %r on %s" % (p.grad_req, name)
+            if p.grad_req != "null" and name not in tr._param2idx:
+                return "net parameter %s not owned by the trainer" % name
+        return None
+
+    def _activate(self, data_nd, label_nd, batch_size):
+        # bf16-by-default training mode: cast the model once, keep fp32
+        # master weights in the optimizer state (multi-precision) — a
+        # dtype knob, not a plane knob: applies on BOTH planes (including
+        # the MXNET_TRAINSTEP=0 eager path)
+        if train_dtype() == "bf16":
+            self._cast = jnp.bfloat16
+            self._materialize(data_nd)
+            ctx = self._trainer._contexts[0]
+            anyp = next(iter(self._net.collect_params().values()), None)
+            if anyp is not None and \
+                    anyp.data(ctx)._data.dtype != jnp.bfloat16:
+                self._net.cast("bfloat16")
+            self._trainer._optimizer.multi_precision = True
+        if mode() == "0":
+            self._plane = "eager"
+            self._why_eager = "MXNET_TRAINSTEP=0"
+            return
+        reason = self._ineligible_reason(data_nd)
+        if reason is not None:
+            self._demote(reason)
+            return
+        try:
+            self._prepare_graph(data_nd, label_nd, batch_size)
+            self._plane = "graph"
+        except Exception as exc:  # noqa: BLE001 - auto-fallback contract:
+            # a non-traceable model (host-sync in hybrid_forward, shape-
+            # dependent python control flow, ...) must train, not crash
+            self._demote("trace: %s" % type(exc).__name__)
+
+    # -- graph plane ----------------------------------------------------
+    def _materialize(self, data_nd):
+        """Finish deferred init so every parameter has a value."""
+        params = self._net.collect_params()
+        try:
+            for p in params.values():
+                p.data(self._trainer._contexts[0])
+        except Exception:  # DeferredInitializationError
+            with autograd.pause():
+                self._net(data_nd)
+
+    def _prepare_graph(self, data_nd, label_nd, batch_size):
+        """Resolve rows/mesh and PROBE the whole-step trace (eval_shape:
+        no FLOPs, no device buffers, no counter mutation) before the plane
+        commits to compiling."""
+        tr = self._trainer
+        opt = tr._optimizer
+        self._materialize(data_nd)
+        if self._mesh is None:
+            self._mesh = _default_mesh(int(data_nd.shape[self._batch_axis]))
+        params = self._net.collect_params()
+        rows = []
+        for i, p in enumerate(tr._params):
+            if p.grad_req != "null":
+                rows.append((i, p))
+        if not rows:
+            raise _Ineligible("no trainable parameters")
+        self._rows = rows
+        diff_names = {p.name for _, p in rows}
+        self._const_names = tuple(n for n in params if n not in diff_names)
+
+        # states must exist for the probe; created EXACTLY as the eager
+        # Updater would (same layout, same mp pairs), so a later eager step
+        # adopts them unchanged
+        updater = tr._updaters[0]
+        ctx = tr._contexts[0]
+        for i, p in rows:
+            w = p.data(ctx)
+            if i not in updater.states:
+                updater.states[i] = opt.create_state_multi_precision(i, w)
+                updater.states_synced[i] = True
+            else:
+                updater.states[i] = opt_mod.ensure_mp_state(
+                    opt, i, w, updater.states[i])
+
+        probe_opt = self._probe_optimizer(opt)
+        probe_opt.rescale_grad = tr._scale / batch_size
+        ts, lrs, wds, extras = self._host_prologue(
+            probe_opt, [i for i, _ in rows])
+        step_fn = self._build_step(probe_opt, tuple(
+            self._mp_flags(probe_opt, updater)))
+        # probe on the CURRENT values' avals, NOT on _gather's output: a
+        # failed probe must leave params un-replicated, or the eager
+        # fallback would mix mesh-committed params with single-device
+        # batches (replication happens in _graph_step, after the plane
+        # commits)
+        raw_diff = [p.data(ctx)._data for _, p in rows]
+        raw_const = {n: params[n].data(ctx)._data
+                     for n in self._const_names}
+        raw_states = [updater.states[i] for i, _ in rows]
+        d = data_nd._data if isinstance(data_nd, NDArray) \
+            else jnp.asarray(data_nd)
+        l = label_nd._data if isinstance(label_nd, NDArray) \
+            else jnp.asarray(label_nd)
+        avals = jax.tree_util.tree_map(
+            _aval, (raw_diff, raw_const, raw_states,
+                    ts, lrs, wds, extras, d, l, _global_key()))
+        jax.eval_shape(step_fn, *avals)
+
+    def _mp_flags(self, optimizer, updater):
+        from .fastpath.fused import _is_mp_state
+
+        ctx = self._trainer._contexts[0]
+        return [_is_mp_state(optimizer, i, p.data(ctx), updater.states[i])
+                for i, p in self._rows]
+
+    def _gather(self, updater):
+        """Current param/state values as jax arrays, replicated over the
+        mesh (fresh buffer on first touch — later steps' outputs come back
+        replicated and skip the put)."""
+        from . import parallel
+
+        ctx = self._trainer._contexts[0]
+        params = self._net.collect_params()
+        repl = NamedSharding(self._mesh, P())
+
+        def repl_val(nd):
+            v = nd._data
+            sh = getattr(v, "sharding", None)
+            if sh is None or not sh.is_equivalent_to(repl, v.ndim):
+                v = parallel.fresh_replicate(v, self._mesh)
+                nd._data = v
+            return v
+
+        diff = [repl_val(p.data(ctx)) for _, p in self._rows]
+        const = {n: repl_val(params[n].data(ctx)) for n in self._const_names}
+        states = [jax.tree_util.tree_map(
+            lambda x: x if getattr(x, "sharding", None) is not None
+            and x.sharding.is_equivalent_to(repl, x.ndim)
+            else parallel.fresh_replicate(x, self._mesh),
+            updater.states[i]) for i, _ in self._rows]
+        for (i, _), s in zip(self._rows, states):
+            updater.states[i] = s
+        return {"diff": diff, "const": const, "states": states}
+
+    def _build_step(self, optimizer, mp_flags):
+        """The whole-step function: fwd + loss + bwd (+ GSPMD-inserted dp
+        all-reduce) + the fastpath tree kernel, traced as ONE program."""
+        from . import fastpath
+
+        base_fn = self._net._base_fn([0], train=True)
+        kernel = fastpath.tree_kernel(optimizer, mp_flags)
+        diff_names = tuple(p.name for _, p in self._rows)
+        loss_fn = self._loss
+        cast = self._cast
+
+        def step(diff_vals, const_vals, states, ts, lrs, wds, extras,
+                 data, label, rng):
+            if cast is not None and jnp.issubdtype(data.dtype, jnp.floating):
+                data = data.astype(cast)
+
+            def f(dv):
+                pv = dict(const_vals)
+                pv.update(zip(diff_names, dv))
+                outs, aux = base_fn(pv, rng, data)
+                out0 = outs[0] if isinstance(outs, tuple) else outs
+                with autograd._RecordingStateScope(False, None):
+                    l_nd = loss_fn(NDArray(out0, cpu()),
+                                   NDArray(label, cpu()))
+                return l_nd._data, aux
+
+            loss, vjp_fn, aux = jax.vjp(f, list(diff_vals), has_aux=True)
+            # the same all-ones cotangent loss.backward() seeds eagerly
+            (grads,) = vjp_fn(jnp.ones(loss.shape, loss.dtype))
+            new_ws, new_sts = kernel(
+                list(diff_vals), grads, states, ts, lrs, wds, extras)
+            return loss, new_ws, new_sts, aux
+
+        return step
+
+    def _graph_step(self, data_nd, label_nd, batch_size):
+        tr = self._trainer
+        opt = tr._optimizer
+        updater = tr._updaters[0]
+        ctx = tr._contexts[0]
+        from . import parallel
+
+        opt.rescale_grad = tr._scale / batch_size  # Trainer.step parity
+        for i, p in self._rows:  # states for rows added after activation
+            if i not in updater.states:
+                updater.states[i] = opt.create_state_multi_precision(
+                    i, p.data(ctx))
+                updater.states_synced[i] = True
+        ts, lrs, wds, extras = self._host_prologue(
+            opt, [i for i, _ in self._rows])
+        mp_flags = tuple(self._mp_flags(opt, updater))
+        args = self._gather(updater)
+        d = parallel.shard_to_mesh(data_nd, self._mesh, self._batch_axis)
+        l = parallel.shard_to_mesh(label_nd, self._mesh, self._batch_axis)
+        rng = _global_key()
+
+        argnums, consumed = self._donation(args["diff"], args["states"])
+        key = (tuple(d.shape), str(d.dtype), tuple(l.shape), str(l.dtype),
+               opt.rescale_grad, opt.clip_gradient, mp_flags, argnums,
+               tuple(len(e) for e in extras))
+        fn = self._jits.get(key)
+        if fn is None:
+            repl = NamedSharding(self._mesh, P())
+            fn = jax.jit(self._build_step(opt, mp_flags),
+                         out_shardings=(repl, repl, repl, repl),
+                         donate_argnums=(0, 2) if argnums else ())
+            self._jits[key] = fn
+        loss, new_ws, new_sts, aux = telemetry.jit_call(
+            "trainplane.step", fn, args["diff"], args["const"],
+            args["states"], ts, lrs, wds, extras, d, l, rng)
+
+        params = self._net.collect_params()
+        for (i, p), nw, ns in zip(self._rows, new_ws, new_sts):
+            p.data(ctx)._data = nw
+            updater.states[i] = ns
+        for name, val in aux.items():
+            params[name].data(ctx)._data = val
+        self._invalidate_consumed(consumed, (new_ws, new_sts))
+        telemetry.STEP_DISPATCHES.inc(plane="graph")
+        return NDArray(loss, ctx)
+
+    # -- eager plane ----------------------------------------------------
+    def _eager_step(self, data_nd, label_nd, batch_size):
+        if self._cast is not None and \
+                jnp.issubdtype(data_nd._data.dtype, jnp.floating):
+            data_nd = NDArray(data_nd._data.astype(self._cast),
+                              data_nd.context)
+        with autograd.record():
+            out = self._net(data_nd)
+            loss = self._loss(out, label_nd)
+        loss.backward()
+        self._trainer.step(batch_size)
+        telemetry.STEP_DISPATCHES.inc(plane="eager")
+        return loss
+
+    # -- entry ----------------------------------------------------------
+    def step(self, data, label, batch_size=None):
+        """Run one training step; returns the (per-sample) loss NDArray."""
+        data_nd = data if isinstance(data, NDArray) else NDArray(
+            jnp.asarray(data), cpu())
+        label_nd = label if isinstance(label, NDArray) else NDArray(
+            jnp.asarray(label), cpu())
+        if batch_size is None:
+            batch_size = int(data_nd.shape[self._batch_axis])
+        if self._plane is None:
+            self._activate(data_nd, label_nd, batch_size)
+        self.step_count += 1
+        if self._plane == "graph":
+            return self._graph_step(data_nd, label_nd, batch_size)
+        return self._eager_step(data_nd, label_nd, batch_size)
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def feed_sharding(self, ndim: int):
+        """The NamedSharding batches should arrive in (pre-sharded feed)."""
+        from . import parallel
+
+        if self._mesh is None:
+            return None
+        return parallel.batch_sharding(self._mesh, ndim, self._batch_axis)
+
+
+def _global_key():
+    from . import _global
+
+    return _global.next_key()
+
+
+# ---------------------------------------------------------------------------
+# epoch-loop convenience
+# ---------------------------------------------------------------------------
+
+
+def fit(net, loss_fn, trainer, train_data, epochs=1, batch_axis=0,
+        mesh=None, batch_end_callback=None):
+    """Train ``net`` over ``train_data`` through the active plane.
+
+    ``train_data`` yields ``io.DataBatch``es (any ``DataIter``) or
+    ``(data, label)`` pairs. With the graph plane active and
+    ``MXNET_SHARDED_FEED`` on, batches are staged ahead of the step by a
+    ``DevicePrefetchIter`` laid out over the mesh's ``dp`` axis, so the
+    step never pays a dispatch-serializing ``device_put``. Returns the
+    :class:`TrainPlane` (inspect ``plane.plane`` for which path ran).
+    """
+    from . import io as io_mod
+
+    plane = TrainPlane(net, loss_fn, trainer, mesh=mesh,
+                       batch_axis=batch_axis)
+    feed = train_data
+    if sharded_feed() and mode() != "0" and \
+            isinstance(train_data, io_mod.DataIter) and \
+            not isinstance(train_data, io_mod.DevicePrefetchIter) and \
+            getattr(train_data, "provide_data", None):
+        bs = train_data.provide_data[0].shape[batch_axis]
+        if plane._mesh is None:
+            plane._mesh = _default_mesh(int(bs))
+        feed = io_mod.DevicePrefetchIter(
+            train_data, sharding=plane.feed_sharding)
+    for epoch in range(epochs):
+        if epoch and hasattr(feed, "reset"):
+            feed.reset()
+        nbatch = 0
+        for batch in feed:
+            if isinstance(batch, io_mod.DataBatch):
+                data, label = batch.data[0], batch.label[0]
+            else:
+                data, label = batch
+            loss = plane.step(data, label)
+            nbatch += 1
+            if batch_end_callback is not None:
+                batch_end_callback(epoch, nbatch, loss)
+    return plane
+
+
+# ---------------------------------------------------------------------------
+# Module plane (Module.fit / model.fit / FeedForward.fit)
+# ---------------------------------------------------------------------------
+
+
+class _ModulePlane(_PlaneBase):
+    """Whole-step jit over a bound ``Module``: the Symbol graph's forward,
+    the all-ones-seeded backward and the fastpath update kernel in one
+    compiled module per batch signature. Single-context modules only (the
+    multi-context Module path stays on the eager executor group); the step
+    still collapses forward/backward/update into ONE dispatch."""
+
+    def __init__(self, module):
+        self._m = module
+        self._exec = module._exec_group.execs[0]
+        self._ctx = module._context[0]
+        exec_ = self._exec
+        param_names = [n for n in module._symbol.list_arguments()
+                       if n in module._param_names]
+        self._entries = []
+        for idx, name in enumerate(param_names):
+            req = exec_.grad_req.get(name, "null")
+            if name in exec_.grad_dict and req == "write":
+                self._entries.append((idx, name))
+            elif req not in ("null", "write"):
+                # 'add' (and anything else) accumulates across calls — a
+                # host-visible side effect the compiled step can't honor.
+                # Demote rather than silently freezing the param as a jit
+                # constant while the eager path would keep training it.
+                raise _Ineligible("grad_req %r on %s" % (req, name))
+        if not self._entries:
+            raise _Ineligible("no trainable parameters")
+        self._diff_names = tuple(n for _, n in self._entries)
+        self._jits: Dict[Any, Any] = {}
+        self._sig = None        # cached const-signature for the jit key —
+        self._sig_batch = None  # only the batch arrays ever change shape
+        self._probe()
+
+    def _probe(self):
+        m = self._m
+        exec_ = self._exec
+        opt = self._probe_optimizer(m._optimizer)
+        updater = m._updater
+        for idx, name in self._entries:
+            if idx not in updater.states:
+                updater.states[idx] = m._optimizer \
+                    .create_state_multi_precision(idx, exec_.arg_dict[name])
+                updater.states_synced[idx] = True
+        ts, lrs, wds, extras = self._host_prologue(
+            opt, [i for i, _ in self._entries])
+        step_fn = self._build_step(opt, tuple(self._mp_flags(opt)))
+        args = self._args()
+        avals = jax.tree_util.tree_map(
+            _aval, (args["diff"], args["const"], args["aux"],
+                    args["states"], ts, lrs, wds, extras, _global_key()))
+        jax.eval_shape(step_fn, *avals)
+
+    def _mp_flags(self, optimizer):
+        from .fastpath.fused import _is_mp_state
+
+        updater = self._m._updater
+        return [_is_mp_state(optimizer, i, self._exec.arg_dict[n],
+                             updater.states[i]) for i, n in self._entries]
+
+    def _args(self):
+        exec_ = self._exec
+        updater = self._m._updater
+        diff = [exec_.arg_dict[n]._data for _, n in self._entries]
+        const = {n: a._data for n, a in exec_.arg_dict.items()
+                 if n not in self._diff_names}
+        aux = {n: a._data for n, a in exec_.aux_dict.items()}
+        states = [updater.states[i] for i, _ in self._entries]
+        return {"diff": diff, "const": const, "aux": aux, "states": states}
+
+    def _build_step(self, optimizer, mp_flags):
+        from . import _global, fastpath
+
+        sym = self._m._symbol
+        kernel = fastpath.tree_kernel(optimizer, mp_flags)
+        diff_names = self._diff_names
+
+        def run_graph(arg_vals, aux_vals, rng):
+            prev = _global.set_train(True)
+            _global.push_rng_key(rng)
+            try:
+                vm = dict(arg_vals)
+                vm.update(aux_vals)
+                aux_updates = {}
+                outs = sym.eval_jax(vm, aux_updates=aux_updates)
+            finally:
+                _global.pop_rng_key()
+                _global.set_train(prev)
+            return tuple(outs), aux_updates
+
+        def step(diff_vals, const_vals, aux_vals, states, ts, lrs, wds,
+                 extras, rng):
+            def f(dv):
+                av = dict(const_vals)
+                av.update(zip(diff_names, dv))
+                return run_graph(av, aux_vals, rng)
+
+            outs, vjp_fn, aux_updates = jax.vjp(
+                f, list(diff_vals), has_aux=True)
+            # backward(out_grads=None) parity: all-ones head gradients
+            (grads,) = vjp_fn(tuple(
+                jnp.ones(o.shape, o.dtype) for o in outs))
+            new_ws, new_sts = kernel(
+                list(diff_vals), grads, states, ts, lrs, wds, extras)
+            return outs, aux_updates, new_ws, new_sts
+
+        return step
+
+    def step(self, batch):
+        """One whole-graph training step for a DataBatch; fills the
+        executor's outputs so ``update_metric`` reads them as usual."""
+        m = self._m
+        exec_ = self._exec
+        opt = m._optimizer
+        updater = m._updater
+        group = m._exec_group
+        # stage the batch into the (traced-operand) arg values
+        for name, arr in zip(group.data_names, batch.data):
+            exec_.arg_dict[name]._data = arr._data
+        if group.label_names and batch.label:
+            for name, arr in zip(group.label_names, batch.label):
+                exec_.arg_dict[name]._data = arr._data
+        for idx, name in self._entries:
+            if idx not in updater.states:
+                updater.states[idx] = opt.create_state_multi_precision(
+                    idx, exec_.arg_dict[name])
+                updater.states_synced[idx] = True
+        ts, lrs, wds, extras = self._host_prologue(
+            opt, [i for i, _ in self._entries])
+        mp_flags = tuple(self._mp_flags(opt))
+        args = self._args()
+        rng = _global_key()
+        argnums, consumed = self._donation(args["diff"], args["states"])
+        # const = fixed params + the staged batch; only the batch arrays
+        # can change shape between steps, so the sorted full-signature walk
+        # (O(n log n) host work on the one-dispatch hot path) is rebuilt
+        # only when the batch signature does
+        batch_sig = tuple((tuple(a.shape), str(a.dtype))
+                          for b in (batch.data, batch.label or ())
+                          for a in b)
+        if batch_sig != self._sig_batch:
+            self._sig = tuple(sorted((n, tuple(v.shape), str(v.dtype))
+                                     for n, v in args["const"].items()))
+            self._sig_batch = batch_sig
+        key = (self._sig, opt.rescale_grad, opt.clip_gradient, mp_flags,
+               argnums, tuple(len(e) for e in extras))
+        fn = self._jits.get(key)
+        if fn is None:
+            fn = jax.jit(self._build_step(opt, mp_flags),
+                         donate_argnums=(0, 3) if argnums else ())
+            self._jits[key] = fn
+        outs, aux_updates, new_ws, new_sts = telemetry.jit_call(
+            "trainplane.module_step", fn, args["diff"], args["const"],
+            args["aux"], args["states"], ts, lrs, wds, extras, rng)
+
+        for (i, n), nw, ns in zip(self._entries, new_ws, new_sts):
+            exec_.arg_dict[n]._data = nw
+            updater.states[i] = ns
+        for name, val in aux_updates.items():
+            if name in exec_.aux_dict:
+                exec_.aux_dict[name]._data = val
+        exec_.outputs = [NDArray(o, self._ctx) for o in outs]
+        exec_._output_shapes = [o.shape for o in outs]
+        exec_._residuals = None
+        m._params_dirty = True
+        self._invalidate_consumed(consumed, (new_ws, new_sts))
+        telemetry.STEP_DISPATCHES.inc(plane="graph")
+        return exec_.outputs
+
+
+def module_plane(module):
+    """Build the whole-step graph plane for a bound, optimizer-initialized
+    ``Module`` — or return ``None`` when the eager executor path must run
+    (``MXNET_TRAINSTEP=0``, multi-context, kvstore exchange, custom
+    grad_req, non-traceable graph, ...). ``BaseModule.fit`` calls this once
+    per fit and falls back silently: routing must never break training."""
+    if mode() == "0":
+        return None
+    try:
+        from .module.module import Module
+    except ImportError:
+        return None
+    if type(module) is not Module:
+        return None
+    from . import fastpath
+
+    try:
+        if not fastpath.enabled() \
+                or len(module._context) != 1 or module._kvstore is not None \
+                or module._update_on_kvstore \
+                or not isinstance(module._updater, opt_mod.Updater) \
+                or not getattr(module._optimizer, "fastpath_capable", False) \
+                or module._exec_group is None \
+                or len(module._exec_group.execs) != 1 \
+                or module._exec_group.state_names \
+                or module.inputs_need_grad:
+            FALLBACKS.inc(reason="module-config")
+            return None
+        return _ModulePlane(module)
+    except Exception as exc:  # noqa: BLE001 - auto-fallback contract
+        FALLBACKS.inc(reason="module-trace: %s" % type(exc).__name__)
+        if mode() == "1":
+            _LOG.warning(
+                "MXNET_TRAINSTEP=1 but Module.fit cannot use the graph "
+                "plane (%s); the eager executor path runs instead", exc)
+        return None

@@ -619,3 +619,102 @@ def test_undecodable_and_null_byte_files_are_findings_not_crashes(tmp_path):
     nul.write_bytes(b"x = 1\x00\n")
     found = lint_files([latin, nul], root=tmp_path)
     assert sorted(f.rule for f in found) == ["parse-error", "parse-error"]
+
+
+# ---------------------------------------------------------------------------
+# eager-step
+# ---------------------------------------------------------------------------
+
+def test_eager_step_gluon_idiom_flagged():
+    f = lint("""
+        def train(net, loss_fn, trainer, batches):
+            for x, y in batches:
+                with autograd.record():
+                    loss = loss_fn(net(x), y)
+                loss.backward()
+                trainer.step(x.shape[0])
+        """, rule="eager-step")
+    assert len(f) == 1 and f[0].rule == "eager-step"
+
+
+def test_eager_step_module_idiom_flagged():
+    f = lint("""
+        def fit(self, train_data):
+            for epoch in range(3):
+                for batch in train_data:
+                    self.forward_backward(batch)
+                    self.update()
+        """, rule="eager-step")
+    # both the epoch loop and the batch loop contain the full step
+    assert len(f) == 2
+
+
+def test_eager_step_negative_cases():
+    # a step outside any loop is a single step, not a loop regime
+    f = lint("""
+        def one(net, loss_fn, trainer, x, y):
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(x.shape[0])
+        """, rule="eager-step")
+    assert f == []
+    # forward-only loops (eval/predict) are fine
+    f = lint("""
+        def score(net, batches, metric):
+            for x, y in batches:
+                metric.update(y, net(x))
+        """, rule="eager-step")
+    assert f == []
+    # backward without an update is grad accumulation, not a train step
+    f = lint("""
+        def grads(net, loss_fn, batches):
+            for x, y in batches:
+                with autograd.record():
+                    loss = loss_fn(net(x), y)
+                loss.backward()
+        """, rule="eager-step")
+    assert f == []
+    # ...and metric bookkeeping next to it is still not an optimizer step
+    f = lint("""
+        def grads(net, loss_fn, batches, eval_metric):
+            for x, y in batches:
+                with autograd.record():
+                    out = net(x)
+                    loss = loss_fn(out, y)
+                loss.backward()
+                eval_metric.update(y, out)
+        """, rule="eager-step")
+    assert f == []
+
+
+def test_eager_step_nested_function_not_attributed_to_loop():
+    # a step packaged in a closure defined inside a loop body runs when
+    # called, not per definition — the loop itself is not flagged
+    f = lint("""
+        def build(net, loss_fn, trainer, batches):
+            fns = []
+            for x, y in batches:
+                def one_step(x=x, y=y):
+                    with autograd.record():
+                        loss = loss_fn(net(x), y)
+                    loss.backward()
+                    trainer.step(1)
+                fns.append(one_step)
+            return fns
+        """, rule="eager-step")
+    assert f == []
+
+
+def test_eager_step_scoped_to_mxnet_tpu():
+    src = """
+        def train(net, loss_fn, trainer, batches):
+            for x, y in batches:
+                with autograd.record():
+                    loss = loss_fn(net(x), y)
+                loss.backward()
+                trainer.step(1)
+    """
+    assert lint(src, rule="eager-step",
+                relpath="tools/somewhere.py") == []
+    assert len(lint(src, rule="eager-step")) == 1

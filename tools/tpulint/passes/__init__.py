@@ -1,6 +1,7 @@
 """tpulint analysis passes. Importing this package populates
 ``tpulint.core.REGISTRY`` via the ``@register`` decorator in each module."""
 from . import dtype_drift  # noqa: F401
+from . import eager_step  # noqa: F401
 from . import env_knob  # noqa: F401
 from . import host_sync  # noqa: F401
 from . import native_guard  # noqa: F401
