@@ -20,6 +20,10 @@ import time
 
 QUICK = os.environ.get("BENCH_QUICK", "") not in ("", "0")
 SERVING = os.environ.get("BENCH_SERVING", "") not in ("", "0")
+# BENCH_DECODE=1: LLM decode soak — token-level continuous batching vs a
+# restart-per-batch baseline at the same slot count, mixed prompt/output
+# lengths, steady-state recompiles gauge-gated to 0 (rc != 0 otherwise)
+DECODE = os.environ.get("BENCH_DECODE", "") not in ("", "0")
 # BENCH_CHAOS=1: run the bench under injected faults (MXNET_CHAOS spec, or
 # a default mild schedule) — proves the resilience layer holds the numbers
 # up under transient failures, and stamps fault/retry counters on the line
@@ -33,6 +37,10 @@ _DEFAULT_CHAOS = "seed=7,site=transfer.*,p=0.2"
 # server, no policy), so faults outside the server's retry boundary would
 # measure the baseline's fragility, not the server's resilience
 _DEFAULT_CHAOS_SERVING = "seed=7,site=serving.engine,p=0.1"
+# decode mode steps once per TOKEN, so even a small rate injects plenty;
+# scoped to the step site so the retry/evict machinery (not the queue) is
+# what gets exercised
+_DEFAULT_CHAOS_DECODE = "seed=7,site=serving.decode,p=0.01"
 
 TRAIN_BASELINE = 298.51   # V100 ResNet-50 train bs=32 fp32, perf.md:214
 INFER_BASELINE = 1076.81  # V100 ResNet-50 infer bs=32 fp32, perf.md:156
@@ -87,8 +95,12 @@ def _maybe_enable_chaos():
     from mxnet_tpu.resilience import chaos
 
     if not chaos.ENABLED:
-        chaos.configure(_DEFAULT_CHAOS_SERVING if SERVING
-                        else _DEFAULT_CHAOS)
+        if DECODE:
+            chaos.configure(_DEFAULT_CHAOS_DECODE)
+        elif SERVING:
+            chaos.configure(_DEFAULT_CHAOS_SERVING)
+        else:
+            chaos.configure(_DEFAULT_CHAOS)
 
 
 def _acquire_backend(timeout_s=120.0, retries=2):
@@ -443,7 +455,163 @@ def _serving_bench():
     return 1 if errors or recompiles else 0
 
 
+def _decode_bench():
+    """BENCH_DECODE=1 mode: token-level continuous batching decode soak.
+
+    Mixed prompt lengths and LONG-TAIL output lengths (most sequences
+    short, a few long — the shape real chat traffic has) through the
+    TinyDecoder reference model. Two runs at the SAME slot count:
+
+    * continuous — all requests queued up front; the engine re-admits a
+      freed slot on the same tick (token-level continuous batching);
+    * restart-per-batch baseline — requests submitted in waves of
+      ``num_slots`` and each wave drained before the next starts, i.e. a
+      finished sequence strands its slot until the longest member of its
+      wave completes (the PR-2 request-granularity regime).
+
+    Prints ONE JSON line: continuous decode tokens/s, speedup vs the
+    baseline, slot occupancy, TTFT/TPOT percentiles and the steady-state
+    recompile count for BOTH engines (gauge-gated: rc != 0 when > 0)."""
+    deadline = float(os.environ.get("MXNET_BENCH_DEADLINE_S",
+                                    "240" if QUICK else "1500"))
+    printed = threading.Event()
+    # every emitted line (success, error AND watchdog) carries whatever
+    # decode numbers were measured by then
+    part = {"phase": "backend-init", "decode_tokens_s": None,
+            "slot_occupancy": None, "ttft_p50_ms": None, "ttft_p99_ms": None,
+            "tpot_p50_ms": None, "tpot_p99_ms": None,
+            "baseline_tokens_s": None, "steady_state_recompiles": None}
+
+    def line(value, vs_baseline, error=None, extra=None):
+        out = {
+            "metric": "decode tokens/s (continuous batching, TinyDecoder)",
+            "value": value, "unit": "tokens/s", "vs_baseline": vs_baseline,
+            "extra": dict(part, **(extra or {})),
+        }
+        if error:
+            out["error"] = error
+        print(json.dumps(_attach_telemetry(out)))
+        sys.stdout.flush()
+
+    def watchdog():
+        time.sleep(deadline)
+        if not printed.is_set():
+            line(part["decode_tokens_s"], None,
+                 error="deadline %.0fs hit during phase %r (accelerator "
+                       "tunnel stall suspected)" % (deadline, part["phase"]))
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    devices = _acquire_backend()
+    import numpy as np
+
+    from mxnet_tpu import serving
+
+    _maybe_enable_chaos()
+
+    if QUICK:
+        slots, max_seq, n_req = 8, 160, 48
+        model = serving.TinyDecoder(vocab_size=64, num_layers=2,
+                                    num_heads=4, head_dim=8)
+    else:
+        slots, max_seq, n_req = 16, 1152, 256
+        model = serving.TinyDecoder(vocab_size=1024, num_layers=4,
+                                    num_heads=8, head_dim=64)
+    params = model.init_params(0)
+    rng = np.random.RandomState(0)
+    # long-tail output mix: mostly short answers, a few long ones — the
+    # distribution where restart-per-batch strands the most slot-time
+    out_mix = ([12] * 3 + [24] * 2 + [48, 96, 144]) if QUICK else \
+        ([16] * 3 + [64] * 2 + [256, 512, 1024])
+    reqs = []
+    for i in range(n_req):
+        p = int(rng.randint(4, 17 if QUICK else 24))
+        m = out_mix[i % len(out_mix)]
+        reqs.append((np.asarray(rng.randint(1, model.vocab_size, p),
+                                np.int32), int(m)))
+
+    def run(name, wave_mode):
+        eng = serving.DecodeEngine(
+            model, params, num_slots=slots, max_seq_len=max_seq,
+            prefill_buckets=(8, 16, 32), name=name, timeout_ms=0)
+        eng.warmup()
+        # untimed warm lap: absorb first-run process costs (dispatch-path
+        # first touches, allocator warm) so the PHASE ORDER doesn't bias
+        # the continuous-vs-restart comparison; tokens are delta-counted
+        for f in [eng.submit([1, 2, 3], 4) for _ in range(2 * slots)]:
+            f.result(timeout=600)
+        warm_tokens = eng.stats()["tokens_generated"]
+        t0 = time.perf_counter()
+        errors = []
+        if wave_mode:
+            for i in range(0, len(reqs), slots):
+                futs = [eng.submit(p, m) for p, m in reqs[i:i + slots]]
+                for f in futs:
+                    try:
+                        f.result(timeout=600)
+                    except Exception as e:  # noqa: BLE001 - surfaced below
+                        errors.append(repr(e))
+        else:
+            futs = [eng.submit(p, m) for p, m in reqs]
+            for f in futs:
+                try:
+                    f.result(timeout=600)
+                except Exception as e:  # noqa: BLE001 - surfaced below
+                    errors.append(repr(e))
+        elapsed = time.perf_counter() - t0
+        stats = eng.stats()
+        eng.close()
+        rate = (stats["tokens_generated"] - warm_tokens) / elapsed
+        return rate, stats, errors
+
+    part["phase"] = "continuous"
+    cont_rate, cont_stats, cont_err = run("bench-decode", wave_mode=False)
+    part["decode_tokens_s"] = round(cont_rate, 2)
+    part["slot_occupancy"] = round(cont_stats["slot_occupancy"], 4)
+    for k in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms", "tpot_p99_ms"):
+        part[k] = round(cont_stats[k], 3)
+    part["steady_state_recompiles"] = \
+        cont_stats.get("steady_state_recompiles")
+
+    part["phase"] = "restart-per-batch-baseline"
+    base_rate, base_stats, base_err = run("bench-decode-base",
+                                          wave_mode=True)
+    part["baseline_tokens_s"] = round(base_rate, 2)
+    part["phase"] = "done"
+
+    recompiles = cont_stats.get("steady_state_recompiles")
+    base_recompiles = base_stats.get("steady_state_recompiles")
+    errors = cont_err + base_err
+    gate_err = None
+    if recompiles:
+        gate_err = ("continuous decode recompiled %d time(s) in steady "
+                    "state (gate: 0 — membership churn must not retrace)"
+                    % recompiles)
+    elif errors:
+        gate_err = "; ".join(errors[:3])
+    extra = {
+        "requests": n_req, "slots": slots,
+        "baseline_slot_occupancy": round(base_stats["slot_occupancy"], 4),
+        "baseline_steady_state_recompiles": base_recompiles,
+        "speedup_vs_restart_per_batch": (round(cont_rate / base_rate, 4)
+                                         if base_rate else None),
+        "tokens_generated": cont_stats["tokens_generated"],
+        "prefill_buckets": cont_stats["prefill_buckets"],
+        "device": str(devices[0]),
+        "baseline": "same engine + slot count, requests admitted in "
+                    "drain-before-refill waves (request-granularity "
+                    "batching)",
+    }
+    printed.set()
+    line(round(cont_rate, 2),
+         round(cont_rate / base_rate, 4) if base_rate else None,
+         error=gate_err, extra=extra)
+    return 1 if gate_err else 0
+
+
 def main():
+    if DECODE:
+        return _decode_bench()
     if SERVING:
         return _serving_bench()
     # Deadline watchdog: the accelerator tunnel can wedge mid-phase with the

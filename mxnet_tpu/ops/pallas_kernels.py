@@ -35,6 +35,16 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` across the rename: jax >= 0.5 calls it
+    ``CompilerParams``, 0.4.x ``TPUCompilerParams`` — same fields."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
+
+
 def _pad_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
@@ -201,7 +211,7 @@ def _flash_forward(q, k, v, scale, causal, block_q=128, block_k=128):
             pltpu.VMEM((bq, LANES), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(qp, kp, vp)
@@ -253,6 +263,227 @@ def _fa_bwd(scale, causal, res, g):
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Ragged paged-attention decode (TPU LLM serving kernel)
+# ---------------------------------------------------------------------------
+#
+# The decode-plane attention of mxnet_tpu.serving.decode: each of S decode
+# slots holds ONE new query token that must attend to that sequence's whole
+# KV history, which lives scattered across fixed-size pages of a static
+# device pool (serving.kvcache). Shapes are static in (S, max_pages,
+# page_size) regardless of how many sequences are live or how long each
+# one is — membership churn and ragged lengths never retrace (the Ragged
+# Paged Attention argument, PAPERS.md).
+#
+# Kernel layout: grid (S, max_pages); the page axis is the innermost
+# ("arbitrary") dimension and carries online-softmax state (running max m,
+# normalizer l, accumulator acc) in VMEM scratch, exactly the flash-kernel
+# idiom above. The page table and sequence lengths ride in as
+# scalar-prefetch operands (PrefetchScalarGridSpec), so the K/V BlockSpec
+# index_map dereferences the page table — the pool page is DMA'd straight
+# into VMEM with no gather op in the kernel body. Interpret mode runs the
+# same kernel on the CPU test mesh; `paged_attention` (the dispatcher the
+# decode engine calls) uses the dense jnp reference off-TPU instead, which
+# is faster than interpreting and bit-comparable within fp tolerance.
+
+
+def _paged_kernel(pt_ref, sl_ref, qp_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, page_size, max_pages, groups,
+                  scale, causal):
+    """One (slot, page) cell of ragged paged attention.
+
+    q_ref: (1, Hp, D) — the slot's single query token (heads padded to the
+    sublane tile); k_ref/v_ref: (1, page_size, KH, D) — the page named by
+    the slot's page table; o_ref: (1, Hp, D). Scratch m/l: (Hp, LANES),
+    acc: (Hp, D).
+    """
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32)            # (Hp, D)
+    k = k_ref[0].astype(jnp.float32)            # (page_size, KH, D)
+    v = v_ref[0].astype(jnp.float32)
+    hp = q.shape[0]
+    kh = k.shape[1]
+
+    # scores (Hp, page_size): head h attends kv head h // groups. Per-kv-
+    # head 2D matmuls keep the MXU fed without a batched einsum; kh is a
+    # small trace-time constant so the python loop unrolls.
+    scores = jnp.zeros((hp, page_size), jnp.float32)
+    for khi in range(kh):
+        qh = lax.dynamic_slice_in_dim(q, khi * groups, groups, 0)
+        sk = jax.lax.dot_general(qh, k[:, khi, :], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        scores = lax.dynamic_update_slice_in_dim(scores, sk, khi * groups, 0)
+    scores = scores * scale
+
+    # ragged mask: token positions of this page vs the slot's length (and
+    # its query position when causal). Padded table entries point at page
+    # 0; the position mask kills them, so the duplicate load is harmless.
+    pos = j * page_size + lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+    valid = pos < sl_ref[s]
+    if causal:
+        valid = jnp.logical_and(valid, pos <= qp_ref[s])
+    scores = jnp.where(valid, scores, _NEG_BIG)
+
+    m_prev = m_scr[:, :1]
+    l_prev = l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new)
+    l_new = alpha * l_prev + p.sum(axis=-1, keepdims=True)
+    pv = jnp.zeros_like(acc_scr[...])
+    for khi in range(kh):
+        ph = lax.dynamic_slice_in_dim(p, khi * groups, groups, 0)
+        av = jax.lax.dot_general(ph, v[:, khi, :], (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        pv = lax.dynamic_update_slice_in_dim(pv, av, khi * groups, 0)
+    acc_scr[...] = acc_scr[...] * alpha + pv
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == max_pages - 1)
+    def _finish():
+        # a fully-masked row (inactive slot, seq_len 0) never raises the
+        # running max off the sentinel: its p = exp(NEG_BIG - NEG_BIG) = 1
+        # accumulates garbage the flash kernel tolerates only because it
+        # drops padded rows — here the row IS the slot's output, so gate
+        # on the max and emit zeros instead
+        seen = m_scr[:, :1] > _NEG_BIG * 0.5
+        o = jnp.where(seen,
+                      acc_scr[...] / jnp.maximum(l_scr[:, :1], 1e-30), 0.0)
+        o_ref[0] = o.astype(o_ref.dtype)
+
+
+def ragged_paged_attention(q, k_pool, v_pool, page_table, seq_lens,
+                           q_pos=None, scale=None, interpret=None):
+    """Ragged paged-attention for decode: one query token per slot.
+
+    q: (S, H, D); k_pool/v_pool: (P, page_size, KH, D) static pools;
+    page_table: (S, max_pages) int32 page ids (unused entries MUST point
+    at a valid page — the ragged mask drops them); seq_lens: (S,) int32
+    tokens live per slot (0 = inactive slot, output row is zeros).
+    q_pos: optional (S,) int32 — when given, the causal bound: positions
+    > q_pos[s] are masked even if < seq_lens[s] (decode passes None: the
+    new token sits at seq_len - 1 and sees the whole prefix).
+    H % KH == 0 (grouped-query attention: head h reads kv head h // g).
+
+    Static in every shape — membership churn, ragged lengths and page
+    reassignment never recompile. Returns (S, H, D).
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    s_slots, n_heads, d = q.shape
+    n_pages_pool, page_size, n_kv, _ = k_pool.shape
+    if n_heads % n_kv:
+        raise ValueError("ragged_paged_attention: %d heads not divisible "
+                         "by %d kv heads" % (n_heads, n_kv))
+    groups = n_heads // n_kv
+    max_pages = page_table.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    causal = q_pos is not None
+    if interpret is None:
+        interpret = _interpret()
+
+    # heads padded to the f32 sublane tile. Pad rows are never written by
+    # the per-kv-head loops (they cover exactly n_heads rows), each score
+    # row's softmax state is independent, and the pad rows are sliced off
+    # on return — so the padding is layout-only, not math.
+    hp = _pad_up(n_heads, _PACK_ROWS)
+    qp = jnp.pad(q, ((0, 0), (0, hp - n_heads), (0, 0)))
+    kernel = functools.partial(
+        _paged_kernel, page_size=page_size, max_pages=max_pages,
+        groups=groups, scale=float(scale), causal=causal)
+    pt_flat = page_table.astype(jnp.int32).ravel()
+    sl = seq_lens.astype(jnp.int32)
+    qpos = (q_pos.astype(jnp.int32) if causal
+            else jnp.zeros_like(sl))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(s_slots, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, hp, d), lambda s, j, pt, sl, qp_: (s, 0, 0)),
+            pl.BlockSpec((1, page_size, n_kv, d),
+                         lambda s, j, pt, sl, qp_:
+                         (pt[s * max_pages + j], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, n_kv, d),
+                         lambda s, j, pt, sl, qp_:
+                         (pt[s * max_pages + j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, hp, d),
+                               lambda s, j, pt, sl, qp_: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((hp, LANES), jnp.float32),
+            pltpu.VMEM((hp, LANES), jnp.float32),
+            pltpu.VMEM((hp, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((s_slots, hp, d), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(pt_flat, sl, qpos, qp, k_pool, v_pool)
+    return out[:, :n_heads]
+
+
+def paged_attention_reference(q, k_pool, v_pool, page_table, seq_lens,
+                              q_pos=None, scale=None):
+    """Dense jnp ragged paged attention — the kernel's parity oracle and
+    the decode path on non-TPU backends (faster than interpret mode;
+    gathers (S, max_pages*page_size) KV views, so it trades the kernel's
+    O(page) VMEM residency for plain XLA gathers)."""
+    s_slots, n_heads, d = q.shape
+    _, page_size, n_kv, _ = k_pool.shape
+    groups = n_heads // n_kv
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    t = page_table.shape[1] * page_size
+    # (S, max_pages, page_size, KH, D) -> (S, T, KH, D)
+    k = k_pool[page_table].reshape(s_slots, t, n_kv, d)
+    v = v_pool[page_table].reshape(s_slots, t, n_kv, d)
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+    scores = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    pos = jnp.arange(t, dtype=jnp.int32)
+    valid = pos[None, :] < seq_lens.astype(jnp.int32)[:, None]
+    if q_pos is not None:
+        valid = valid & (pos[None, :] <= q_pos.astype(jnp.int32)[:, None])
+    scores = jnp.where(valid[:, None, :], scores, _NEG_BIG)
+    any_valid = valid.any(axis=1)[:, None, None]
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("sht,sthd->shd", p, v.astype(jnp.float32))
+    return jnp.where(any_valid, out, 0.0).astype(q.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, page_table, seq_lens, q_pos=None,
+                    scale=None):
+    """Dispatcher the decode engine traces: the Pallas kernel on TPU (when
+    the pool meets the (8, 128) tiling), the jnp reference elsewhere —
+    same math, tested for parity in interpret mode."""
+    page_size = k_pool.shape[1]
+    d = k_pool.shape[3]
+    if jax.default_backend() == "tpu" and page_size % 8 == 0 \
+            and d % LANES == 0:
+        return ragged_paged_attention(q, k_pool, v_pool, page_table,
+                                      seq_lens, q_pos=q_pos, scale=scale,
+                                      interpret=False)
+    return paged_attention_reference(q, k_pool, v_pool, page_table,
+                                     seq_lens, q_pos=q_pos, scale=scale)
 
 
 def _register_flash_attention_op():

@@ -264,7 +264,10 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh,
     Returns (M, B, ...) outputs (the last stage's results, in microbatch
     order), fully replicated.
     """
-    from jax import shard_map
+    try:  # jax >= 0.5 exports it at the top level
+        from jax import shard_map
+    except ImportError:  # the 0.4.x experimental home
+        from jax.experimental.shard_map import shard_map
 
     n_stage = mesh.shape[axis]
     n_micro = microbatches.shape[0]

@@ -34,6 +34,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.5 exports it at the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # the 0.4.x experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
 
@@ -132,7 +137,7 @@ def ring_attention(q, k, v, mesh: Optional[Mesh] = None, causal: bool = False,
     if scale is None:
         scale = 1.0 / float(np.sqrt(d))
     spec = P(None, None, axis_name, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
                           causal=causal, scale=scale, seq_len_local=s // n),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
@@ -190,7 +195,7 @@ def ulysses_attention(q, k, v, mesh: Optional[Mesh] = None,
     if scale is None:
         scale = 1.0 / float(np.sqrt(d))
     spec = P(None, None, axis_name, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(_ulysses_local, axis_name=axis_name, causal=causal,
                           scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)

@@ -19,7 +19,14 @@ Pieces
   resilience (retry under the ``mxnet_tpu.resilience`` policy, a circuit
   breaker per engine, AOT→Block fallback, engine load-shed);
 * :mod:`~mxnet_tpu.serving.stats`    — counters + latency reservoir
-  behind ``Server.stats()``, bridged to ``profiler`` Counters/Markers.
+  behind ``Server.stats()``, bridged to ``profiler`` Counters/Markers;
+* :mod:`~mxnet_tpu.serving.kvcache`  — paged KV cache for autoregressive
+  decode: static device pools, host free-list allocator, per-sequence
+  page tables;
+* :mod:`~mxnet_tpu.serving.decode`   — :class:`DecodeEngine`: token-level
+  continuous batching over fixed decode slots, one jitted step per tick,
+  prefill through a bucket ladder, ragged paged-attention reads
+  (:mod:`mxnet_tpu.ops.pallas_kernels`) — the LLM serving plane.
 
 Typical use::
 
@@ -41,7 +48,9 @@ from .batcher import (EngineUnavailableError, QueueFullError,
                       RequestTimeoutError, Server, ServerClosedError,
                       ServingError)
 from .buckets import bucket_ladder, pad_to_bucket, select_bucket
+from .decode import DecodeEngine, PagedDecodeModel, TinyDecoder
 from .engine import BlockEngine, Engine, StableHLOEngine
+from .kvcache import OutOfPagesError, PagedKVCache
 from .stats import ServingStats
 
 __all__ = [
@@ -51,6 +60,8 @@ __all__ = [
     "ServingStats",
     "bucket_ladder", "select_bucket", "pad_to_bucket",
     "serve_block", "serve_stablehlo",
+    "DecodeEngine", "PagedDecodeModel", "TinyDecoder",
+    "PagedKVCache", "OutOfPagesError",
 ]
 
 

@@ -1,0 +1,240 @@
+"""Paged KV cache: static device pools + a host-side page allocator.
+
+The HBM discipline of autoregressive decode. A contiguous per-sequence KV
+buffer must be sized for the longest sequence it might ever hold, so a
+batch of mixed lengths strands most of its HBM in padding; and growing a
+buffer changes its shape, which retraces. Paging fixes both at once
+(Ragged Paged Attention, PAPERS.md): KV lives in ONE statically-shaped
+pool of fixed-size pages per layer, a sequence owns whatever pages it
+needs right now through a page table, and the ragged attention kernel
+(:func:`mxnet_tpu.ops.pallas_kernels.paged_attention`) reads through the
+table — so allocation is a host-side free-list operation that never
+touches a device shape. Nothing recompiles as sequences come, grow and
+go.
+
+Split of responsibilities:
+
+* **host side (this class)** — the free list, the per-slot page tables
+  and lengths (numpy, static shapes), admission accounting, and the
+  ``mxnet_kvcache_pages_in_use`` gauge;
+* **device side (pure helpers)** — :func:`write_kv` scatters one step's
+  new K/V rows into the pools at host-computed (page, offset) slots;
+  traced inside the decode/prefill jit, static shapes throughout.
+
+Page 0 is reserved as the *null page*: page-table padding and inactive
+decode slots point at it (the BlockSpec index map must always name a
+real page), and masked reads/garbage writes land there harmlessly. The
+allocator never hands it out.
+
+Knobs (``docs/env_var.md``): ``MXNET_KVCACHE_PAGE_SIZE`` (default 16
+tokens/page), ``MXNET_KVCACHE_PAGES`` (0 = auto-size to the slot count x
+max sequence length, + the null page).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import telemetry
+from ..base import MXNetError, get_env
+
+__all__ = ["PagedKVCache", "OutOfPagesError", "write_kv"]
+
+_DEFAULT_PAGE_SIZE = 16
+
+_T_PAGES = telemetry.gauge(
+    "mxnet_kvcache_pages_in_use",
+    "KV cache pages currently allocated to live sequences",
+    labels=("cache",))
+_T_CAPACITY = telemetry.gauge(
+    "mxnet_kvcache_pages_capacity",
+    "allocatable KV cache pages in the pool (excludes the null page)",
+    labels=("cache",))
+
+
+class OutOfPagesError(MXNetError):
+    """The free list cannot cover the requested reservation; the caller
+    (the decode engine's admission loop) defers the sequence instead of
+    growing the pool — static shapes are the contract."""
+
+
+def write_kv(k_pool, v_pool, layer: int, k_new, v_new, pages, offsets):
+    """Scatter one batch of new K/V rows into the layer's pool pages.
+
+    k_pool/v_pool: (L, P, page_size, KH, D) device pools (traced);
+    k_new/v_new: (N, KH, D) rows; pages/offsets: (N,) int32 destinations
+    (host-computed by :meth:`PagedKVCache.write_slots`). Returns the
+    updated pools. Pure — trace it inside the step jit; every shape is
+    static, so membership churn never recompiles. Rows whose destination
+    is the null page (inactive slots, prompt padding) overwrite garbage
+    with garbage by design.
+    """
+    k_pool = k_pool.at[layer, pages, offsets].set(k_new)
+    v_pool = v_pool.at[layer, pages, offsets].set(v_new)
+    return k_pool, v_pool
+
+
+class PagedKVCache:
+    """Fixed-size paged KV pools for ``num_slots`` concurrent sequences.
+
+    Device state: ``k_pool``/``v_pool`` of shape ``(num_layers,
+    num_pages, page_size, num_kv_heads, head_dim)`` — allocated once,
+    shape-stable for the cache's lifetime. The decode engine threads the
+    pools through its jitted step (functional update) and stores the
+    returned arrays back via :meth:`swap_pools`.
+
+    Host state per slot: a fixed-width page-table row (``max_pages``
+    entries, unused entries = the null page 0) and a token count. The
+    free list is LIFO — a page freed by one sequence is the next page
+    another acquires, which the reuse regression test pins.
+    """
+
+    def __init__(self, num_slots: int, max_seq_len: int, num_layers: int,
+                 num_kv_heads: int, head_dim: int, page_size: Optional[int]
+                 = None, num_pages: Optional[int] = None, dtype="float32",
+                 name: str = "decode"):
+        import jax.numpy as jnp
+
+        from ..base import np_dtype
+
+        if page_size is None:
+            page_size = get_env("MXNET_KVCACHE_PAGE_SIZE",
+                                _DEFAULT_PAGE_SIZE, int, cache=False)
+        if num_pages is None:
+            num_pages = get_env("MXNET_KVCACHE_PAGES", 0, int, cache=False)
+        self.page_size = max(1, int(page_size))
+        self.num_slots = int(num_slots)
+        self.max_seq_len = int(max_seq_len)
+        self.max_pages = -(-self.max_seq_len // self.page_size)
+        if not num_pages:
+            # worst case: every slot holds a max-length sequence; +1 null
+            num_pages = self.num_slots * self.max_pages + 1
+        if num_pages < 2:
+            raise MXNetError("kvcache needs >= 2 pages (null + 1), got %d"
+                             % num_pages)
+        self.num_pages = int(num_pages)
+        self.name = name
+        shape = (int(num_layers), self.num_pages, self.page_size,
+                 int(num_kv_heads), int(head_dim))
+        self.k_pool = jnp.zeros(shape, np_dtype(dtype))
+        self.v_pool = jnp.zeros(shape, np_dtype(dtype))
+        # LIFO free list over pages 1..P-1; page 0 is the null page
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self.page_table = np.zeros((self.num_slots, self.max_pages),
+                                   np.int32)
+        self.seq_lens = np.zeros((self.num_slots,), np.int32)
+        self._owned = [0] * self.num_slots  # pages held per slot
+        # bumped on every table mutation (reserve/free): the decode
+        # engine keys its cached DEVICE copy of the page table on it, so
+        # steady decode ticks skip the host->device put entirely
+        self.version = 0
+        _T_CAPACITY.set(self.num_pages - 1, cache=self.name)
+        _T_PAGES.set(0, cache=self.name)
+
+    # -- accounting --------------------------------------------------------
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - 1 - len(self._free)
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages a sequence of ``n_tokens`` occupies."""
+        return -(-int(n_tokens) // self.page_size)
+
+    def can_admit(self, n_tokens: int) -> bool:
+        """Whether a full reservation for ``n_tokens`` fits right now."""
+        return self.pages_for(n_tokens) <= len(self._free)
+
+    # -- allocation --------------------------------------------------------
+    def reserve(self, slot: int, n_tokens: int) -> None:
+        """Grow ``slot``'s page run to cover ``n_tokens`` total tokens.
+
+        The decode engine reserves a sequence's WORST CASE (prompt +
+        max_new_tokens) at admission, so a sequence admitted can always
+        finish — no mid-flight eviction for lack of pages. Raises
+        :class:`OutOfPagesError` (leaving the slot unchanged) when the
+        free list can't cover it.
+        """
+        if n_tokens > self.max_seq_len:
+            raise MXNetError(
+                "sequence of %d tokens exceeds max_seq_len %d"
+                % (n_tokens, self.max_seq_len))
+        need = self.pages_for(n_tokens) - self._owned[slot]
+        if need <= 0:
+            return
+        if need > len(self._free):
+            raise OutOfPagesError(
+                "kvcache %r: need %d pages, %d free (pool %d)"
+                % (self.name, need, len(self._free), self.num_pages - 1))
+        for _ in range(need):
+            page = self._free.pop()
+            self.page_table[slot, self._owned[slot]] = page
+            self._owned[slot] += 1
+        self.version += 1
+        _T_PAGES.set(self.pages_in_use, cache=self.name)
+
+    def free(self, slot: int) -> None:
+        """Return every page ``slot`` owns to the free list and reset its
+        table row to the null page. Idempotent."""
+        for i in range(self._owned[slot]):
+            self._free.append(int(self.page_table[slot, i]))
+        self.page_table[slot, :] = 0
+        self.seq_lens[slot] = 0
+        self._owned[slot] = 0
+        self.version += 1
+        _T_PAGES.set(self.pages_in_use, cache=self.name)
+
+    # -- write-slot computation (host) -------------------------------------
+    def write_slots(self, slot: int, start: int,
+                    n_tokens: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(pages, offsets) int32 arrays addressing token positions
+        ``start .. start+n_tokens`` of ``slot`` — the destinations
+        :func:`write_kv` scatters into. Positions must be covered by a
+        prior :meth:`reserve`."""
+        pos = np.arange(start, start + n_tokens)
+        if n_tokens and pos[-1] >= self._owned[slot] * self.page_size:
+            raise MXNetError(
+                "write past slot %d's reservation (pos %d, %d pages)"
+                % (slot, int(pos[-1]), self._owned[slot]))
+        pages = self.page_table[slot, pos // self.page_size]
+        offsets = (pos % self.page_size).astype(np.int32)
+        return pages.astype(np.int32), offsets
+
+    def null_write_slots(self, n_tokens: int) -> Tuple[np.ndarray,
+                                                       np.ndarray]:
+        """Destinations for rows that must go NOWHERE (inactive decode
+        slots, prompt padding): the null page, offset cycling through the
+        page so scatter indices stay in range."""
+        pos = np.arange(n_tokens)
+        return (np.zeros(n_tokens, np.int32),
+                (pos % self.page_size).astype(np.int32))
+
+    def swap_pools(self, k_pool, v_pool) -> None:
+        """Store the pools returned by a jitted step (functional update
+        discipline; with donation the old buffers are already dead)."""
+        self.k_pool = k_pool
+        self.v_pool = v_pool
+
+    def reset_pools(self) -> None:
+        """Fresh zeroed pools (same shapes). The eviction path calls this
+        after a failed step: with donation on, the old buffers may have
+        been consumed by the failed execution, and every future sequence
+        rewrites its pages through prefill before reading them anyway."""
+        import jax.numpy as jnp
+
+        shape, dtype = self.k_pool.shape, self.k_pool.dtype
+        self.k_pool = jnp.zeros(shape, dtype)
+        self.v_pool = jnp.zeros(shape, dtype)
+
+    def stats(self) -> dict:
+        return {
+            "pages_in_use": self.pages_in_use,
+            "pages_free": self.pages_free,
+            "pages_capacity": self.num_pages - 1,
+            "page_size": self.page_size,
+            "max_pages_per_seq": self.max_pages,
+        }

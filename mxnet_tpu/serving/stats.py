@@ -62,6 +62,16 @@ _T_ENGINE = telemetry.counter(
     "engine-level resilience events (failure after retries, fallback "
     "serve, load-shed with every breaker open)",
     labels=("server", "engine", "event"))
+_T_TTFT = telemetry.histogram(
+    "mxnet_serving_ttft_ms",
+    "time to first token: submit to the first generated token "
+    "(decode plane) in milliseconds",
+    labels=("server",))
+_T_TPOT = telemetry.histogram(
+    "mxnet_serving_tpot_ms",
+    "time per output token: inter-token interval during decode in "
+    "milliseconds",
+    labels=("server",))
 
 
 class ServingStats:
@@ -73,6 +83,11 @@ class ServingStats:
                              int, cache=False)
         self._lock = threading.Lock()
         self._lat_ms = collections.deque(maxlen=max(1, int(window)))
+        # decode-plane reservoirs: first-token latency (TTFT) and the
+        # inter-token interval (TPOT) — the two numbers an LLM serving
+        # SLO is written in. Empty (and snapshot-zero) for batch servers.
+        self._ttft_ms = collections.deque(maxlen=max(1, int(window)))
+        self._tpot_ms = collections.deque(maxlen=max(1, int(window)))
         self.submitted = 0
         self.completed = 0
         self.shed = 0
@@ -140,6 +155,28 @@ class ServingStats:
         _T_REQS.inc(server=self.name, event="completed")
         _T_LATENCY.observe(latency_ms, server=self.name)
 
+    def on_first_token(self, ttft_ms: float):
+        """First generated token of a sequence delivered (decode plane):
+        submit-to-first-token latency."""
+        with self._lock:
+            self._ttft_ms.append(ttft_ms)
+        _T_TTFT.observe(ttft_ms, server=self.name)
+
+    def on_output_token(self, tpot_ms: float):
+        """One subsequent output token (decode plane): interval since the
+        sequence's previous token."""
+        with self._lock:
+            self._tpot_ms.append(tpot_ms)
+        _T_TPOT.observe(tpot_ms, server=self.name)
+
+    def on_output_tokens(self, tpot_ms_batch):
+        """One decode tick's worth of output tokens (one TPOT sample per
+        active slot): single lock acquisition per tick, not per token —
+        this sits on the per-token hot path of the decode plane."""
+        with self._lock:
+            self._tpot_ms.extend(tpot_ms_batch)
+        _T_TPOT.observe_many(tpot_ms_batch, server=self.name)
+
     def on_error(self):
         with self._lock:
             self.errors += 1
@@ -178,6 +215,8 @@ class ServingStats:
         """Point-in-time dict of every serving metric (``Server.stats()``)."""
         with self._lock:
             lat = np.asarray(self._lat_ms)  # host floats; no device dtype
+            ttft = np.asarray(self._ttft_ms)
+            tpot = np.asarray(self._tpot_ms)
             out = {
                 "queue_depth": self._queue_depth,
                 "submitted": self.submitted,
@@ -203,4 +242,12 @@ class ServingStats:
         else:
             out["p50_ms"] = out["p99_ms"] = 0.0
             out["latency_window"] = 0
+        for key, arr in (("ttft", ttft), ("tpot", tpot)):
+            if arr.size:
+                p50, p99 = np.percentile(arr, [50.0, 99.0])
+                out[key + "_p50_ms"] = float(p50)
+                out[key + "_p99_ms"] = float(p99)
+            else:
+                out[key + "_p50_ms"] = out[key + "_p99_ms"] = 0.0
+            out[key + "_count"] = int(arr.size)
         return out

@@ -194,6 +194,21 @@ class Histogram(_Metric):
             s.sum += value
             s.window.append(value)
 
+    def observe_many(self, values, **labels):
+        """Batched :meth:`observe`: one key build + one lock acquisition
+        for a whole batch of samples — the per-tick hot path of the
+        decode plane (one TPOT sample per active slot per tick)."""
+        if not ENABLED or not values:
+            return
+        key = self._key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = _HistSeries(self._reservoir)
+            s.count += len(values)
+            s.sum += sum(values)
+            s.window.extend(values)
+
     def count(self, **labels) -> int:
         key = self._key(labels)
         with self._lock:

@@ -1,0 +1,626 @@
+"""mxnet_tpu.serving.decode — token-level continuous batching + paged KV
+cache + ragged paged-attention kernel (tier-1, CPU).
+
+Covers the ISSUE-7 acceptance surface: interpret-mode kernel parity vs a
+dense jnp reference (causal + non-causal, ragged lengths, page-boundary
+cases, GQA, inactive slots), the page allocator (reserve/free accounting,
+LIFO reuse, never-grows regression), engine correctness vs the no-cache
+oracle under slot churn, zero steady-state recompiles, the PR-2 policy
+surface (shed/timeout/close), TTFT/TPOT stats, and the PR-4 chaos wiring
+(prefill isolation, decode-step eviction soak, breaker shed)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import serving, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ops import pallas_kernels as pk
+from mxnet_tpu.resilience import RetryPolicy, chaos
+from mxnet_tpu.serving.kvcache import OutOfPagesError, PagedKVCache, write_kv
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    chaos.disable()
+    yield
+    chaos.disable()
+
+
+# ---------------------------------------------------------------------------
+# ragged paged-attention kernel: interpret-mode parity vs the dense oracle
+# ---------------------------------------------------------------------------
+
+def _rand_pool(rng, s, h, kh, d, pages, page_size, max_pages):
+    q = jnp.asarray(rng.randn(s, h, d).astype(np.float32))
+    kp = jnp.asarray(rng.randn(pages, page_size, kh, d).astype(np.float32))
+    vp = jnp.asarray(rng.randn(pages, page_size, kh, d).astype(np.float32))
+    pt = jnp.asarray(rng.randint(1, pages, (s, max_pages)).astype(np.int32))
+    return q, kp, vp, pt
+
+
+def _assert_parity(q, kp, vp, pt, sl, q_pos=None):
+    ref = pk.paged_attention_reference(q, kp, vp, pt, sl, q_pos=q_pos)
+    ker = pk.ragged_paged_attention(q, kp, vp, pt, sl, q_pos=q_pos,
+                                    interpret=True)
+    np.testing.assert_allclose(np.asarray(ker), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_kernel_parity_ragged_noncausal():
+    rng = np.random.RandomState(0)
+    q, kp, vp, pt = _rand_pool(rng, 4, 8, 8, 16, 9, 8, 3)
+    sl = jnp.asarray(np.array([1, 7, 13, 24], np.int32))
+    _assert_parity(q, kp, vp, pt, sl)
+
+
+def test_kernel_parity_causal_q_pos():
+    rng = np.random.RandomState(1)
+    q, kp, vp, pt = _rand_pool(rng, 4, 4, 4, 8, 7, 8, 3)
+    sl = jnp.asarray(np.array([5, 9, 16, 24], np.int32))
+    # q_pos < seq_len - 1: future positions masked even though live
+    qpos = jnp.asarray(np.array([0, 3, 8, 20], np.int32))
+    _assert_parity(q, kp, vp, pt, sl, q_pos=qpos)
+
+
+def test_kernel_parity_page_boundaries():
+    # lengths straddling page edges: k*page_size - 1, k*page_size,
+    # k*page_size + 1 — the off-by-one surface of the ragged mask
+    rng = np.random.RandomState(2)
+    q, kp, vp, pt = _rand_pool(rng, 4, 4, 4, 8, 11, 8, 4)
+    sl = jnp.asarray(np.array([7, 8, 9, 32], np.int32))
+    _assert_parity(q, kp, vp, pt, sl)
+
+
+def test_kernel_parity_gqa():
+    # 8 query heads over 2 kv heads: head h reads kv head h // 4
+    rng = np.random.RandomState(3)
+    q, kp, vp, pt = _rand_pool(rng, 3, 8, 2, 16, 6, 8, 2)
+    sl = jnp.asarray(np.array([3, 10, 16], np.int32))
+    _assert_parity(q, kp, vp, pt, sl)
+
+
+def test_kernel_inactive_slot_is_zeros():
+    rng = np.random.RandomState(4)
+    q, kp, vp, pt = _rand_pool(rng, 3, 4, 4, 8, 5, 8, 2)
+    sl = jnp.asarray(np.array([0, 5, 0], np.int32))
+    ker = np.asarray(pk.ragged_paged_attention(q, kp, vp, pt, sl,
+                                               interpret=True))
+    assert (ker[0] == 0).all() and (ker[2] == 0).all()
+    assert np.abs(ker[1]).sum() > 0
+
+
+def test_kernel_rejects_indivisible_gqa():
+    rng = np.random.RandomState(5)
+    q, kp, vp, pt = _rand_pool(rng, 2, 6, 4, 8, 4, 8, 1)
+    with pytest.raises(ValueError, match="not divisible"):
+        pk.ragged_paged_attention(q, kp, vp, pt,
+                                  jnp.asarray(np.array([4, 4], np.int32)),
+                                  interpret=True)
+
+
+def test_dispatcher_uses_reference_off_tpu():
+    # on the CPU test mesh paged_attention routes to the jnp reference —
+    # same numbers, traceable inside the decode jit
+    rng = np.random.RandomState(6)
+    q, kp, vp, pt = _rand_pool(rng, 2, 4, 4, 8, 4, 8, 2)
+    sl = jnp.asarray(np.array([5, 12], np.int32))
+    got = pk.paged_attention(q, kp, vp, pt, sl)
+    ref = pk.paged_attention_reference(q, kp, vp, pt, sl)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref))
+
+
+# ---------------------------------------------------------------------------
+# paged KV cache: the host allocator
+# ---------------------------------------------------------------------------
+
+def _cache(**kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("num_layers", 1)
+    kw.setdefault("num_kv_heads", 1)
+    kw.setdefault("head_dim", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("name", "t-%d" % np.random.randint(1 << 30))
+    return PagedKVCache(**kw)
+
+
+def test_kvcache_reserve_accounting():
+    c = _cache()
+    assert c.pages_in_use == 0
+    c.reserve(0, 17)  # 3 pages of 8
+    assert c.pages_in_use == 3 and c._owned[0] == 3
+    c.reserve(0, 20)  # still 3 pages — idempotent growth
+    assert c.pages_in_use == 3
+    c.reserve(0, 25)  # 4th page
+    assert c.pages_in_use == 4
+
+
+def test_kvcache_null_page_never_allocated():
+    c = _cache()
+    seen = set()
+    c.reserve(0, c.max_seq_len)
+    c.reserve(1, c.max_seq_len)
+    for s in range(c.num_slots):
+        seen.update(int(p) for p in c.page_table[s, :c._owned[s]])
+    assert 0 not in seen
+    assert len(seen) == c.pages_in_use
+
+
+def test_kvcache_out_of_pages_leaves_slot_unchanged():
+    c = _cache(num_pages=4)  # 3 allocatable
+    c.reserve(0, 16)  # 2 pages
+    with pytest.raises(OutOfPagesError):
+        c.reserve(1, 17)  # needs 3, only 1 free
+    assert c._owned[1] == 0 and c.pages_in_use == 2
+    assert not c.can_admit(17) and c.can_admit(8)
+
+
+def test_kvcache_free_lifo_reuse():
+    c = _cache()
+    c.reserve(0, 16)
+    freed = [int(p) for p in c.page_table[0, :2]]
+    c.free(0)
+    assert c.pages_in_use == 0
+    assert (c.page_table[0] == 0).all() and c.seq_lens[0] == 0
+    c.free(0)  # idempotent
+    c.reserve(1, 16)
+    got = [int(p) for p in c.page_table[1, :2]]
+    # LIFO: the pages just freed are the next handed out
+    assert got == freed[::-1]
+
+
+def test_kvcache_never_grows_under_churn():
+    # the reuse regression of the issue: admit/free cycles far exceeding
+    # pool capacity must recycle pages, never exhaust or grow the pool
+    c = _cache(num_slots=2, max_seq_len=32, page_size=8)
+    cap = c.num_pages
+    rng = np.random.RandomState(0)
+    for i in range(200):
+        slot = i % 2
+        c.free(slot)
+        c.reserve(slot, int(rng.randint(1, 33)))
+    assert c.num_pages == cap
+    assert c.pages_in_use <= cap - 1
+    c.free(0)
+    c.free(1)
+    assert c.pages_in_use == 0 and c.pages_free == cap - 1
+
+
+def test_kvcache_write_slots_page_boundary():
+    c = _cache()
+    c.reserve(0, 24)
+    pages, offs = c.write_slots(0, 6, 4)  # tokens 6..9 straddle page 0/1
+    own = [int(p) for p in c.page_table[0, :2]]
+    assert [int(p) for p in pages] == [own[0], own[0], own[1], own[1]]
+    assert [int(o) for o in offs] == [6, 7, 0, 1]
+    with pytest.raises(MXNetError, match="past slot"):
+        c.write_slots(0, 22, 4)  # token 25 needs a 4th page
+
+
+def test_kvcache_null_write_slots_target_null_page():
+    c = _cache()
+    pages, offs = c.null_write_slots(10)
+    assert (pages == 0).all()
+    assert offs.max() < c.page_size
+
+
+def test_kvcache_reserve_beyond_max_seq_len():
+    c = _cache(max_seq_len=32)
+    with pytest.raises(MXNetError, match="max_seq_len"):
+        c.reserve(0, 33)
+
+
+def test_kvcache_gauge_tracks_pages():
+    from mxnet_tpu.serving import kvcache as kvc
+
+    name = "gauge-test"
+    c = _cache(name=name)
+    c.reserve(0, 16)
+    assert kvc._T_PAGES.value(cache=name) == 2
+    c.free(0)
+    assert kvc._T_PAGES.value(cache=name) == 0
+
+
+def test_write_kv_scatters_rows():
+    c = _cache(num_slots=1, num_layers=2)
+    c.reserve(0, 10)
+    rows = jnp.asarray(np.arange(2 * 1 * 4, dtype=np.float32)
+                       .reshape(2, 1, 4))
+    pages, offs = c.write_slots(0, 7, 2)  # straddles the page edge
+    kp, vp = write_kv(c.k_pool, c.v_pool, 1, rows, rows * 2.0,
+                      jnp.asarray(pages), jnp.asarray(offs))
+    got_k = np.asarray(kp[1, np.asarray(pages), np.asarray(offs)])
+    np.testing.assert_array_equal(got_k, np.asarray(rows))
+    assert np.abs(np.asarray(kp[0])).sum() == 0  # other layer untouched
+
+
+# ---------------------------------------------------------------------------
+# DecodeEngine: continuous batching vs the no-cache oracle
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = serving.TinyDecoder(vocab_size=32, num_layers=2, num_heads=4,
+                                head_dim=8, num_kv_heads=2)
+    return model, model.init_params(0)
+
+
+def _engine(tiny, **kw):
+    model, params = tiny
+    kw.setdefault("num_slots", 3)
+    kw.setdefault("max_seq_len", 48)
+    kw.setdefault("prefill_buckets", (8, 16))
+    kw.setdefault("timeout_ms", 0)
+    kw.setdefault("name", "t%d" % np.random.randint(1 << 30))
+    return serving.DecodeEngine(model, params, **kw)
+
+
+def test_engine_matches_oracle_under_churn(tiny):
+    # more requests than slots with mixed prompt/output lengths: every
+    # completion re-admits on the same tick, and every output must equal
+    # the no-cache dense oracle exactly (greedy argmax, same params)
+    model, params = tiny
+    rng = np.random.RandomState(7)
+    reqs = [(rng.randint(1, 32, int(rng.randint(1, 14))).astype(np.int32),
+             int(rng.randint(1, 9))) for _ in range(9)]
+    with _engine(tiny) as eng:
+        eng.warmup()
+        futs = [eng.submit(p, m) for p, m in reqs]
+        outs = [f.result(timeout=120) for f in futs]
+        stats = eng.stats()
+    for (p, m), got in zip(reqs, outs):
+        ref = model.reference_generate(params, p, m)
+        np.testing.assert_array_equal(got, ref)
+    assert stats["completed"] == len(reqs)
+    assert stats["steady_state_recompiles"] == 0
+    assert stats["kvcache"]["pages_in_use"] == 0  # all freed
+
+
+def test_engine_zero_recompiles_and_occupancy(tiny):
+    with _engine(tiny, num_slots=2) as eng:
+        warm = eng.warmup()
+        assert warm > 0
+        futs = [eng.submit([1 + i, 2, 3], 6) for i in range(6)]
+        for f in futs:
+            f.result(timeout=120)
+        stats = eng.stats()
+    assert stats["steady_state_recompiles"] == 0
+    assert stats["compile_count"] == warm
+    assert 0.0 < stats["slot_occupancy"] <= 1.0
+    assert stats["tokens_generated"] == 6 * 6
+
+
+def test_engine_eos_frees_slot_early(tiny):
+    model, params = tiny
+    prompt = np.asarray([3, 5, 7], np.int32)
+    ref = model.reference_generate(params, prompt, 16)
+    eos = int(ref[2])  # force a stop at the 3rd generated token
+    with _engine(tiny) as eng:
+        out = eng.generate(prompt, 16, eos_id=eos)
+        stats = eng.stats()
+    np.testing.assert_array_equal(out, ref[:3])
+    assert stats["kvcache"]["pages_in_use"] == 0
+
+
+def test_engine_ttft_tpot_stats_and_prometheus(tiny):
+    name = "ttft-test"
+    with _engine(tiny, name=name) as eng:
+        eng.warmup()
+        for f in [eng.submit([1, 2, 3], 4) for _ in range(3)]:
+            f.result(timeout=120)
+        stats = eng.stats()
+    assert stats["ttft_count"] == 3
+    assert stats["tpot_count"] == 9  # 3 seqs x 3 post-first tokens
+    assert stats["ttft_p50_ms"] > 0 and stats["tpot_p99_ms"] > 0
+    text = telemetry.render_prometheus()
+    assert 'mxnet_serving_ttft_ms_count{server="%s"}' % name in text
+    assert 'mxnet_serving_tpot_ms' in text
+
+
+def test_engine_submit_validation(tiny):
+    with _engine(tiny) as eng:
+        with pytest.raises(MXNetError, match=">= 1 prompt token"):
+            eng.submit([], 4)
+        with pytest.raises(MXNetError, match="max_new_tokens"):
+            eng.submit([1], 0)
+        with pytest.raises(MXNetError, match="exceeds max_seq_len"):
+            eng.submit([1] * 40, 16)  # 40 + 16 > 48
+
+
+def test_engine_rejects_unadmittable_reservation(tiny):
+    # a worst-case reservation larger than the whole (undersized) pool
+    # could never be admitted — FIFO head-of-line would starve everything
+    # behind it forever, so submit() rejects it at the door
+    with _engine(tiny, num_slots=2, max_seq_len=32, page_size=8,
+                 num_pages=3) as eng:  # 2 allocatable pages
+        with pytest.raises(MXNetError, match="KV pages"):
+            eng.submit([1, 2], 20)  # needs 3 pages, pool has 2
+        # a request that fits still serves
+        assert len(eng.generate([1], 8)) == 8
+
+
+def test_engine_survives_fetch_fault(tiny, monkeypatch):
+    # a wedged device->host transfer mid-tick must evict the in-flight
+    # sequences like a failed step — NOT kill the engine thread and hang
+    # every later future (the PR-2 batcher survival discipline)
+    import mxnet_tpu.serving.decode as dec
+
+    model, params = tiny
+    with _engine(tiny, num_slots=1) as eng:
+        eng.warmup()
+        real = dec.fetch_host
+        calls = {"n": 0}
+
+        def flaky(arrays):
+            calls["n"] += 1
+            if calls["n"] == 2:  # call 1 = prefill first token, 2 = tick
+                raise RuntimeError("transfer wedged")
+            return real(arrays)
+
+        monkeypatch.setattr(dec, "fetch_host", flaky)
+        doomed = eng.submit([7, 8], 6)
+        with pytest.raises(RuntimeError, match="wedged"):
+            doomed.result(timeout=120)
+        assert eng.stats()["evictions"] == 1
+        # the worker is alive and the engine keeps answering
+        monkeypatch.setattr(dec, "fetch_host", real)
+        np.testing.assert_array_equal(
+            eng.generate([9], 4),
+            model.reference_generate(params, [9], 4))
+
+
+def test_engine_worker_survives_unexpected_exception(tiny):
+    # belt-and-braces: an exception ANYWHERE in the tick loop (here a
+    # poisoned _admit) evicts what was in flight and the thread lives on
+    model, params = tiny
+    with _engine(tiny, num_slots=1) as eng:
+        eng.warmup()
+        orig = eng._admit
+        state = {"armed": True}
+
+        def poisoned():
+            if state["armed"]:
+                state["armed"] = False
+                raise RuntimeError("unexpected admit failure")
+            orig()
+
+        eng._admit = poisoned
+        np.testing.assert_array_equal(
+            eng.generate([11], 3),
+            model.reference_generate(params, [11], 3))
+        assert eng._thread.is_alive()
+
+
+def test_engine_queue_shed(tiny):
+    # a 1-deep queue with a 1-slot engine saturated by a long request:
+    # the next submits shed with QueueFullError
+    with _engine(tiny, num_slots=1, queue_depth=1) as eng:
+        eng.warmup()
+        futs = [eng.submit([1, 2], 24)]
+        shed = 0
+        for _ in range(30):
+            try:
+                futs.append(eng.submit([3], 24))
+            except serving.QueueFullError:
+                shed += 1
+        assert shed > 0
+        for f in futs:
+            f.result(timeout=120)
+        assert eng.stats()["shed"] == shed
+
+
+def test_engine_queue_deadline_expires(tiny):
+    with _engine(tiny, num_slots=1) as eng:
+        eng.warmup()
+        blocker = eng.submit([1, 2], 30)
+        doomed = eng.submit([3], 4, timeout_ms=1.0)
+        with pytest.raises(serving.RequestTimeoutError):
+            doomed.result(timeout=120)
+        np.testing.assert_array_equal(
+            blocker.result(timeout=120),
+            eng._model.reference_generate(eng._params, [1, 2], 30))
+        assert eng.stats()["timeouts"] == 1
+
+
+def test_engine_close_semantics(tiny):
+    eng = _engine(tiny)
+    fut = eng.submit([1, 2, 3], 4)
+    eng.close()  # drain=True finishes in-flight work
+    assert len(fut.result(timeout=5)) == 4
+    with pytest.raises(serving.ServerClosedError):
+        eng.submit([1], 2)
+    eng.close()  # idempotent
+
+    eng2 = _engine(tiny, num_slots=1)
+    futs = [eng2.submit([1], 20) for _ in range(3)]
+    eng2.close(drain=False)
+    failed = 0
+    for f in futs:
+        try:
+            f.result(timeout=5)
+        except serving.ServerClosedError:
+            failed += 1
+    assert failed >= 1  # queued (and any admitted) work fails fast
+    assert eng2._cache.pages_in_use == 0
+
+
+def test_engine_admission_defers_on_page_pressure(tiny):
+    # pool sized for ~1.5 worst-case sequences: admission must wait for
+    # pages, never evict mid-flight, and everyone completes eventually
+    model, params = tiny
+    with _engine(tiny, num_slots=2, max_seq_len=32, page_size=8,
+                 num_pages=5) as eng:
+        eng.warmup()
+        reqs = [(np.asarray([1 + i], np.int32), 20) for i in range(4)]
+        futs = [eng.submit(p, m) for p, m in reqs]
+        outs = [f.result(timeout=120) for f in futs]
+        stats = eng.stats()
+    for (p, m), got in zip(reqs, outs):
+        np.testing.assert_array_equal(
+            got, model.reference_generate(params, p, m))
+    assert stats["completed"] == 4
+    assert stats["kvcache"]["pages_in_use"] == 0
+
+
+def test_engine_concurrent_submitters(tiny):
+    model, params = tiny
+    with _engine(tiny) as eng:
+        eng.warmup()
+        results = {}
+
+        def client(i):
+            p = np.asarray([i + 1, i + 2], np.int32)
+            results[i] = (p, eng.generate(p, 5))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+    for p, got in results.values():
+        np.testing.assert_array_equal(
+            got, model.reference_generate(params, p, 5))
+
+
+# ---------------------------------------------------------------------------
+# chaos wiring: per-request isolation, eviction soak, breaker shed
+# ---------------------------------------------------------------------------
+
+def test_chaos_prefill_fault_isolates_one_request(tiny):
+    # the 2nd prefill attempt faults with retries off: exactly one future
+    # fails, every other request completes with oracle-exact output
+    model, params = tiny
+    with _engine(tiny, num_slots=1,
+                 retry_policy=RetryPolicy(max_attempts=1)) as eng:
+        eng.warmup()
+        with chaos.active("seed=1,site=serving.decode.prefill,at=2"):
+            futs = [eng.submit([10 + i], 3) for i in range(4)]
+            outcomes = []
+            for f in futs:
+                try:
+                    outcomes.append(("ok", f.result(timeout=120)))
+                except chaos.FaultInjected as e:
+                    outcomes.append(("fault", e))
+        stats = eng.stats()
+    kinds = [k for k, _ in outcomes]
+    assert kinds.count("fault") == 1
+    assert kinds.count("ok") == 3
+    for i, (kind, val) in enumerate(outcomes):
+        if kind == "ok":
+            np.testing.assert_array_equal(
+                val, model.reference_generate(params, [10 + i], 3))
+    assert stats["errors"] == 1
+    assert stats["kvcache"]["pages_in_use"] == 0  # failed slot freed
+
+
+def test_chaos_decode_fault_evicts_only_in_flight(tiny):
+    # the decode-step eviction soak of the issue: a mid-stream fault
+    # (retries exhausted) fails exactly the sequences in flight, frees
+    # their pages, and the engine answers later traffic on fresh pools
+    model, params = tiny
+    with _engine(tiny, num_slots=2,
+                 retry_policy=RetryPolicy(max_attempts=1)) as eng:
+        eng.warmup()
+        with chaos.active("seed=1,site=serving.decode,at=3"):
+            futs = [eng.submit([20 + i, 5], 6) for i in range(2)]
+            evicted = 0
+            for f in futs:
+                try:
+                    f.result(timeout=120)
+                except chaos.FaultInjected:
+                    evicted += 1
+        assert evicted == 2  # both were in flight on the faulted tick
+        mid = eng.stats()
+        assert mid["evictions"] == 2
+        assert mid["kvcache"]["pages_in_use"] == 0
+        # the engine keeps answering — and stays oracle-exact
+        after = [eng.submit([30 + i], 4) for i in range(4)]
+        for i, f in enumerate(after):
+            np.testing.assert_array_equal(
+                f.result(timeout=120),
+                model.reference_generate(params, [30 + i], 4))
+        stats = eng.stats()
+    assert stats["completed"] == 4
+    assert stats["steady_state_recompiles"] == 0  # eviction never retraces
+
+
+def test_chaos_decode_fault_recovers_via_retry(tiny):
+    # with the default policy a single injected fault is retried in place:
+    # nothing evicted, every output still oracle-exact
+    model, params = tiny
+    with _engine(tiny, num_slots=2) as eng:
+        eng.warmup()
+        with chaos.active("seed=1,site=serving.decode,at=2"):
+            futs = [eng.submit([40 + i], 5) for i in range(3)]
+            outs = [f.result(timeout=120) for f in futs]
+        stats = eng.stats()
+    for i, got in enumerate(outs):
+        np.testing.assert_array_equal(
+            got, model.reference_generate(params, [40 + i], 5))
+    assert stats["evictions"] == 0 and stats["completed"] == 3
+
+
+def test_chaos_breaker_opens_sheds_and_recovers(tiny):
+    # a step failure trips the engine breaker (threshold 1): queued work
+    # is shed with EngineUnavailableError instead of hanging, and the
+    # half-open probe recovers the engine once the schedule ends
+    model, params = tiny
+    with _engine(tiny, num_slots=1,
+                 retry_policy=RetryPolicy(max_attempts=1),
+                 breaker_threshold=1, breaker_reset_s=0.2) as eng:
+        eng.warmup()
+        with chaos.active("seed=1,site=serving.decode,at=1"):
+            futs = [eng.submit([50 + i], 6) for i in range(4)]
+            collect = []
+            for f in futs:
+                try:
+                    f.result(timeout=120)
+                    collect.append("ok")
+                except chaos.FaultInjected:
+                    collect.append("fault")
+                except serving.EngineUnavailableError:
+                    collect.append("shed")
+        assert collect[0] == "fault"  # the faulted tick's eviction
+        assert "shed" in collect and "ok" not in collect
+        # past the reset window the half-open probe serves (the schedule
+        # is spent), closing the breaker — oracle-exact again
+        time.sleep(0.25)
+        np.testing.assert_array_equal(
+            eng.generate([60], 3),
+            model.reference_generate(params, [60], 3))
+        assert eng._breaker.state == "closed"
+        assert eng.stats()["steady_state_recompiles"] == 0
+
+
+# ---------------------------------------------------------------------------
+# prefill routing
+# ---------------------------------------------------------------------------
+
+def test_prefill_ladder_capped_by_max_seq_len(tiny):
+    with _engine(tiny, prefill_buckets=(8, 16, 999), max_seq_len=48) as eng:
+        assert eng.stats()["prefill_buckets"] == [8, 16, 48]
+
+
+def test_prefill_ladder_rejects_garbage(tiny):
+    model, params = tiny
+    with pytest.raises(MXNetError, match="empty prefill bucket"):
+        serving.DecodeEngine(model, params, prefill_buckets=(0, -3),
+                             name="bad")
+
+
+def test_ring_prefill_path_matches_oracle(tiny):
+    # ring_prefill_len=1 routes EVERY prompt through the long-context
+    # path; on a 1-device CPU mesh it degrades to the dense in-graph
+    # attention, so outputs must stay oracle-exact (the multi-device
+    # sharded case is covered by tests/test_sequence_parallel.py)
+    model, params = tiny
+    with _engine(tiny, ring_prefill_len=1) as eng:
+        out = eng.generate([3, 1, 4, 1, 5], 4)
+    np.testing.assert_array_equal(
+        out, model.reference_generate(params, [3, 1, 4, 1, 5], 4))

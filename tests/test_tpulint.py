@@ -718,3 +718,77 @@ def test_eager_step_scoped_to_mxnet_tpu():
     assert lint(src, rule="eager-step",
                 relpath="tools/somewhere.py") == []
     assert len(lint(src, rule="eager-step")) == 1
+
+
+# ---------------------------------------------------------------------------
+# decode-host-sync
+# ---------------------------------------------------------------------------
+
+def test_decode_host_sync_flags_syncs_in_decode_scope():
+    # straight-line code, no loop: the generic host-sync pass is blind
+    # here, the cadence comes from the scope name
+    f = lint("""
+        def decode_step(engine, step):
+            sampled = step()
+            return fetch_host([sampled])[0]
+        """, rule="decode-host-sync")
+    assert len(f) == 1 and "fetch_host" in f[0].message
+
+    f = lint("""
+        def generate(model, prompt):
+            logits = model(prompt)
+            return logits.asnumpy()
+        """, rule="decode-host-sync")
+    assert len(f) == 1 and ".asnumpy" in f[0].message
+
+
+def test_decode_host_sync_class_scope_and_item():
+    # any method of a Decode* class is per-token cadence, whatever its
+    # name; .item() and .tolist() are sync calls too
+    f = lint("""
+        class DecodeEngine:
+            def _tick(self):
+                tok = self._step()
+                return tok.item()
+        """, rule="decode-host-sync")
+    assert len(f) == 1 and ".item" in f[0].message
+
+
+def test_decode_host_sync_negative_cases():
+    # imdecode (host-side image decoding) must not match the word scope;
+    # sync calls outside any decode scope belong to the generic pass
+    assert lint("""
+        def imdecode(buf):
+            return fetch_host([buf])[0]
+        """, rule="decode-host-sync") == []
+    assert lint("""
+        def forward(engine, batch):
+            out = engine(batch)
+            return fetch_host([out])[0]
+        """, rule="decode-host-sync") == []
+    # non-sync calls inside decode scope stay clean
+    assert lint("""
+        def decode_step(engine, toks):
+            return engine.step(toks)
+        """, rule="decode-host-sync") == []
+
+
+def test_decode_host_sync_scoped_to_mxnet_tpu():
+    src = """
+        def decode_loop(step):
+            return fetch_host([step()])[0]
+    """
+    assert lint(src, rule="decode-host-sync",
+                relpath="tools/elsewhere.py") == []
+    assert len(lint(src, rule="decode-host-sync")) == 1
+
+
+def test_decode_host_sync_repo_sites_are_baselined():
+    # the decode plane keeps exactly its two justified syncs (the tick's
+    # sampled-token fetch + the prefill first-token fetch) — baselined,
+    # so the repo gate stays clean and any NEW sync is a finding
+    counts = load_baseline(DEFAULT_BASELINE)
+    key = ("mxnet_tpu/serving/decode.py::decode-host-sync::"
+           "`fetch_host()` in decode-plane code runs per token — "
+           "a device->host stall every tick")
+    assert counts.get(key) == 2

@@ -1,5 +1,6 @@
 """tpulint analysis passes. Importing this package populates
 ``tpulint.core.REGISTRY`` via the ``@register`` decorator in each module."""
+from . import decode_host_sync  # noqa: F401
 from . import dtype_drift  # noqa: F401
 from . import eager_step  # noqa: F401
 from . import env_knob  # noqa: F401

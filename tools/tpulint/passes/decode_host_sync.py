@@ -1,0 +1,84 @@
+"""decode-host-sync: device->host syncs in decode-plane code.
+
+The decode engine (``mxnet_tpu/serving/decode.py``) runs one jitted step
+per output token. At that cadence a device->host transfer is not an
+occasional cost — it is a PER-TOKEN stall that serializes every tick of
+every live sequence, the single easiest way to ruin decode throughput.
+The generic ``host-sync`` pass only fires inside syntactic loops or jit
+contexts; a decode engine hides its loop behind a worker thread, so its
+per-token syncs sit in straight-line methods the loop pass cannot see.
+
+This pass takes the cadence from the NAME SCOPE instead: any sync call
+inside a function whose name says it runs per token — ``decode*`` /
+``generate*`` (or ``_decode``/``_generate``-suffixed), or any method of a
+class whose name contains ``Decode`` — is flagged, loop or no loop.
+
+Flagged calls: ``fetch_host(...)`` / ``jax.device_get(...)`` and the
+``.asnumpy()`` / ``.item()`` / ``.tolist()`` methods.
+
+The decode plane keeps exactly one justified per-token sync — fetching
+the tick's sampled token ids, which MUST reach the host for EOS/stop
+checks and feedback — plus one per-sequence fetch at prefill. Those are
+baselined with their justification in the source; the gate stops NEW
+per-token syncs (logits peeks, per-slot scalar reads, debug fetches)
+from creeping into the plane.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..core import (FileContext, Finding, Pass, ancestors, dotted_name,
+                    register)
+
+_SYNC_METHODS = {"asnumpy", "item", "tolist"}
+_SYNC_CALLS = {"fetch_host", "device_get"}
+# word-start match so `imdecode` (image decoding, host-side by nature)
+# stays out of scope while `decode`, `_decode_step`, `generate_tokens`,
+# `reference_generate` are in
+_SCOPE_FN = re.compile(r"(^|_)(decode|generate)")
+
+
+def _decode_scope(node: ast.AST):
+    """The decode-plane scope name enclosing ``node``, or None."""
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _SCOPE_FN.search(anc.name):
+                return anc.name
+        if isinstance(anc, ast.ClassDef) and "Decode" in anc.name:
+            return anc.name
+    return None
+
+
+@register
+class DecodeHostSyncPass(Pass):
+    name = "decode-host-sync"
+    description = ("device->host sync (fetch_host/asnumpy/.item) in "
+                   "decode-plane code — a per-token stall; batch it or "
+                   "baseline the justified site")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            scope = _decode_scope(node)
+            if scope is None:
+                continue
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SYNC_METHODS:
+                yield ctx.finding(
+                    node, self.name,
+                    "`.%s()` in decode-plane code runs per token — "
+                    "a device->host stall every tick" % node.func.attr)
+                continue
+            fname = dotted_name(node.func) or ""
+            if fname.rsplit(".", 1)[-1] in _SYNC_CALLS:
+                yield ctx.finding(
+                    node, self.name,
+                    "`%s()` in decode-plane code runs per token — "
+                    "a device->host stall every tick"
+                    % fname.rsplit(".", 1)[-1])
