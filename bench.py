@@ -28,6 +28,11 @@ DECODE = os.environ.get("BENCH_DECODE", "") not in ("", "0")
 # a default mild schedule) — proves the resilience layer holds the numbers
 # up under transient failures, and stamps fault/retry counters on the line
 CHAOS = os.environ.get("BENCH_CHAOS", "") not in ("", "0")
+# BENCH_ZERO=1: ZeRO sweep — the SAME model/batch trained replicated
+# (MXNET_ZERO=0) then sharded (ZeRO-1, ZeRO-2); per-device optimizer-state
+# bytes, zero_hbm_savings_ratio and the step-time delta on the line;
+# rc != 0 if the sharded plane recompiles in steady state
+ZERO = os.environ.get("BENCH_ZERO", "") not in ("", "0")
 # p=0.2 because the fused-step protocol performs only ~a dozen accounted
 # transfers per run (one barrier fetch per timed phase): a mild rate would
 # usually inject nothing and "prove" resilience vacuously
@@ -55,6 +60,14 @@ def _attach_telemetry(out):
     try:
         from mxnet_tpu import telemetry
 
+        # refresh the HBM gauges right before the snapshot so every line
+        # carries current device-memory truth (no-op on CPU: the gauges
+        # stay absent rather than reading 0)
+        hbm = telemetry.sample_hbm()
+        if hbm:
+            out["hbm_bytes"] = {
+                str(d): {"in_use": u, "peak": p}
+                for d, (u, p) in hbm.items()}
         out["telemetry"] = telemetry.snapshot()
         if telemetry.enabled():
             # compile-cache + dispatch traffic on EVERY line: whether this
@@ -609,7 +622,150 @@ def _decode_bench():
     return 1 if gate_err else 0
 
 
+def _zero_bench():
+    """BENCH_ZERO=1 mode: replicated vs ZeRO-1/2 at the same model/batch.
+
+    Protocol: three otherwise-identical eager Trainer runs (the fastpath
+    update plane, where ``fastpath.zero`` swaps the update collective) at
+    MXNET_ZERO=0/1/2. Each phase reports steady-state img/s and the
+    per-device optimizer-state bytes measured by ``zero.state_bytes_on``
+    (the ground truth next to the ``mxnet_hbm_bytes_*`` gauges, which
+    need backend memory stats). The line carries
+    ``zero_hbm_savings_ratio`` (sharded/replicated state bytes — ~1/dp +
+    padding), the step-time delta, and the steady-state recompile count
+    of the sharded update jit; recompiles after warmup fail the run
+    (rc 5): the sharded plane promised compile-once like every other
+    plane here.
+    """
+    # the sweep needs a mesh that actually shards: give the CPU backend
+    # two virtual devices when nothing set a device count (no-op on TPU)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+
+    devices = _acquire_backend()
+    import numpy as np
+
+    import mxnet_tpu as mx  # noqa: F401 - registers backends
+    from mxnet_tpu import autograd, gluon, nd, telemetry
+    from mxnet_tpu.fastpath import zero
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    _maybe_enable_chaos()
+    if QUICK:
+        batch, side, classes = 8, 32, 10
+        make_net = vision.resnet18_v1
+        budget = 6.0
+    else:
+        batch, side, classes = 32, 224, 1000
+        make_net = vision.resnet50_v1
+        budget = 20.0
+    dev = devices[0]
+    rng = np.random.RandomState(0)
+    x_np = rng.rand(batch, 3, side, side).astype(np.float32)
+    y_np = rng.randint(0, classes, (batch,))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    sgd = {"learning_rate": 0.05, "momentum": 0.9}
+
+    prev = os.environ.get("MXNET_ZERO")
+    phases = {}
+    err = None
+    try:
+        for lvl in (0, 1, 2):
+            os.environ["MXNET_ZERO"] = str(lvl)
+            net = make_net(classes=classes)
+            net.initialize()
+            net.hybridize()
+            trainer = gluon.Trainer(net.collect_params(), "sgd", dict(sgd),
+                                    kvstore="device")
+            xt, yt = nd.array(x_np), nd.array(y_np)
+
+            def one_step():
+                with autograd.record():
+                    l = loss_fn(net(xt), yt)
+                l.backward()
+                trainer.step(batch)
+                return l
+
+            one_step()  # compile (adopts the sharded plane at lvl>0)
+            r0 = telemetry.RECOMPILES.value(site="fastpath.zero_apply")
+            rate = _time_iters(one_step, budget)
+            recompiles = telemetry.RECOMPILES.value(
+                site="fastpath.zero_apply") - r0
+            upd = trainer._updaters[0]
+            state_bytes = zero.state_bytes_on(dev, upd)
+            plane = zero.plane_of(upd)
+            hbm = telemetry.sample_hbm()
+            phases[lvl] = {
+                "img_s": round(batch * rate, 2),
+                "step_ms": round(1e3 / rate, 3),
+                "state_bytes_dev0": int(state_bytes),
+                "sharded": plane is not None,
+                "steady_state_recompiles": int(recompiles),
+                "hbm_bytes_in_use_dev0":
+                    hbm.get(dev.id, (None, None))[0] if hbm else None,
+            }
+            if lvl and recompiles:
+                err = ("ZeRO-%d plane recompiled %d time(s) in steady "
+                       "state (gate: compile-once)" % (lvl, int(recompiles)))
+            if lvl and plane is None:
+                err = err or ("MXNET_ZERO=%d fell back to the replicated "
+                              "plane on this mesh (%d devices)"
+                              % (lvl, len(devices)))
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as e:  # noqa: BLE001 - report, don't vanish
+        import traceback
+        traceback.print_exc()
+        sys.stderr.flush()
+        err = "exception during BENCH_ZERO: %r" % (e,)
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_ZERO", None)
+        else:
+            os.environ["MXNET_ZERO"] = prev
+
+    base = phases.get(0, {})
+    z1 = phases.get(1, {})
+    ratio = None
+    if base.get("state_bytes_dev0") and z1.get("state_bytes_dev0"):
+        ratio = round(z1["state_bytes_dev0"] / base["state_bytes_dev0"], 4)
+    delta = None
+    if base.get("step_ms") and z1.get("step_ms"):
+        delta = round(z1["step_ms"] - base["step_ms"], 3)
+    out = {
+        "metric": "%s ZeRO-1 train img/s (bs=%d fp32, eager fastpath, "
+                  "%d-device dp)" % ("resnet18 quick-mode" if QUICK
+                                     else "resnet50_v1", batch,
+                                     len(devices)),
+        "value": z1.get("img_s"),
+        "unit": "img/s",
+        "vs_baseline": round(z1["img_s"] / base["img_s"], 4)
+        if z1.get("img_s") and base.get("img_s") else None,
+        "extra": {
+            "zero_sweep": phases,
+            "zero_hbm_savings_ratio": ratio,
+            "zero_step_time_delta_ms": delta,
+            "replicated_img_s": base.get("img_s"),
+            "zero1_img_s": z1.get("img_s"),
+            "zero2_img_s": phases.get(2, {}).get("img_s"),
+            "batch": batch,
+            "devices": len(devices),
+            "device": str(dev),
+            "device_kind": getattr(dev, "device_kind", str(dev)),
+        },
+    }
+    if err:
+        out["error"] = err
+    print(json.dumps(_attach_telemetry(out)))
+    sys.stdout.flush()
+    return 5 if err else 0
+
+
 def main():
+    if ZERO:
+        return _zero_bench()
     if DECODE:
         return _decode_bench()
     if SERVING:

@@ -22,6 +22,11 @@ piece                 what it gives you
 :mod:`.cache`         persistent XLA compilation cache
                       (``MXNET_COMPILE_CACHE_DIR``) with hit/miss counters
                       feeding the PR-3 recompile accounting
+:mod:`.zero`          ZeRO-1/2 sharded state plane (``MXNET_ZERO``):
+                      optimizer state (and fp32 masters at level 2) lives
+                      partitioned over the dp axis in padded flat buckets;
+                      the step swaps all-reduce for reduce-scatter →
+                      shard-local kernel → weight all-gather
 ====================  =====================================================
 
 Consumers: ``gluon.Trainer.step``, ``model._update_params[_on_kvstore]``,
@@ -35,11 +40,11 @@ import jax
 
 from ..base import get_env
 from .fused import FusedApplyError, apply_updater, fused_apply, tree_kernel
-from . import bucketing, cache  # noqa: F401  - cache wires itself at import
+from . import bucketing, cache, zero  # noqa: F401  - cache wires itself at import
 
 __all__ = ["enabled", "donation_enabled", "donation_argnums_ok", "supports",
            "fused_apply", "apply_updater", "FusedApplyError", "tree_kernel",
-           "bucketing", "cache"]
+           "bucketing", "cache", "zero"]
 
 
 def enabled() -> bool:

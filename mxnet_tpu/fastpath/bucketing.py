@@ -25,7 +25,7 @@ import numpy as np
 
 from ..base import get_env
 
-__all__ = ["bucket_cap_bytes", "plan_for", "Plan"]
+__all__ = ["bucket_cap_bytes", "plan_for", "flat_plan", "Plan"]
 
 _MB = 1 << 20
 
@@ -45,19 +45,33 @@ class Plan:
     ``solo`` — positions that ride unpacked (bigger than the cap, or alone
     in their dtype). Pack/unpack jits are cached on the plan, which is
     itself cached per (signature, cap) in :data:`_PLANS`.
+
+    ``pad_to`` (default 1 — no padding) zero-pads each packed bucket to a
+    multiple of that length. The ZeRO state plane (``fastpath.zero``) sets
+    it to the dp axis size so every bucket shards evenly over the mesh;
+    :meth:`unpack` never reads the tail, so the round trip stays exact.
     """
 
     def __init__(self, sig: Tuple, buckets: List[Tuple[int, ...]],
-                 solo: List[int]):
+                 solo: List[int], pad_to: int = 1):
         self.sig = sig            # ((shape, dtype_str), ...) per leaf
         self.buckets = buckets
         self.solo = solo
+        self.pad_to = max(1, int(pad_to))
         # static per-leaf flat sizes: trace-time constants of the
         # pack/unpack jits, computed once on the host
         self.sizes = [int(np.prod(s, dtype=np.int64))  # tpulint: disable=host-sync - static shape tuples, pure host math
                       for s, _ in sig]
         self._pack_jit = None
         self._unpack_jit = None
+
+    def bucket_layout(self, b: int) -> Tuple[List[int], int]:
+        """``(per-leaf flat sizes, padded length)`` of bucket ``b`` —
+        the static layout the ZeRO plane's scalar expansion and state
+        packing share with :meth:`pack`."""
+        sizes = [self.sizes[i] for i in self.buckets[b]]
+        total = sum(sizes)
+        return sizes, -(-total // self.pad_to) * self.pad_to
 
     @property
     def n_out(self) -> int:
@@ -73,12 +87,18 @@ class Plan:
         processing."""
         if self._pack_jit is None:
             lens = [len(b) for b in self.buckets]
+            pads = [self.bucket_layout(b)[1] - sum(self.bucket_layout(b)[0])
+                    for b in range(len(self.buckets))]
 
             def _pack(pruned):
                 out, k = [], 0
-                for n in lens:
-                    out.append(jnp.concatenate(
-                        [p.ravel() for p in pruned[k:k + n]]))
+                for n, pad in zip(lens, pads):
+                    flat = jnp.concatenate(
+                        [p.ravel() for p in pruned[k:k + n]])
+                    if pad:
+                        flat = jnp.concatenate(
+                            [flat, jnp.zeros((pad,), flat.dtype)])
+                    out.append(flat)
                     k += n
                 return out
 
@@ -164,3 +184,26 @@ def plan_for(leaves: Sequence[Any],
     plan = Plan(sig, buckets, sorted(solo)) if buckets else None
     _PLANS[key] = plan
     return plan
+
+
+def flat_plan(leaves: Sequence[Any], keys: Sequence[Any],
+              pad_to: int = 1) -> Plan:
+    """Full-coverage coalescing for the ZeRO state plane: EVERY leaf joins
+    a flat bucket (no byte cap, no solo leaves), one bucket per distinct
+    ``keys[i]`` in first-appearance order, each padded to a multiple of
+    ``pad_to`` (the dp axis size, so the bucket shards evenly). Unlike
+    :func:`plan_for`, single-leaf buckets are kept — sharding wants
+    everything flat, not just what coalescing pays for. Not cached: the
+    caller (``fastpath.zero``) owns the plan for the life of its sharded
+    state."""
+    if len(leaves) != len(keys):
+        raise ValueError("flat_plan: one key per leaf")
+    sig = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+    order: List[Any] = []
+    groups: Dict[Any, List[int]] = {}
+    for pos, k in enumerate(keys):
+        if k not in groups:
+            groups[k] = []
+            order.append(k)
+        groups[k].append(pos)
+    return Plan(sig, [tuple(groups[k]) for k in order], [], pad_to=pad_to)

@@ -109,12 +109,19 @@ def _leaf_buffers(tree) -> List[Any]:
 
 
 def _buf_ptr(b):
-    """Device buffer address, or None when unprobeable (already deleted,
-    multi-shard, backend without the probe). Identity must be judged by
-    buffer, not python object: XLA can alias two identical jit outputs
-    onto one buffer behind distinct jax.Array objects."""
+    """Set of device buffer addresses behind an array (one per shard —
+    a dp-sharded ZeRO state bucket has one buffer per mesh device), or
+    None when unprobeable (already deleted, backend without the probe).
+    Identity must be judged by buffer, not python object: XLA can alias
+    two identical jit outputs onto one buffer behind distinct jax.Array
+    objects."""
     try:
-        return b.unsafe_buffer_pointer()
+        return frozenset((b.unsafe_buffer_pointer(),))
+    except Exception:  # noqa: BLE001  # tpulint: disable=swallowed-error - fall through to the sharded probe below
+        pass
+    try:
+        return frozenset(s.data.unsafe_buffer_pointer()
+                         for s in b.addressable_shards)
     except Exception:  # noqa: BLE001 - probe failure => caller plays safe
         return None
 
@@ -124,8 +131,9 @@ def _invalidate(buffers: Sequence[Any], keep_ptrs) -> None:
     'Array has been deleted' instead of reading reused memory. Idempotent
     with real donation (the runtime already invalidated them)."""
     for b in buffers:
-        if _buf_ptr(b) in keep_ptrs:  # None never collides: keep set is
-            continue                  # built from live probed buffers only
+        ptrs = _buf_ptr(b)
+        if ptrs is not None and ptrs & keep_ptrs:
+            continue  # (a shard of) this buffer is live in an output
         try:
             b.delete()
         except RuntimeError:
@@ -147,8 +155,13 @@ def donation_prep(*trees):
     consumed: List[Any] = []
     for t in trees:
         consumed += _leaf_buffers(t)
-    ptrs = [_buf_ptr(b) for b in consumed]
-    duplicated = None in ptrs or len(set(ptrs)) != len(ptrs)
+    ptr_sets = [_buf_ptr(b) for b in consumed]
+    flat: List[Any] = []
+    for p in ptr_sets:
+        if p is not None:
+            flat.extend(p)
+    # any shared shard buffer across two consumed arrays is a duplicate
+    duplicated = None in ptr_sets or len(set(flat)) != len(flat)
     return (not duplicated and donation_argnums_ok(),
             [] if duplicated else consumed)
 
@@ -161,8 +174,9 @@ def invalidate_consumed(consumed, live_trees) -> None:
         return
     keep = set()
     for t in live_trees:
-        keep.update(p for p in map(_buf_ptr, _leaf_buffers(t))
-                    if p is not None)
+        for p in map(_buf_ptr, _leaf_buffers(t)):
+            if p is not None:
+                keep.update(p)
     _invalidate(consumed, keep)
 
 
@@ -221,14 +235,21 @@ def fused_apply(optimizer, indices, grads, weights, states):
     return new_sts
 
 
-def apply_updater(updater, triples):
+def apply_updater(updater, triples, positions: int = 1):
     """Run an ``optimizer.Updater`` over many ``(index, grad, weight)``
     triples in one fused dispatch — the drop-in replacement for the
     ``for ...: updater(i, g, w)`` loop in Trainer/model/module. Creates
-    missing states exactly as ``Updater.__call__`` would."""
+    missing states exactly as ``Updater.__call__`` would.
+
+    ``positions`` is the caller's device-position count (contexts /
+    executor replicas): under ``MXNET_ZERO`` the sharded state plane
+    (:mod:`.zero`) takes the update first — single-position callers
+    only, everything else falls back to the replicated path here with a
+    ``mxnet_zero_fallbacks_total`` reason."""
     if not triples:
         return
     from ..optimizer import ensure_mp_state
+    from . import zero
 
     opt = updater.optimizer
     for index, _grad, weight in triples:
@@ -236,14 +257,27 @@ def apply_updater(updater, triples):
             updater.states[index] = opt.create_state_multi_precision(
                 index, weight)
             updater.states_synced[index] = True
-        else:
+        elif not zero.is_sharded(updater.states[index]):
             # restored states may predate the fp32-master layout for this
             # weight dtype — migrate exactly as update_multi_precision does
+            # (a sharded handle was adopted in-layout; acquire_plane runs
+            # the same migration whenever the plane rebuilds)
             updater.states[index] = ensure_mp_state(
                 opt, index, weight, updater.states[index])
+    if zero.level() and zero.apply(updater, triples, positions):
+        return
     indices = [t[0] for t in triples]
+    # a declined zero call (or the knob flipped off) leaves plain states;
+    # formerly-sharded ones may still predate an mp flip — migrate them.
+    # None = lost to a failed donated sharded step: recreate fresh
+    states = zero.ensure_materialized(updater, indices)
+    states = [ensure_mp_state(opt, i, w, s) if s is not None
+              else opt.create_state_multi_precision(i, w)
+              for (i, _g, w), s in zip(triples, states)]
+    for i, s in zip(indices, states):
+        updater.states[i] = s
     new_states = fused_apply(
         opt, indices, [t[1] for t in triples], [t[2] for t in triples],
-        [updater.states[i] for i in indices])
+        states)
     for i, ns in zip(indices, new_states):
         updater.states[i] = ns

@@ -1,0 +1,719 @@
+"""ZeRO-1/2 optimizer/param-state sharding through the fused step.
+
+``parallel.py`` replicates parameters AND optimizer state over every
+device on the mesh, so per-device HBM — not compute — caps model size,
+and the dp gradient exchange moves the full tree when 1/N shards would
+do. This module is the sharded state plane (ROADMAP item 3; the ZeRO
+partitioning of arxiv 1910.02054 expressed the GSPMD way — sharding
+annotations, not hand-written collectives):
+
+* **ZeRO-1** (``MXNET_ZERO=1``): optimizer state (momenta, variance
+  accumulators, …) lives partitioned over the ``dp`` axis — each device
+  holds 1/N of every state bucket between steps.
+* **ZeRO-2** (``MXNET_ZERO=2``): additionally partitions the fp32
+  master weight copies of the multi-precision (bf16/fp16) path, and
+  with them the master's share of the weight all-gather. For pure fp32
+  training level 2 behaves like level 1 — gradients are already
+  scattered transiently inside the step, which is all classic ZeRO-2
+  adds on a dp-only mesh.
+
+Layout: every ``(weight, grad, state)`` leaf joins a flat per-dtype
+bucket (``bucketing.flat_plan`` — the DDP-coalescing machinery reused
+with full coverage), padded to a multiple of the dp axis size so the
+bucket shards evenly. The step then swaps the gradient collective from
+all-reduce to **reduce-scatter → shard-local ``_leaf_step`` →
+all-gather of the updated weights**: in-graph (``trainplane``) this is
+a ``with_sharding_constraint`` on the packed gradients and GSPMD
+inserts the collectives; on the eager fused path the already-reduced
+gradients are scattered with ``parallel.put_sharded`` (the one
+placement home) and only the updated weights travel back.
+
+Per-parameter scalars (t, lr with Adam's host bias correction, wd)
+come from the SAME host prologue as the replicated fastpath and are
+expanded to per-element vectors over the static bucket layout, so the
+sharded update is element-for-element the same math — fp32 sharded
+training is bit-identical to the replicated plane wherever the dp
+reduction order is (≤ 1 ulp where it differs).
+
+Never-a-crash discipline: anything the probe rejects — order-sensitive
+host prologues (Nadam's m_schedule, SGLD's rng stream), non-pointwise
+kernels (LBSGD's layer norms), ``update_on_kvstore``, a 1-device mesh
+where sharding is a no-op, multi-position eager updates — falls back to
+the replicated path with a ``mxnet_zero_fallbacks_total{reason}``
+counter. The sharded state itself is owned by a :class:`ZeroPlane`;
+``Updater.states`` holds :class:`ShardedState` handles that materialize
+back to plain per-parameter states whenever anything outside the plane
+(checkpointing, an eager per-param update) touches them.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry
+from ..base import get_env
+from . import bucketing
+
+__all__ = ["level", "ZeroPlane", "ShardedState", "eligible_reason",
+           "note_fallback", "plane_of", "materialize_updater",
+           "ensure_materialized", "acquire_plane", "apply",
+           "state_bytes_on", "is_sharded", "FALLBACKS"]
+
+#: why a sharded update was declined, by coarse reason — the operator's
+#: record that MXNET_ZERO quietly stayed on the replicated path
+FALLBACKS = telemetry.counter(
+    "mxnet_zero_fallbacks_total",
+    "ZeRO sharded-state updates declined, by reason",
+    labels=("reason",))
+
+
+def level() -> int:
+    """``MXNET_ZERO``: ``0`` replicated (default), ``1`` shard optimizer
+    state, ``2`` also shard fp32 master copies. Re-read per call."""
+    lv = get_env("MXNET_ZERO", 0, int, cache=False)
+    return lv if lv in (0, 1, 2) else 0
+
+
+def _max_devices() -> int:
+    """``MXNET_ZERO_DEVICES``: cap on the eager-path dp mesh width
+    (default 0 = every local device)."""
+    return get_env("MXNET_ZERO_DEVICES", 0, int, cache=False) or 0
+
+
+def note_fallback(reason: str) -> None:
+    FALLBACKS.inc(reason=reason)
+
+
+#: per-class memo of whether _host_scalars emits kernel extras — probed
+#: ONCE on a deepcopied throwaway (stateless prologues only: the stateful
+#: ones are ruled out before the probe, so probing cannot consume a host
+#: stream or skew a schedule)
+_EXTRAS_CACHE: Dict[type, bool] = {}
+
+
+def _kernel_has_extras(optimizer) -> bool:
+    cls = type(optimizer)
+    if cls not in _EXTRAS_CACHE:
+        import copy
+
+        pd, optimizer.param_dict = optimizer.param_dict, {}
+        try:
+            probe = copy.deepcopy(optimizer)
+        except Exception:  # noqa: BLE001 - unprobeable => conservative
+            _EXTRAS_CACHE[cls] = True
+            return True
+        finally:
+            optimizer.param_dict = pd
+        probe.param_dict = {}
+        try:
+            probe._update_count(0)
+            _lr, _wd, ex = probe._host_scalars(0)
+            _EXTRAS_CACHE[cls] = bool(ex)
+        except Exception:  # noqa: BLE001 - unprobeable => conservative
+            _EXTRAS_CACHE[cls] = True
+    return _EXTRAS_CACHE[cls]
+
+
+def eligible_reason(optimizer, ndev: int) -> Optional[str]:
+    """Why this optimizer/mesh cannot take the sharded plane (None when it
+    can). The gate mirrors what the flat-bucket kernel actually requires:
+    a pure pointwise ``_leaf_step`` (no cross-element math, no extras)
+    and a stateless host prologue, over a mesh that actually shards."""
+    if ndev <= 1:
+        return "1-device mesh (sharding is a no-op)"
+    if not getattr(optimizer, "fastpath_capable", False):
+        return "optimizer has no pure _leaf_step kernel"
+    if getattr(optimizer, "_host_scalars_stateful", False):
+        return "order-sensitive host prologue (%s)" % \
+            type(optimizer).__name__
+    if not getattr(optimizer, "_leaf_step_pointwise", False):
+        return "non-pointwise _leaf_step (%s)" % type(optimizer).__name__
+    if _kernel_has_extras(optimizer):
+        return "kernel extras (%s)" % type(optimizer).__name__
+    return None
+
+
+def _f32(x):
+    return jnp.asarray(x, dtype=jnp.float32)
+
+
+class ShardedState(object):
+    """Placeholder riding in ``Updater.states`` while the real optimizer
+    state for that index lives flat-packed and dp-sharded inside a
+    :class:`ZeroPlane`. Anything outside the plane that needs the plain
+    per-parameter layout (``Updater.get_states``/``__call__``,
+    ``ensure_mp_state``) detects the ``_is_zero_shard`` marker and calls
+    :func:`materialize_updater` first — sharding must never corrupt a
+    checkpoint or an eager interleave."""
+
+    _is_zero_shard = True
+    __slots__ = ("plane", "pos")
+
+    def __init__(self, plane: "ZeroPlane", pos: int):
+        self.plane = plane
+        self.pos = pos
+
+    def __repr__(self):
+        return "ShardedState(pos=%d, level=%d)" % (self.pos,
+                                                   self.plane.level)
+
+
+def is_sharded(state) -> bool:
+    return getattr(state, "_is_zero_shard", False)
+
+
+# ---------------------------------------------------------------------------
+# the sharded plane
+# ---------------------------------------------------------------------------
+
+
+class ZeroPlane(object):
+    """One sharded-state layout over a dp mesh: the flat bucket plan, the
+    persistent sharded state buckets, and the traced/shard-local update.
+
+    Used two ways:
+
+    * the **in-graph** path (``trainplane``) calls :meth:`traced_update`
+      inside its whole-step jit — the reduce-scatter / all-gather become
+      ``with_sharding_constraint`` annotations GSPMD lowers;
+    * the **eager** fused path (:func:`apply`, behind
+      ``fastpath.apply_updater``) packs on the source device, scatters
+      the flat buckets via ``parallel.put_sharded`` and runs one sharded
+      update jit per layout.
+    """
+
+    def __init__(self, optimizer, mesh, zero_level: int, indices,
+                 weights_data: Sequence[Any], states: Sequence[Any],
+                 mp_flags: Sequence[bool]):
+        self.mesh = mesh
+        self.level = int(zero_level)
+        self.axis = mesh.axis_names[0]
+        self.dp = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+        self.indices = tuple(indices)
+        self.mp_flags = tuple(bool(m) for m in mp_flags)
+        # group by (weight dtype, state pytree structure, mp): buckets
+        # must pack uniformly shaped/structured state slots
+        keys = []
+        for w, s, mp in zip(weights_data, states, mp_flags):
+            keys.append((str(w.dtype),
+                         str(jax.tree_util.tree_structure(s)), bool(mp)))
+        self.plan = bucketing.flat_plan(weights_data, keys, pad_to=self.dp)
+        self.bucket_mp = tuple(self.mp_flags[b[0]]
+                               for b in self.plan.buckets)
+        self.sig = (self.indices, self.plan.sig, self.level,
+                    tuple(d.id for d in mesh.devices.flat),
+                    self.mp_flags)
+        self.buckets: Optional[List[Any]] = None  # sharded state, per bucket
+        self._treedefs: Optional[List[Any]] = None
+        self._home = None          # device the eager caller's arrays live on
+        self._update_jits: Dict[Any, Any] = {}
+        self._expand_jit = None
+
+    # -- shardings ------------------------------------------------------
+    def _shard(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P(self.axis))
+
+    def _repl(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P())
+
+    def _slot_shardings(self, b: int) -> List[Any]:
+        """Target sharding per state-leaf slot of bucket ``b``: everything
+        shards except — at level 1 — the fp32 master (slot 0 of an mp
+        state), which classic ZeRO-1 keeps with the (replicated)
+        parameters."""
+        n = self._treedefs[b].num_leaves
+        shard, repl = self._shard(), self._repl()
+        out = [shard] * n
+        if self.bucket_mp[b] and self.level < 2 and n:
+            # classic ZeRO-1 keeps the fp32 master with the (replicated)
+            # parameters; leaf 0 of an mp pair IS the master
+            out[0] = repl
+        return out
+
+    def sharding_tree(self) -> List[Any]:
+        """Per-bucket pytree of target shardings — the jit
+        ``out_shardings`` for the state outputs."""
+        out = []
+        for b, td in enumerate(self._treedefs):
+            out.append(jax.tree_util.tree_unflatten(
+                td, self._slot_shardings(b)))
+        return out
+
+    # -- adoption / materialization ------------------------------------
+    def ensure_treedefs(self, states: Sequence[Any]) -> None:
+        if self._treedefs is None:
+            self._treedefs = [
+                jax.tree_util.tree_structure(states[b[0]])
+                for b in self.plan.buckets]
+
+    def bucket_avals(self, states: Sequence[Any]) -> List[Any]:
+        """ShapeDtypeStructs of the packed state buckets — the trace
+        probe's stand-in, computed without touching a device."""
+        self.ensure_treedefs(states)
+        out = []
+        for b, positions in enumerate(self.plan.buckets):
+            _, padded = self.plan.bucket_layout(b)
+            leaves = jax.tree_util.tree_leaves(states[positions[0]])
+            out.append(jax.tree_util.tree_unflatten(
+                self._treedefs[b],
+                [jax.ShapeDtypeStruct((padded,), l.dtype)
+                 for l in leaves]))
+        return out
+
+    def adopt(self, states: Sequence[Any], home=None) -> None:
+        """Pack the plain per-parameter ``states`` (parallel to the plan's
+        positions) into flat padded buckets and lay them out over the
+        mesh via ``parallel.put_sharded`` — the persistent sharded
+        representation. One-time per (re)adoption; steps afterwards keep
+        the state resident in its shards."""
+        from .. import parallel
+
+        self.ensure_treedefs(states)
+        self._home = home
+        buckets = []
+        for b, positions in enumerate(self.plan.buckets):
+            sizes, padded = self.plan.bucket_layout(b)
+            pad = padded - sum(sizes)
+            leaf_lists = [jax.tree_util.tree_leaves(states[i])
+                          for i in positions]
+            slots = []
+            for j in range(len(leaf_lists[0])):
+                parts = [ll[j].ravel() for ll in leaf_lists]
+                flat = jnp.concatenate(parts) if len(parts) > 1 \
+                    else parts[0]
+                if pad:
+                    flat = jnp.concatenate(
+                        [flat, jnp.zeros((pad,), flat.dtype)])
+                slots.append(flat)
+            targets = self._slot_shardings(b)
+            slots = [parallel.put_sharded(s, t)
+                     for s, t in zip(slots, targets)]
+            buckets.append(jax.tree_util.tree_unflatten(
+                self._treedefs[b], slots))
+        self.buckets = buckets
+
+    def materialize(self) -> List[Any]:
+        """All-gather the sharded buckets back into plain per-parameter
+        state trees (plan order). Used at the sync points — checkpoints,
+        eager per-param interleaves, layout changes — after which the
+        plane is detached (the next sharded step re-adopts)."""
+        from .. import parallel
+
+        assert self.buckets is not None
+        out: List[Any] = [None] * len(self.plan.sig)
+        repl = self._repl()
+        for b, positions in enumerate(self.plan.buckets):
+            sizes, _ = self.plan.bucket_layout(b)
+            gathered = [jax.device_put(s, repl) if hasattr(s, "sharding")
+                        else s
+                        for s in jax.tree_util.tree_leaves(
+                            self.buckets[b])]
+            off = 0
+            for i, size in zip(positions, sizes):
+                shape = self.plan.sig[i][0]
+                leaves = []
+                for g in gathered:
+                    piece = g[off:off + size].reshape(shape)
+                    if self._home is not None:
+                        piece = parallel.shard_for_device(piece,
+                                                          self._home)
+                    leaves.append(piece)
+                out[i] = jax.tree_util.tree_unflatten(
+                    self._treedefs[b], leaves)
+                off += size
+        self.buckets = None
+        return out
+
+    def state_handles(self) -> List[ShardedState]:
+        return [ShardedState(self, pos)
+                for pos in range(len(self.plan.sig))]
+
+    # -- the shard-local update ----------------------------------------
+    def _expand(self, b: int, vals, pad_value: float):
+        """Per-element vector over bucket ``b`` from per-leaf scalars:
+        ``vals`` is a 1-D array of the bucket's leaf scalars (traced or
+        host-built), broadcast over the static flat layout; the padding
+        tail gets ``pad_value`` (chosen so padded lanes stay finite —
+        their results are never read)."""
+        sizes, padded = self.plan.bucket_layout(b)
+        reps = np.asarray(sizes + [padded - sum(sizes)], np.int32)
+        vals = jnp.concatenate(
+            [jnp.asarray(vals, jnp.float32).reshape(-1),
+             jnp.asarray([pad_value], jnp.float32)])
+        return jnp.repeat(vals, reps, total_repeat_length=padded)
+
+    def expand_scalars(self, ts, lrs, wds):
+        """Per-bucket per-element (t, lr, wd) vectors as device arrays,
+        computed in their OWN jit and handed to the sharded update as
+        plain operands. Expanding in-trace would be one less dispatch,
+        but a ``repeat`` feeding a partitioned elementwise fusion was
+        measured to perturb FMA contraction near shard boundaries
+        (1-ulp state drift vs the replicated kernel); as operands the
+        sharded update math is bitwise the replicated math."""
+        if self._expand_jit is None:
+            plane = self
+            nb = len(self.plan.buckets)
+
+            def expand(tvals, lrvals, wdvals):
+                return ([plane._expand(b, tvals[b], 1.0)
+                         for b in range(nb)],
+                        [plane._expand(b, lrvals[b], 1.0)
+                         for b in range(nb)],
+                        [plane._expand(b, wdvals[b], 0.0)
+                         for b in range(nb)])
+
+            self._expand_jit = jax.jit(expand)
+        tvals, lrvals, wdvals = [], [], []
+        for positions in self.plan.buckets:
+            tvals.append(np.asarray([ts[i] for i in positions],
+                                    np.float32))
+            lrvals.append(np.asarray([lrs[i] for i in positions],
+                                     np.float32))
+            wdvals.append(np.asarray([wds[i] for i in positions],
+                                     np.float32))
+        return self._expand_jit(tvals, lrvals, wdvals)
+
+    def bucket_kernel(self, optimizer):
+        """The flat twin of ``fused.tree_kernel``: ``Optimizer._leaf_step``
+        over each flat bucket with per-element scalar vectors — the same
+        pointwise math, one kernel per bucket instead of per parameter."""
+        bucket_mp = self.bucket_mp
+
+        def step(flat_ws, flat_gs, buckets, tvs, lrvs, wdvs):
+            new_ws, new_sts = [], []
+            for w, g, s, t, lr, wd, mp in zip(
+                    flat_ws, flat_gs, buckets, tvs, lrvs, wdvs,
+                    bucket_mp):
+                if mp:
+                    master, base = s
+                    nm, nb = optimizer._leaf_step(
+                        master, g.astype(jnp.float32), base, t, lr, wd)
+                    new_ws.append(nm.astype(w.dtype))
+                    new_sts.append((nm, nb))
+                else:
+                    nw, ns = optimizer._leaf_step(w, g, s, t, lr, wd)
+                    new_ws.append(nw)
+                    new_sts.append(ns)
+            return new_ws, new_sts
+
+        return step
+
+    def traced_update(self, optimizer, diff_vals, grads, buckets,
+                      tvs, lrvs, wdvs):
+        """The in-graph sharded update, traced inside the whole-step jit:
+        pack → constrain the packed grads to the dp shards (GSPMD lowers
+        the pending batch-axis reduction to a reduce-scatter) → the
+        shard-local bucket kernel → all-gather ONLY the updated weights.
+        ``tvs``/``lrvs``/``wdvs`` are the :meth:`expand_scalars` vectors,
+        riding in as step-jit operands. Returns per-leaf new weights
+        (replicated) + the new state buckets (sharded)."""
+        wsc = jax.lax.with_sharding_constraint
+        shard, repl = self._shard(), self._repl()
+        flat_gs = [wsc(x, shard) for x in self.plan.pack(list(grads))]
+        flat_ws = [wsc(x, shard) for x in self.plan.pack(list(diff_vals))]
+        kernel = self.bucket_kernel(optimizer)
+        new_flat_ws, new_buckets = kernel(
+            flat_ws, flat_gs, buckets, tvs, lrvs, wdvs)
+        new_flat_ws = [wsc(x, repl) for x in new_flat_ws]
+        new_ws = self.plan.unpack(new_flat_ws)
+        new_buckets = [
+            jax.tree_util.tree_map(lambda x, t: wsc(x, t), nb, st)
+            for nb, st in zip(new_buckets, self.sharding_tree())]
+        return new_ws, new_buckets
+
+    # -- the eager fused path ------------------------------------------
+    def _update_jit(self, optimizer, argnums: bool):
+        key = (optimizer.rescale_grad, optimizer.clip_gradient, argnums)
+        fn = self._update_jits.get(key)
+        if fn is not None:
+            return fn
+        kernel = self.bucket_kernel(optimizer)
+        repl = self._repl()
+        plan = self
+
+        def update(flat_ws, flat_gs, buckets, tvs, lrvs, wdvs):
+            new_flat_ws, new_buckets = kernel(
+                flat_ws, flat_gs, buckets, tvs, lrvs, wdvs)
+            new_flat_ws = [jax.lax.with_sharding_constraint(x, repl)
+                           for x in new_flat_ws]
+            return plan.plan.unpack(new_flat_ws), new_buckets
+
+        leaf_repl = [repl] * len(self.plan.sig)
+        fn = jax.jit(update,
+                     out_shardings=(leaf_repl, self.sharding_tree()),
+                     donate_argnums=(0, 2) if argnums else ())
+        self._update_jits[key] = fn
+        return fn
+
+    def step(self, optimizer, grads, weights, ts, lrs, wds):
+        """One eager sharded update: pack the (already dp-reduced) grads
+        and current weights on their source device, scatter the flat
+        buckets over the mesh, run the shard-local kernel, and hand the
+        all-gathered weights back on the caller's device. Optimizer
+        state never leaves its shards."""
+        from .. import parallel
+        from .fused import donation_prep, invalidate_consumed
+
+        ws = [w._data for w in weights]
+        gs = [g._data for g in grads]
+        shard = self._shard()
+        flat_ws = [parallel.put_sharded(x, shard)
+                   for x in self.plan.pack(ws)]
+        flat_gs = [parallel.put_sharded(x, shard)
+                   for x in self.plan.pack(gs)]
+        tvs, lrvs, wdvs = self.expand_scalars(ts, lrs, wds)
+        argnums, consumed = donation_prep(flat_ws, self.buckets)
+        fn = self._update_jit(optimizer, argnums)
+        telemetry.OPT_DISPATCHES.inc(path="zero")
+        new_ws, new_buckets = telemetry.jit_call(
+            "fastpath.zero_apply", fn, flat_ws, flat_gs, self.buckets,
+            tvs, lrvs, wdvs)
+        self.buckets = new_buckets
+        for w, nw in zip(weights, new_ws):
+            w._data = parallel.shard_for_device(nw, self._home) \
+                if self._home is not None else nw
+        invalidate_consumed(consumed, (new_ws, new_buckets, flat_gs))
+        telemetry.sample_hbm()
+
+
+# ---------------------------------------------------------------------------
+# updater plumbing (the eager fused path behind apply_updater)
+# ---------------------------------------------------------------------------
+
+
+def plane_of(updater) -> Optional[ZeroPlane]:
+    return getattr(updater, "_zero_plane", None)
+
+
+def materialize_updater(updater) -> None:
+    """Bring every sharded state in ``updater.states`` back to the plain
+    per-parameter layout and detach the plane. Idempotent; called from
+    the sync points (``Updater.get_states``/``__call__``, layout
+    changes, zero deactivation).
+
+    A bucket whose buffers were donated into a step that then FAILED is
+    unrecoverable (the runtime already invalidated them) — those indices
+    are dropped instead of raising out of a fallback handler; every
+    consumer of a missing state recreates it fresh (the serving plane's
+    evict-onto-fresh-pools discipline applied to optimizer state)."""
+    plane = plane_of(updater)
+    if plane is None:
+        return
+    updater._zero_plane = None
+    if plane.buckets is None:
+        return
+    dead = any(getattr(leaf, "is_deleted", lambda: False)()
+               for leaf in jax.tree_util.tree_leaves(plane.buckets))
+    if dead:
+        note_fallback("sharded state lost (donated step failed)")
+        plane.buckets = None
+        for idx in plane.indices:
+            if is_sharded(updater.states.get(idx)):
+                updater.states.pop(idx, None)
+                if hasattr(updater, "states_synced"):
+                    updater.states_synced.pop(idx, None)
+        return
+    states = plane.materialize()
+    for pos, idx in enumerate(plane.indices):
+        if is_sharded(updater.states.get(idx)):
+            updater.states[idx] = states[pos]
+
+
+def ensure_materialized(updater, indices: Sequence[Any]) -> List[Any]:
+    """``updater.states[i]`` for ``indices`` with any
+    :class:`ShardedState` handles resolved to plain states first — the
+    guard for paths that reach ``fused_apply`` directly while a plane is
+    attached (e.g. the zero knob flipped off mid-run). An index whose
+    sharded state was lost to a failed donated step comes back ``None``
+    — the caller recreates it."""
+    if any(is_sharded(updater.states.get(i)) for i in indices):
+        materialize_updater(updater)
+    return [updater.states.get(i) for i in indices]
+
+
+def acquire_plane(updater, optimizer, mesh, lv: int, indices,
+                  weights, home=None) -> ZeroPlane:
+    """Attach (or keep) the updater's :class:`ZeroPlane` for EXACTLY this
+    layout — same indices/shapes/dtypes/level/mesh AND every state still
+    its handle; anything else (a skipped stale grad, a checkpoint
+    restore, a flipped knob) materializes and re-adopts. On (re)build the
+    current states are mp-migrated exactly as ``apply_updater`` would
+    (a formerly-sharded state may predate a ``multi_precision`` flip),
+    packed into padded flat buckets and laid out over ``mesh``; handles
+    are installed in ``updater.states``. Shared by the eager fused path
+    (:func:`apply`) and the in-graph ``trainplane`` step, so the two
+    cannot grow different plane lifecycles."""
+    from ..optimizer import ensure_mp_state
+    from .fused import _is_mp_state
+
+    indices = list(indices)
+    plane = plane_of(updater)
+    if plane is not None:
+        states = [updater.states[i] for i in indices]
+        reuse = (plane.buckets is not None
+                 and lv == plane.level
+                 and tuple(indices) == plane.indices
+                 and tuple(d.id for d in mesh.devices.flat)
+                 == plane.sig[3]
+                 and tuple((tuple(w._data.shape), str(w._data.dtype))
+                           for w in weights) == plane.plan.sig
+                 and all(is_sharded(s) and s.plane is plane
+                         and s.pos == k
+                         for k, s in enumerate(states)))
+        if not reuse:
+            materialize_updater(updater)
+            plane = None
+    if plane is None:
+        states = []
+        for i, w in zip(indices, weights):
+            updater.states[i] = ensure_mp_state(
+                optimizer, i, w, updater.states[i])
+            states.append(updater.states[i])
+        mp_flags = [_is_mp_state(optimizer, i, w, s)
+                    for i, w, s in zip(indices, weights, states)]
+        plane = ZeroPlane(optimizer, mesh, lv, indices,
+                          [w._data for w in weights], states, mp_flags)
+        plane.adopt(states, home=home)
+        updater._zero_plane = plane
+        for pos, i in enumerate(indices):
+            updater.states[i] = ShardedState(plane, pos)
+    return plane
+
+
+_MESH_CACHE: Dict[Any, Any] = {}
+
+
+def _default_ndev() -> int:
+    """Device count of the eager path's dp mesh, without building it —
+    the eligibility probe runs per step and must stay cheap."""
+    n = len(jax.devices())
+    cap = _max_devices()
+    return min(n, cap) if cap else n
+
+
+def _default_mesh():
+    """The eager path's dp mesh: every local device (capped by
+    ``MXNET_ZERO_DEVICES``) on one ``dp`` axis. Memoized per device set
+    — a Mesh is not free and this sits on the per-step path."""
+    from .. import parallel
+
+    n = _default_ndev()
+    key = tuple(d.id for d in jax.devices()[:n])
+    mesh = _MESH_CACHE.get(key)
+    if mesh is None:
+        mesh = parallel.device_mesh(n)
+        _MESH_CACHE[key] = mesh
+    return mesh
+
+
+def apply(updater, triples, positions: int = 1) -> bool:
+    """Try to run one fused update through the sharded plane; returns
+    ``False`` (after noting the fallback reason) when the replicated
+    ``fused_apply`` should run instead. Mirrors ``fused_apply``'s host
+    prologue exactly — same ``_update_count`` + ``_host_scalars``
+    sequence — so the sharded step consumes bit-identical scalars."""
+    optimizer = updater.optimizer
+    if getattr(updater, "_zero_opt_out", None):
+        note_fallback(str(updater._zero_opt_out))
+        materialize_updater(updater)
+        return False
+    if positions > 1:
+        note_fallback("multi-position eager update")
+        materialize_updater(updater)
+        return False
+    plane = plane_of(updater)
+    ndev = len(plane.mesh.devices.flat) if plane is not None \
+        else _default_ndev()
+    reason = eligible_reason(optimizer, ndev)
+    if reason is not None:
+        note_fallback(reason)
+        materialize_updater(updater)
+        return False
+    mesh = plane.mesh if plane is not None else _default_mesh()
+
+    indices = [t[0] for t in triples]
+    grads = [t[1] for t in triples]
+    weights = [t[2] for t in triples]
+    home = None
+    devs = getattr(weights[0]._data, "devices", lambda: None)()
+    if devs and len(devs) == 1:
+        home = next(iter(devs))
+    try:
+        plane = acquire_plane(updater, optimizer, mesh, level(), indices,
+                              weights, home=home)
+    except Exception as exc:  # noqa: BLE001 - never-a-crash: a failed
+        # adopt/layout build falls back BEFORE the prologue mutates any
+        # counter, so the replicated fused_apply runs a clean update
+        note_fallback("adopt: %s" % type(exc).__name__)
+        materialize_updater(updater)
+        return False
+
+    # the SAME prologue fused_apply runs, in the same order. Snapshot the
+    # counters first: eligibility already ruled out stateful prologues,
+    # so a restore makes the prologue exactly replayable — a failed
+    # sharded step can hand the update to the replicated fused_apply,
+    # which re-runs the identical sequence without double-advancing t
+    pre_num_update = optimizer.num_update
+    pre_counts = {i: optimizer._index_update_count.get(i)
+                  for i in indices}
+    ts, lrs, wds = [], [], []
+    for i in indices:
+        optimizer._update_count(i)
+        lr, wd, _ex = optimizer._host_scalars(i)
+        ts.append(float(optimizer._index_update_count[i]))
+        lrs.append(float(lr))
+        wds.append(float(wd))
+
+    try:
+        plane.step(optimizer, grads, weights, ts, lrs, wds)
+    except Exception as exc:  # noqa: BLE001 - never-a-crash: a sharded
+        # trace/exec failure demotes to the replicated path, counted
+        note_fallback("step: %s" % type(exc).__name__)
+        for i, c in pre_counts.items():
+            if c is None:
+                optimizer._index_update_count.pop(i, None)
+            else:
+                optimizer._index_update_count[i] = c
+        optimizer.num_update = pre_num_update
+        materialize_updater(updater)
+        return False
+    return True
+
+
+def state_bytes_on(device, updater) -> int:
+    """Optimizer-state bytes resident on ``device`` for this updater —
+    per-shard accounting that works on every backend (the bench's
+    ground truth next to the HBM gauges, which need device memory
+    stats). Counts plain states and sharded plane buckets alike."""
+    seen_planes = set()
+    total = 0
+
+    def _leaf_bytes(x):
+        nonlocal total
+        if not hasattr(x, "addressable_shards"):
+            if hasattr(x, "nbytes"):
+                total += int(x.nbytes)
+            return
+        for s in x.addressable_shards:
+            if s.device == device:
+                total += int(s.data.nbytes)
+
+    for st in updater.states.values():
+        if is_sharded(st):
+            plane = st.plane
+            if id(plane) in seen_planes or plane.buckets is None:
+                continue
+            seen_planes.add(id(plane))
+            for leaf in jax.tree_util.tree_leaves(plane.buckets):
+                _leaf_bytes(leaf)
+        else:
+            for leaf in jax.tree_util.tree_leaves(st):
+                _leaf_bytes(leaf)
+    return total

@@ -220,7 +220,8 @@ class Trainer(object):
                         continue
                     triples.append((i, param.list_grad()[j], arr))
                     arr._fresh_grad = False
-                fastpath.apply_updater(upd, triples)
+                fastpath.apply_updater(upd, triples,
+                                       positions=len(self._updaters))
             return
 
         for i, param in rows:

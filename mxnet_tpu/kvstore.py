@@ -343,9 +343,16 @@ class KVStore(object):
         this process)."""
         self._optimizer = optimizer
         self._updater = opt.get_updater(optimizer)
+        # store-side updates run against the store's own weight copies —
+        # the ZeRO plane (fastpath.zero) must not adopt THOSE as sharded
+        # training state (the weights callers pull would skip the
+        # all-gathered layout); the classic update_on_kvstore exclusion
+        self._updater._zero_opt_out = "update_on_kvstore"
 
     def _set_updater(self, updater):
         self._updater = updater
+        if hasattr(updater, "states"):
+            updater._zero_opt_out = "update_on_kvstore"
 
     def _can_fuse_pushpull(self):
         """Whether callers may use the batched ``pushpull_multi`` fast path;

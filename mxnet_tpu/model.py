@@ -107,7 +107,8 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None,
                 by_pos.setdefault(k, []).append(
                     (index * num_device + k, g, w))
         for k in sorted(by_pos):
-            fastpath.apply_updater(updater, by_pos[k])
+            fastpath.apply_updater(updater, by_pos[k],
+                                   positions=len(by_pos))
         return
     for index, arg_list, grad_list in entries:
         for k, p in enumerate(zip(arg_list, grad_list)):

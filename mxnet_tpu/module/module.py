@@ -319,7 +319,8 @@ class Module(BaseModule):
                     by_pos.setdefault(k, []).append(
                         (idx, g, e.arg_dict[name]))
             for k in sorted(by_pos):
-                fastpath.apply_updater(self._updater, by_pos[k])
+                fastpath.apply_updater(self._updater, by_pos[k],
+                                       positions=len(by_pos))
             return
         for idx, name, pairs in entries:
             for e, g in pairs:

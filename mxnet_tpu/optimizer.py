@@ -207,6 +207,15 @@ class Optimizer(object):
     #: positions it cannot, and ``fastpath.supports`` falls back.
     _host_scalars_stateful = False
 
+    #: True when :meth:`_leaf_step` is element-wise over the weight — no
+    #: cross-element math (LBSGD's layer-wise norms), no shape-dependent
+    #: randomness (SGLD's noise draw). The ZeRO plane (``fastpath.zero``)
+    #: may then run the kernel over a flattened 1/N dp-shard of the
+    #: concatenated parameter buckets and get bit-identical per-element
+    #: results; subclasses with cross-element math MUST set this False or
+    #: sharded updates would silently change the math.
+    _leaf_step_pointwise = True
+
     @property
     def fastpath_capable(self):
         """Whether ``fastpath.fused_apply`` can fold this optimizer's whole
@@ -486,6 +495,7 @@ class SGLD(Optimizer):
     extra."""
 
     _host_scalars_stateful = True  # consumes the host rng stream in order
+    _leaf_step_pointwise = False   # noise draw depends on the weight SHAPE
 
     def _host_scalars(self, index):
         from . import _global
@@ -607,6 +617,8 @@ class LBSGD(Optimizer):
                  num_epochs=60, **kwargs):
         super().__init__(multi_precision=multi_precision, **kwargs)
         self.momentum = momentum
+
+    _leaf_step_pointwise = False  # layer-wise w/g norms are cross-element
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -881,6 +893,18 @@ class Updater(object):
         if index not in self.states:
             self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
             self.states_synced[index] = True
+        elif getattr(self.states[index], "_is_zero_shard", False):
+            # an eager per-param update interleaving with the ZeRO plane
+            # must see the plain per-parameter layout — materialize the
+            # whole plane (the next sharded step re-adopts)
+            from .fastpath import zero
+
+            zero.materialize_updater(self)
+            if index not in self.states:  # lost to a failed donated step
+                self.states[index] = \
+                    self.optimizer.create_state_multi_precision(index,
+                                                                weight)
+                self.states_synced[index] = True
         self.states[index] = self.optimizer.update_multi_precision(
             index, weight, grad, self.states[index])
 
@@ -889,6 +913,9 @@ class Updater(object):
 
     def set_states(self, states):
         """Restore states from :meth:`get_states` bytes."""
+        # a restore replaces the whole layout: drop any attached ZeRO
+        # plane rather than letting a stale handle alias the old shards
+        self._zero_plane = None
         states = pickle.loads(states)
         if isinstance(states, tuple) and len(states) == 2:
             self.states, self.optimizer = states
@@ -903,7 +930,13 @@ class Updater(object):
         self.states_synced = dict.fromkeys(self.states.keys(), False)
 
     def get_states(self, dump_optimizer=False):
-        """Serialize states (optionally with the optimizer) to bytes."""
+        """Serialize states (optionally with the optimizer) to bytes.
+        Sharded (ZeRO) states are materialized back to the plain
+        per-parameter layout first — a checkpoint must never depend on
+        the mesh it was trained on."""
+        from .fastpath import zero
+
+        zero.materialize_updater(self)
         host_states = {
             k: jax.tree_util.tree_map(
                 lambda a: np.asarray(a) if isinstance(a, jnp.ndarray) else a, v)

@@ -407,12 +407,14 @@ def shard_to_mesh(data, mesh: Mesh, batch_axis: int = 0,
 _REPL_JITS: Dict[Any, Any] = {}
 
 
-def _identity_copy_fn(mesh: Mesh):
-    key = tuple(d.id for d in mesh.devices.flat)
+def _identity_copy_fn(mesh: Mesh, target=None):
+    if target is None:
+        target = NamedSharding(mesh, P())
+    key = (tuple(d.id for d in mesh.devices.flat),
+           str(getattr(target, "spec", target)))
     fn = _REPL_JITS.get(key)
     if fn is None:
-        fn = jax.jit(lambda a: a,
-                     out_shardings=NamedSharding(mesh, P()))
+        fn = jax.jit(lambda a: a, out_shardings=target)
         _REPL_JITS[key] = fn
     return fn
 
@@ -426,34 +428,46 @@ def _buffer_ptrs(a):
         return None
 
 
-def fresh_replicate(x, mesh: Mesh):
-    """Replicate ``x`` over ``mesh`` into FRESH buffers, without the eager
+def fresh_replicate(x, mesh: Mesh, target=None):
+    """Lay ``x`` out over ``mesh`` into FRESH buffers, without the eager
     ``jnp.copy`` intermediate the old TrainStep init paid (a transient
     second full copy of every parameter — the 2x-HBM init spike): the
     result must not alias the source, because the step jit donates its
     param inputs and donation would otherwise delete a buffer the caller
     still references.
 
+    ``target`` is the destination ``Sharding`` (or an ``ndim ->
+    Sharding`` callable, resolved through :func:`resolve_sharding`);
+    default fully replicated. The alias guard is layout-aware: a source
+    already laid out as ``target`` — INCLUDING a dp-sharded ZeRO state
+    bucket re-initialized in place — takes one compiled identity copy
+    UNDER THAT LAYOUT instead of being silently re-replicated (the
+    pre-ZeRO guard only knew the replicated case, so re-initializing a
+    sharded tree would have quietly undone its sharding and N-tupled its
+    per-device bytes).
+
     * host (numpy) source: ``device_put`` allocates fresh device buffers
       by construction — one copy, done;
-    * resharding device source: ``device_put`` to the replicated layout,
-      then an isolation pass ONLY if a source buffer leaked into the
-      result (a runtime may reuse the source as the co-located replica);
-    * already-replicated source (the alias-guaranteed case ``device_put``
+    * relaying-out device source: ``device_put`` to ``target``, then an
+      isolation pass ONLY if a source buffer leaked into the result (a
+      runtime may reuse the source as a co-located shard);
+    * already-in-layout source (the alias-guaranteed case ``device_put``
       would no-op on): one compiled identity copy — jit outputs never
       alias non-donated inputs.
     """
-    repl = NamedSharding(mesh, P())
+    target = resolve_sharding(target, getattr(x, "ndim", 0))
+    if target is None:
+        target = NamedSharding(mesh, P())
     sh = getattr(x, "sharding", None)
     if sh is None:
-        return jax.device_put(x, repl)
-    if sh.is_equivalent_to(repl, x.ndim):
-        return _identity_copy_fn(mesh)(x)
+        return jax.device_put(x, target)
+    if sh.is_equivalent_to(target, x.ndim):
+        return _identity_copy_fn(mesh, target)(x)
     src = _buffer_ptrs(x)
-    moved = jax.device_put(x, repl)
+    moved = jax.device_put(x, target)
     dst = _buffer_ptrs(moved)
     if src is None or dst is None or (src & dst):
-        moved = _identity_copy_fn(mesh)(moved)
+        moved = _identity_copy_fn(mesh, target)(moved)
     return moved
 
 

@@ -36,11 +36,13 @@ from __future__ import annotations
 
 from . import accounting, exporters, registry, spans
 from .accounting import (COMPILE_CACHE_HITS, COMPILE_CACHE_MISSES,
-                         COMPILE_SECONDS, OPT_DISPATCHES, PROFILER_COUNTER,
+                         COMPILE_SECONDS, HBM_BYTES_IN_USE, HBM_BYTES_PEAK,
+                         OPT_DISPATCHES, PROFILER_COUNTER,
                          RECOMPILES, STEADY_STATE_RECOMPILES, STEP_DISPATCHES,
                          TRANSFER_BYTES,
                          TRANSFERS, jit_cache_size, jit_call, note_recompile,
-                         record_transfer, set_steady_state_recompiles)
+                         record_transfer, sample_hbm,
+                         set_steady_state_recompiles)
 from .exporters import (Emitter, render_prometheus, snapshot, start_emitter,
                         stop_emitter)
 from .registry import (Counter, Gauge, Histogram, Registry, REGISTRY,
@@ -52,9 +54,10 @@ __all__ = [
     "counter", "gauge", "histogram", "enabled", "set_enabled",
     "span", "traced",
     "jit_call", "jit_cache_size", "note_recompile", "record_transfer",
-    "set_steady_state_recompiles",
+    "sample_hbm", "set_steady_state_recompiles",
     "RECOMPILES", "COMPILE_SECONDS", "STEADY_STATE_RECOMPILES",
     "TRANSFERS", "TRANSFER_BYTES", "PROFILER_COUNTER",
+    "HBM_BYTES_IN_USE", "HBM_BYTES_PEAK",
     "OPT_DISPATCHES", "STEP_DISPATCHES",
     "COMPILE_CACHE_HITS", "COMPILE_CACHE_MISSES",
     "render_prometheus", "snapshot", "Emitter", "start_emitter",

@@ -26,8 +26,10 @@ __all__ = ["RECOMPILES", "COMPILE_SECONDS", "STEADY_STATE_RECOMPILES",
            "TRANSFERS", "TRANSFER_BYTES", "PROFILER_COUNTER",
            "OPT_DISPATCHES", "STEP_DISPATCHES",
            "COMPILE_CACHE_HITS", "COMPILE_CACHE_MISSES",
+           "HBM_BYTES_IN_USE", "HBM_BYTES_PEAK",
            "jit_call", "jit_cache_size", "note_recompile",
-           "record_transfer", "set_steady_state_recompiles"]
+           "record_transfer", "sample_hbm",
+           "set_steady_state_recompiles"]
 
 RECOMPILES = _registry.counter(
     "mxnet_recompiles_total",
@@ -80,6 +82,19 @@ COMPILE_CACHE_MISSES = _registry.counter(
     "mxnet_compile_cache_misses_total",
     "compilations the persistent cache could not serve (first-ever trace "
     "of that program on this machine)")
+
+HBM_BYTES_IN_USE = _registry.gauge(
+    "mxnet_hbm_bytes_in_use",
+    "device memory currently allocated, per device, as reported by the "
+    "PJRT memory stats (sample_hbm; absent where the backend has no "
+    "stats, e.g. CPU)",
+    labels=("device",))
+
+HBM_BYTES_PEAK = _registry.gauge(
+    "mxnet_hbm_bytes_peak",
+    "peak device memory allocated since process start, per device "
+    "(sample_hbm; absent where the backend has no stats)",
+    labels=("device",))
 
 PROFILER_COUNTER = _registry.gauge(
     "mxnet_profiler_counter",
@@ -158,6 +173,36 @@ def set_steady_state_recompiles(site: str, count: int):
     if not _registry.ENABLED:
         return
     STEADY_STATE_RECOMPILES.set(count, site=site)
+
+
+def sample_hbm(devices=None):
+    """Sample per-device memory stats into the ``mxnet_hbm_bytes_*``
+    gauges and return ``{device_id: (in_use, peak)}``. HBM — not compute
+    — is what the ZeRO state plane trades for collectives, so the
+    training planes publish this per step and the bench stamps it on
+    every JSON line. Guarded no-op where the backend exposes no memory
+    stats (CPU devices return ``None``): the gauges stay unset rather
+    than lying a zero."""
+    if not _registry.ENABLED:
+        return {}
+    import jax
+
+    out = {}
+    for d in (devices if devices is not None else jax.local_devices()):
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 - a stats probe must never break a step
+            stats = None
+        if not stats:
+            continue
+        used = stats.get("bytes_in_use")
+        peak = stats.get("peak_bytes_in_use", used)
+        if used is None:
+            continue
+        HBM_BYTES_IN_USE.set(int(used), device=str(d.id))
+        HBM_BYTES_PEAK.set(int(peak), device=str(d.id))
+        out[d.id] = (int(used), int(peak))
+    return out
 
 
 def record_transfer(path: str, arrays):

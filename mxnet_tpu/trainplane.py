@@ -220,6 +220,7 @@ class TrainPlane(_PlaneBase):
         self._cast = None                  # jnp.bfloat16 under bf16 mode
         self._rows = None                  # [(trainer idx, Parameter)]
         self._const_names = None
+        self._zero_broken = None           # sticky zero-trace failure
         self._jits: Dict[Any, Any] = {}
         self.step_count = 0
         # multi-host: join the distributed runtime when a launcher planted
@@ -374,10 +375,13 @@ class TrainPlane(_PlaneBase):
         return [_is_mp_state(optimizer, i, p.data(ctx), updater.states[i])
                 for i, p in self._rows]
 
-    def _gather(self, updater):
+    def _gather(self, updater, with_states=True):
         """Current param/state values as jax arrays, replicated over the
         mesh (fresh buffer on first touch — later steps' outputs come back
-        replicated and skip the put)."""
+        replicated and skip the put). With the ZeRO plane active the
+        optimizer state lives dp-sharded in the plane's buckets and is
+        NOT gathered here (``with_states=False``) — replicating it would
+        silently undo the sharding."""
         from . import parallel
 
         ctx = self._trainer._contexts[0]
@@ -394,14 +398,17 @@ class TrainPlane(_PlaneBase):
 
         diff = [repl_val(p.data(ctx)) for _, p in self._rows]
         const = {n: repl_val(params[n].data(ctx)) for n in self._const_names}
-        states = [jax.tree_util.tree_map(
-            lambda x: x if getattr(x, "sharding", None) is not None
-            and x.sharding.is_equivalent_to(repl, x.ndim)
-            else parallel.fresh_replicate(x, self._mesh),
-            updater.states[i]) for i, _ in self._rows]
-        for (i, _), s in zip(self._rows, states):
-            updater.states[i] = s
-        return {"diff": diff, "const": const, "states": states}
+        out = {"diff": diff, "const": const}
+        if with_states:
+            states = [jax.tree_util.tree_map(
+                lambda x: x if getattr(x, "sharding", None) is not None
+                and x.sharding.is_equivalent_to(repl, x.ndim)
+                else parallel.fresh_replicate(x, self._mesh),
+                updater.states[i]) for i, _ in self._rows]
+            for (i, _), s in zip(self._rows, states):
+                updater.states[i] = s
+            out["states"] = states
+        return out
 
     def _build_step(self, optimizer, mp_flags):
         """The whole-step function: fwd + loss + bwd (+ GSPMD-inserted dp
@@ -438,12 +445,119 @@ class TrainPlane(_PlaneBase):
 
         return step
 
+    # -- ZeRO: the sharded state plane inside the step jit ---------------
+    def _zero_acquire(self, opt, updater):
+        """The updater's ZeroPlane for this step, or None for the
+        replicated layout — decided per call so a flipped ``MXNET_ZERO``
+        takes effect (and materializes) without re-activation. Every
+        decline lands in ``mxnet_zero_fallbacks_total``."""
+        from .fastpath import zero
+
+        lv = zero.level()
+        if lv == 0 or self._zero_broken is not None:
+            if zero.plane_of(updater) is not None:
+                zero.materialize_updater(updater)
+            return None
+        reason = zero.eligible_reason(opt, len(self._mesh.devices.flat))
+        if reason is not None:
+            zero.note_fallback(reason)
+            if zero.plane_of(updater) is not None:
+                zero.materialize_updater(updater)
+            return None
+        ctx = self._trainer._contexts[0]
+        weights = [p.data(ctx) for _, p in self._rows]
+        try:
+            return zero.acquire_plane(updater, opt, self._mesh, lv,
+                                      [i for i, _ in self._rows], weights)
+        except Exception as exc:  # noqa: BLE001 - never-a-crash: a failed
+            # adopt falls back to the replicated layout, counted
+            zero.note_fallback("adopt: %s" % type(exc).__name__)
+            zero.materialize_updater(updater)
+            return None
+
+    def _build_zero_step(self, optimizer, zp):
+        """The whole-step function over the SHARDED state plane: fwd +
+        loss + bwd, then ``fastpath.zero.traced_update`` — the packed
+        gradients constrained to the dp shards (GSPMD lowers the pending
+        batch-axis reduction to a reduce-scatter), the shard-local bucket
+        kernel, and an all-gather of ONLY the updated weights — traced
+        as ONE program."""
+        base_fn = self._net._base_fn([0], train=True)
+        diff_names = tuple(p.name for _, p in self._rows)
+        loss_fn = self._loss
+        cast = self._cast
+
+        def step(diff_vals, const_vals, buckets, tvs, lrvs, wdvs,
+                 data, label, rng):
+            if cast is not None and jnp.issubdtype(data.dtype, jnp.floating):
+                data = data.astype(cast)
+
+            def f(dv):
+                pv = dict(const_vals)
+                pv.update(zip(diff_names, dv))
+                outs, aux = base_fn(pv, rng, data)
+                out0 = outs[0] if isinstance(outs, tuple) else outs
+                with autograd._RecordingStateScope(False, None):
+                    l_nd = loss_fn(NDArray(out0, cpu()),
+                                   NDArray(label, cpu()))
+                return l_nd._data, aux
+
+            loss, vjp_fn, aux = jax.vjp(f, list(diff_vals), has_aux=True)
+            (grads,) = vjp_fn(jnp.ones(loss.shape, loss.dtype))
+            new_ws, new_buckets = zp.traced_update(
+                optimizer, list(diff_vals), grads, buckets,
+                tvs, lrvs, wdvs)
+            return loss, new_ws, new_buckets, aux
+
+        return step
+
+    def _zero_graph_call(self, zp, opt, updater, fts, flrs, fwds,
+                         d, l, rng):
+        """Dispatch one sharded whole-step jit and commit its outputs:
+        weights replicated back onto the params, state buckets staying in
+        their dp shards (``updater.states`` keeps the handles)."""
+        ctx = self._trainer._contexts[0]
+        args = self._gather(updater, with_states=False)
+        tvs, lrvs, wdvs = zp.expand_scalars(fts, flrs, fwds)
+        argnums, consumed = self._donation(args["diff"], zp.buckets)
+        # zp.sig carries indices/plan/level/mesh/mp — the sharded twin of
+        # the replicated key's mp_flags: a row added after activation (or
+        # any relayout) must miss here, not reuse a jit whose closure
+        # holds the OLD plane's diff names and bucket layout
+        key = ("zero", zp.sig, tuple(d.shape), str(d.dtype),
+               tuple(l.shape), str(l.dtype), opt.rescale_grad,
+               opt.clip_gradient, argnums)
+        fn = self._jits.get(key)
+        if fn is None:
+            repl = NamedSharding(self._mesh, P())
+            fn = jax.jit(
+                self._build_zero_step(opt, zp),
+                out_shardings=(repl, [repl] * len(self._rows),
+                               zp.sharding_tree(), repl),
+                donate_argnums=(0, 2) if argnums else ())
+            self._jits[key] = fn
+        loss, new_ws, new_buckets, aux = telemetry.jit_call(
+            "trainplane.step", fn, args["diff"], args["const"],
+            zp.buckets, tvs, lrvs, wdvs, d, l, rng)
+        zp.buckets = new_buckets
+
+        params = self._net.collect_params()
+        for (_i, p), nw in zip(self._rows, new_ws):
+            p.data(ctx)._data = nw
+        for name, val in aux.items():
+            params[name].data(ctx)._data = val
+        self._invalidate_consumed(consumed, (new_ws, new_buckets))
+        telemetry.STEP_DISPATCHES.inc(plane="graph")
+        telemetry.sample_hbm()
+        return NDArray(loss, ctx)
+
     def _graph_step(self, data_nd, label_nd, batch_size):
         tr = self._trainer
         opt = tr._optimizer
         updater = tr._updaters[0]
         ctx = tr._contexts[0]
         from . import parallel
+        from .fastpath import zero as zero_mod
 
         opt.rescale_grad = tr._scale / batch_size  # Trainer.step parity
         for i, p in self._rows:  # states for rows added after activation
@@ -451,13 +565,48 @@ class TrainPlane(_PlaneBase):
                 updater.states[i] = opt.create_state_multi_precision(
                     i, p.data(ctx))
                 updater.states_synced[i] = True
-        ts, lrs, wds, extras = self._host_prologue(
-            opt, [i for i, _ in self._rows])
-        mp_flags = tuple(self._mp_flags(opt, updater))
-        args = self._gather(updater)
         d = parallel.shard_to_mesh(data_nd, self._mesh, self._batch_axis)
         l = parallel.shard_to_mesh(label_nd, self._mesh, self._batch_axis)
         rng = _global_key()
+        indices = [i for i, _ in self._rows]
+
+        zp = self._zero_acquire(opt, updater)
+        if zp is not None:
+            # zero's float prologue — the SAME count/scalars sequence,
+            # plain floats for expand_scalars (no device scalar bounce)
+            fts, flrs, fwds = [], [], []
+            for i in indices:
+                opt._update_count(i)
+                lr, wd, _ex = opt._host_scalars(i)
+                fts.append(float(opt._index_update_count[i]))
+                flrs.append(float(lr))
+                fwds.append(float(wd))
+            try:
+                return self._zero_graph_call(zp, opt, updater,
+                                             fts, flrs, fwds, d, l, rng)
+            except Exception as exc:  # noqa: BLE001 - never-a-crash: the
+                # sharded trace failing must not kill training; the
+                # replicated step below reuses the SAME prologue scalars
+                # (counters already advanced — no double count)
+                zero_mod.note_fallback("trace: %s" % type(exc).__name__)
+                zero_mod.materialize_updater(updater)
+                self._zero_broken = type(exc).__name__
+                # a state lost to a failed DONATED execution cannot be
+                # materialized — recreate it fresh so the replicated step
+                # below still runs (momenta reset beats a dead run)
+                for i, p in self._rows:
+                    if i not in updater.states:
+                        updater.states[i] = \
+                            opt.create_state_multi_precision(i, p.data(ctx))
+                        updater.states_synced[i] = True
+                ts = [_f32(t) for t in fts]
+                lrs = [_f32(x) for x in flrs]
+                wds = [_f32(x) for x in fwds]
+                extras = [() for _ in indices]
+        else:
+            ts, lrs, wds, extras = self._host_prologue(opt, indices)
+        mp_flags = tuple(self._mp_flags(opt, updater))
+        args = self._gather(updater)
 
         argnums, consumed = self._donation(args["diff"], args["states"])
         key = (tuple(d.shape), str(d.dtype), tuple(l.shape), str(l.dtype),
@@ -482,6 +631,7 @@ class TrainPlane(_PlaneBase):
             params[name].data(ctx)._data = val
         self._invalidate_consumed(consumed, (new_ws, new_sts))
         telemetry.STEP_DISPATCHES.inc(plane="graph")
+        telemetry.sample_hbm()
         return NDArray(loss, ctx)
 
     # -- eager plane ----------------------------------------------------

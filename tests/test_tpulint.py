@@ -792,3 +792,78 @@ def test_decode_host_sync_repo_sites_are_baselined():
            "`fetch_host()` in decode-plane code runs per token — "
            "a device->host stall every tick")
     assert counts.get(key) == 2
+
+
+# ---------------------------------------------------------------------------
+# replicated-state
+# ---------------------------------------------------------------------------
+
+def test_replicated_state_flags_eager_copy_and_device_put():
+    f = lint("""
+        def restore(updater):
+            for i in updater.states:
+                updater.states[i] = jnp.copy(updater.states[i])
+        """, rule="replicated-state")
+    assert len(f) == 1 and "jnp.copy" in f[0].message
+
+    f = lint("""
+        def spread(opt_states, repl):
+            return [jax.device_put(s, repl) for s in opt_states]
+        """, rule="replicated-state")
+    assert len(f) == 1 and "device_put" in f[0].message
+
+
+def test_replicated_state_flags_tree_map_full_tree_copy():
+    f = lint("""
+        def gather(states, repl):
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, repl), states)
+        """, rule="replicated-state")
+    assert len(f) == 1 and "tree_map" in f[0].message
+
+
+def test_replicated_state_negative_cases():
+    # non-state arrays stay out of scope
+    assert lint("""
+        def copy_params(pvals):
+            return {n: jnp.copy(v) for n, v in pvals.items()}
+        """, rule="replicated-state") == []
+    # the blessed layout-aware helpers are the FIX, not a finding
+    assert lint("""
+        def gather(states, mesh):
+            return [parallel.fresh_replicate(s, mesh) for s in states]
+        """, rule="replicated-state") == []
+    # states_synced is bool bookkeeping, not device state
+    assert lint("""
+        def mark(updater):
+            updater.states_synced = jnp.copy(updater.states_synced)
+        """, rule="replicated-state") == []
+    # tree_map without a copy/device_put inside is fine
+    assert lint("""
+        def cast(states):
+            return jax.tree_util.tree_map(lambda x: x.astype("f4"), states)
+        """, rule="replicated-state") == []
+
+
+def test_replicated_state_blessed_homes_exempt():
+    src = """
+        def fresh_replicate(states, repl):
+            return jax.device_put(states, repl)
+    """
+    assert lint(src, rule="replicated-state",
+                relpath="mxnet_tpu/parallel.py") == []
+    assert lint(src, rule="replicated-state",
+                relpath="mxnet_tpu/fastpath/zero.py") == []
+    assert lint(src, rule="replicated-state",
+                relpath="tools/whatever.py") == []
+    assert len(lint(src, rule="replicated-state")) == 1
+
+
+def test_replicated_state_repo_gate_clean():
+    # the repo itself carries ZERO eager state placements — nothing to
+    # baseline, and the first regression is a finding
+    files = collect_files(["mxnet_tpu"], root=REPO)
+    findings = [f for f in lint_files(files, root=REPO,
+                                      passes=["replicated-state"])
+                if f.rule == "replicated-state"]
+    assert findings == []

@@ -7,5 +7,6 @@ from . import env_knob  # noqa: F401
 from . import host_sync  # noqa: F401
 from . import native_guard  # noqa: F401
 from . import perparam_jit  # noqa: F401
+from . import replicated_state  # noqa: F401
 from . import swallowed_error  # noqa: F401
 from . import tracer_leak  # noqa: F401

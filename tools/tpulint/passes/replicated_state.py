@@ -1,0 +1,132 @@
+"""replicated-state: eager full-tree copy/placement of optimizer state
+outside the blessed placement helpers.
+
+The ZeRO plane (``mxnet_tpu/fastpath/zero.py``) keeps optimizer state
+partitioned over the dp axis between steps; HBM headroom — the whole
+point of ``MXNET_ZERO`` — survives only as long as nothing quietly pulls
+that state back to a replicated (or single-device) layout. The hazard
+shape is an eager ``jnp.copy(...)`` / ``jax.device_put(...)`` over a
+state tree: it allocates a full per-device copy of every shard NOW (the
+2x-HBM init-spike class of bug ``parallel.fresh_replicate`` was built to
+kill) and, applied to a sharded tree, silently re-replicates it. All
+state placement must route through the blessed helpers —
+``parallel.fresh_replicate`` / ``parallel.put_sharded`` (layout-aware,
+alias-guarded) or the ``fastpath.zero`` plane itself.
+
+Flagged in ``mxnet_tpu/`` (the helpers' own homes ``parallel.py`` and
+``fastpath/zero.py`` are exempt):
+
+- ``jnp.copy`` / ``jax.device_put`` whose argument expression names
+  optimizer state (an identifier containing ``state``, e.g. ``states``,
+  ``opt_states``, ``updater.states[i]``);
+- a ``tree_map`` mapping a copy/device_put-containing function over a
+  state tree (the full-tree variant of the same eager placement).
+
+``states_synced`` (a bool bookkeeping dict) never matches.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..core import (FileContext, Finding, Pass, ancestors, dotted_name,
+                    register)
+
+_COPY_CALLS = {"jnp.copy", "jax.numpy.copy", "np.copy", "numpy.copy"}
+_PUT_TAILS = ("device_put",)
+_TREE_MAP_TAILS = ("tree_map", "tree_multimap")
+_STATE_RE = re.compile(r"(?<![A-Za-z0-9_])_?(?:[a-z0-9_]*_)?states?(?:_|\b)")
+_EXCLUDED = ("states_synced", "state_dict", "recordingstatescope")
+
+_BLESSED = ("mxnet_tpu/parallel.py", "mxnet_tpu/fastpath/zero.py")
+
+
+def _mentions_state(node: ast.AST) -> bool:
+    """Whether any identifier under ``node`` reads like optimizer state."""
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name is None:
+            continue
+        low = name.lower()
+        if any(x in low for x in _EXCLUDED):
+            continue
+        if _STATE_RE.search(low):
+            return True
+    return False
+
+
+def _is_copy_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func) or ""
+    return name in _COPY_CALLS or name.rsplit(".", 1)[-1] in _PUT_TAILS
+
+
+def _arg_is_state(call: ast.Call, arg: ast.AST) -> bool:
+    """The copied value names state directly, OR is a loop/comprehension
+    variable fed from a state iterable (``for s in opt_states: copy(s)``
+    — the common full-tree spread shape)."""
+    if _mentions_state(arg):
+        return True
+    names = {sub.id for sub in ast.walk(arg) if isinstance(sub, ast.Name)}
+    if not names:
+        return False
+    for anc in ancestors(call):
+        gens = list(getattr(anc, "generators", ()))
+        if isinstance(anc, (ast.For, ast.AsyncFor)):
+            gens.append(anc)
+        for g in gens:
+            tgt = {s.id for s in ast.walk(g.target)
+                   if isinstance(s, ast.Name)}
+            if tgt & names and _mentions_state(g.iter):
+                return True
+    return False
+
+
+@register
+class ReplicatedStatePass(Pass):
+    name = "replicated-state"
+    description = ("eager jnp.copy/device_put of optimizer state outside "
+                   "the blessed placement helpers (fresh_replicate/"
+                   "put_sharded/fastpath.zero) — re-replicates sharded "
+                   "state and spikes HBM")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/") and relpath not in _BLESSED
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if _is_copy_call(node):
+                if any(_arg_is_state(node, a) for a in node.args[:1]):
+                    yield ctx.finding(
+                        node, self.name,
+                        "eager `%s(...)` over optimizer state — route "
+                        "placement through parallel.fresh_replicate/"
+                        "put_sharded (layout-aware) or fastpath.zero"
+                        % name)
+                continue
+            # tree_map(fn-with-copy/device_put, states): the full-tree
+            # eager placement in one expression
+            if name.rsplit(".", 1)[-1] in _TREE_MAP_TAILS and node.args:
+                fn_arg, tree_args = node.args[0], node.args[1:]
+                if not any(_mentions_state(a) for a in tree_args):
+                    continue
+                has_copy = any(
+                    isinstance(sub, ast.Call) and _is_copy_call(sub)
+                    for sub in ast.walk(fn_arg))
+                has_copy = has_copy or (dotted_name(fn_arg) or "") \
+                    in _COPY_CALLS or (dotted_name(fn_arg) or "") \
+                    .rsplit(".", 1)[-1] in _PUT_TAILS
+                if has_copy:
+                    yield ctx.finding(
+                        node, self.name,
+                        "eager `%s(copy/device_put, states)` replicates a "
+                        "whole state tree — route placement through "
+                        "parallel.fresh_replicate/put_sharded or "
+                        "fastpath.zero" % name)
