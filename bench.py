@@ -33,6 +33,12 @@ CHAOS = os.environ.get("BENCH_CHAOS", "") not in ("", "0")
 # bytes, zero_hbm_savings_ratio and the step-time delta on the line;
 # rc != 0 if the sharded plane recompiles in steady state
 ZERO = os.environ.get("BENCH_ZERO", "") not in ("", "0")
+# BENCH_ELASTIC=1: preemption goodput — the SAME training run under
+# injected kill-at-step preemptions with checkpoint-resume vs restarted
+# from scratch, sync- vs async-checkpoint step-stall delta, and the
+# sharded-save gates (exactly-once batches, zero all-gathers); rc 6 on
+# a gate failure
+ELASTIC = os.environ.get("BENCH_ELASTIC", "") not in ("", "0")
 # p=0.2 because the fused-step protocol performs only ~a dozen accounted
 # transfers per run (one barrier fetch per timed phase): a mild rate would
 # usually inject nothing and "prove" resilience vacuously
@@ -763,7 +769,212 @@ def _zero_bench():
     return 5 if err else 0
 
 
+def _elastic_bench():
+    """BENCH_ELASTIC=1 mode: the cost of preemptions, measured.
+
+    One small training run (TrainPlane on a 2-device dp mesh, quick:
+    MLP) is executed twice under the SAME injected kill-at-step
+    schedule: once checkpoint-resuming (save_training every step, resume
+    from the last committed epoch) and once restarting from scratch
+    (the pre-elastic regime — every kill replays the whole run). The
+    line carries both goodput ratios (productive step time / wall time,
+    the ``mxnet_elastic_goodput_ratio`` gauge) and their quotient, plus
+    the sync- vs async-checkpoint step-stall delta.
+
+    Gates (rc 6): the resume run must train every batch EXACTLY once
+    (no replay, no skip — per-step batch accounting across restarts),
+    and a sharded (MXNET_ZERO=1) save must perform zero all-gathers
+    (``mxnet_zero_materializations_total`` delta) while moving shard
+    bytes through the accounted ``ckpt.shard`` transfer path."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+
+    devices = _acquire_backend()
+    import tempfile
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import elastic, gluon, nd, parallel, telemetry, trainplane
+    from mxnet_tpu.fastpath import zero
+    from mxnet_tpu.resilience import chaos
+
+    B = 8
+    steps = 24 if QUICK else 96
+    hidden = 64 if QUICK else 512
+    rng = np.random.RandomState(0)
+    X = rng.rand(steps * B, 16).astype(np.float32)
+    Y = rng.randint(0, 8, (steps * B,)).astype(np.float32)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    # both kill indices must be REACHABLE in the resume run, whose total
+    # boundary-call count is only steps + replay (the from-scratch run
+    # makes strictly more calls): kill 1 at steps/3, kill 2 half a run
+    # later — well inside steps + (steps/3 - 1) replayed calls
+    kills = "site=elastic.step,at=%d:%d,action=kill" % (
+        steps // 3, steps // 3 + steps // 2)
+
+    def make():
+        mx.random.seed(7)
+        net = gluon.nn.HybridSequential(prefix="el_")
+        with net.name_scope():
+            net.add(gluon.nn.Dense(hidden, activation="relu"),
+                    gluon.nn.Dense(8))
+        net.initialize()
+        with mx.autograd.pause():
+            net(nd.ones((B, 16)))
+        net.hybridize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9})
+        plane = trainplane.TrainPlane(net, loss_fn, tr,
+                                      mesh=parallel.device_mesh(
+                                          min(2, len(devices))))
+        return net, tr, plane
+
+    def run(resume):
+        """One supervised run to `steps` steps under the kill schedule;
+        returns (goodput, wall_s, consumed step ids across attempts)."""
+        workdir = tempfile.mkdtemp(prefix="bench-elastic-")
+        cm = elastic.CheckpointManager(workdir)
+        consumed = []
+
+        def train_fn(start, manager):
+            net, tr, plane = make()
+            it = mx.io.NDArrayIter(X, Y, batch_size=B)
+            last = manager.restore_training(net=net, trainer=tr,
+                                            train_iter=it) if resume else -1
+            for step in range(last + 1, steps):
+                elastic.step_boundary(manager=manager)
+                batch = it.next()
+                consumed.append(step)
+                plane.step(batch.data[0], batch.label[0])
+                if resume:
+                    manager.save_training(step, net=net, trainer=tr,
+                                          train_iter=it, async_save=True)
+            manager.wait()
+            return "done"
+
+        t0 = time.perf_counter()
+        with chaos.active(kills):
+            elastic.run_elastic(train_fn, cm, max_restarts=4,
+                                restart_delay=0)
+        wall = time.perf_counter() - t0
+        return float(telemetry.ELASTIC_GOODPUT.value()), wall, consumed
+
+    out_extra = {}
+    err = None
+    try:
+        resume_goodput, resume_wall, resume_consumed = run(resume=True)
+        scratch_goodput, scratch_wall, scratch_consumed = run(resume=False)
+        out_extra.update({
+            "steps": steps,
+            "resume_goodput": round(resume_goodput, 4),
+            "from_scratch_goodput": round(scratch_goodput, 4),
+            "resume_wall_s": round(resume_wall, 3),
+            "from_scratch_wall_s": round(scratch_wall, 3),
+            "from_scratch_replayed_steps":
+                len(scratch_consumed) - steps,
+        })
+        # GATE: with a checkpoint every step, resume must neither replay
+        # nor skip a batch — each global step trained exactly once
+        if sorted(resume_consumed) != list(range(steps)):
+            dup = len(resume_consumed) - len(set(resume_consumed))
+            err = ("resume run replayed/skipped batches (%d trained, %d "
+                   "duplicated) — the iterator/RNG cursor did not round-"
+                   "trip" % (len(resume_consumed), dup))
+
+        # sync- vs async-checkpoint step stall: time (save + next step)
+        net, tr, plane = make()
+        it = mx.io.NDArrayIter(X, Y, batch_size=B)
+        cm2 = elastic.CheckpointManager(tempfile.mkdtemp(
+            prefix="bench-elastic-stall-"))
+
+        def one(i):
+            b = it.next()
+            plane.step(b.data[0], b.label[0])
+
+        for i in range(3):
+            one(i)  # warm/compile
+
+        def stall(async_flag, epoch):
+            t0 = time.perf_counter()
+            cm2.save_training(epoch, net=net, trainer=tr, train_iter=it,
+                              async_save=async_flag)
+            one(epoch)
+            return (time.perf_counter() - t0) * 1e3
+
+        sync_ms = stall(False, 100)
+        async_ms = stall(True, 101)
+        cm2.wait()
+        out_extra["sync_save_step_ms"] = round(sync_ms, 3)
+        out_extra["async_save_step_ms"] = round(async_ms, 3)
+        out_extra["async_stall_saving_ms"] = round(sync_ms - async_ms, 3)
+
+        # GATE: a ZeRO-sharded save must not all-gather (materialize)
+        if len(devices) >= 2:
+            os.environ["MXNET_ZERO"] = "1"
+            os.environ["MXNET_ZERO_DEVICES"] = "2"
+            try:
+                net, tr, plane = make()
+                it = mx.io.NDArrayIter(X, Y, batch_size=B)
+                for i in range(2):
+                    one(i)
+                if zero.plane_of(tr._updaters[0]) is not None:
+                    m0 = zero.MATERIALIZATIONS.value()
+                    b0 = telemetry.TRANSFER_BYTES.value(path="ckpt.shard")
+                    cm3 = elastic.CheckpointManager(tempfile.mkdtemp(
+                        prefix="bench-elastic-shard-"))
+                    cm3.save_training(0, net=net, trainer=tr)
+                    gathers = zero.MATERIALIZATIONS.value() - m0
+                    shard_bytes = telemetry.TRANSFER_BYTES.value(
+                        path="ckpt.shard") - b0
+                    out_extra["sharded_save_allgathers"] = int(gathers)
+                    out_extra["sharded_save_bytes"] = int(shard_bytes)
+                    if gathers:
+                        err = err or (
+                            "sharded save materialized (all-gathered) the "
+                            "state %d time(s) — gate: 0" % int(gathers))
+                    elif not shard_bytes:
+                        err = err or ("sharded save moved no bytes through "
+                                      "the ckpt.shard transfer path")
+                else:
+                    out_extra["sharded_save_allgathers"] = None
+            finally:
+                os.environ.pop("MXNET_ZERO", None)
+                os.environ.pop("MXNET_ZERO_DEVICES", None)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as e:  # noqa: BLE001 - report, don't vanish
+        import traceback
+        traceback.print_exc()
+        sys.stderr.flush()
+        err = "exception during BENCH_ELASTIC: %r" % (e,)
+
+    goodput = out_extra.get("resume_goodput")
+    scratch = out_extra.get("from_scratch_goodput")
+    out = {
+        "metric": "elastic goodput ratio under kill-at-step preemptions "
+                  "(checkpoint-resume, %d steps, 2 kills)" % steps,
+        "value": goodput,
+        "unit": "ratio",
+        "vs_baseline": (round(goodput / scratch, 4)
+                        if goodput and scratch else None),
+        "extra": dict(out_extra,
+                      device=str(devices[0]),
+                      baseline="same run + kill schedule restarted from "
+                               "scratch (no checkpoint resume)"),
+    }
+    if err:
+        out["error"] = err
+    print(json.dumps(_attach_telemetry(out)))
+    sys.stdout.flush()
+    return 6 if err else 0
+
+
 def main():
+    if ELASTIC:
+        return _elastic_bench()
     if ZERO:
         return _zero_bench()
     if DECODE:

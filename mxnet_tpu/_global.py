@@ -29,6 +29,8 @@ __all__ = [
     "push_rng_key",
     "pop_rng_key",
     "current_rng_key",
+    "rng_snapshot",
+    "restore_rng_snapshot",
 ]
 
 
@@ -109,3 +111,24 @@ def pop_rng_key():
 
 def current_rng_key():
     return _STATE.key_stack[-1] if _STATE.key_stack else _base_key()
+
+
+def rng_snapshot() -> np.ndarray:
+    """The base key's raw data as a host array — the picklable stream
+    cursor elastic checkpoints carry. Taken at a step boundary (empty key
+    stack): restoring it makes every subsequent :func:`next_key` draw
+    identical to an uninterrupted run's."""
+    k = _base_key()
+    try:
+        return np.asarray(k)
+    except TypeError:  # pragma: no cover - typed (new-style) PRNG keys
+        return np.asarray(jax.random.key_data(k))
+
+
+def restore_rng_snapshot(data) -> None:
+    """Install a :func:`rng_snapshot` as the live base key (clearing any
+    traced-key stack — snapshots are only taken/restored between steps)."""
+    import jax.numpy as jnp
+
+    _STATE.base_key = jnp.asarray(np.asarray(data))
+    _STATE.key_stack = []

@@ -60,7 +60,8 @@ from . import bucketing
 __all__ = ["level", "ZeroPlane", "ShardedState", "eligible_reason",
            "note_fallback", "plane_of", "materialize_updater",
            "ensure_materialized", "acquire_plane", "apply",
-           "state_bytes_on", "is_sharded", "FALLBACKS"]
+           "state_bytes_on", "is_sharded", "states_from_export",
+           "FALLBACKS", "MATERIALIZATIONS"]
 
 #: why a sharded update was declined, by coarse reason — the operator's
 #: record that MXNET_ZERO quietly stayed on the replicated path
@@ -68,6 +69,17 @@ FALLBACKS = telemetry.counter(
     "mxnet_zero_fallbacks_total",
     "ZeRO sharded-state updates declined, by reason",
     labels=("reason",))
+
+#: every all-gather of the sharded state back to the plain layout. The
+#: sharded checkpoint path (``elastic.CheckpointManager.save_training``)
+#: promises NOT to move this counter — the bench and tests assert a zero
+#: delta across a sharded save, which is how "the save performed no
+#: all-gather" is checked rather than assumed.
+MATERIALIZATIONS = telemetry.counter(
+    "mxnet_zero_materializations_total",
+    "sharded state buckets all-gathered back to the plain per-parameter "
+    "layout (checkpoint via the materialized path, eager interleave, "
+    "layout change)")
 
 
 def level() -> int:
@@ -307,6 +319,7 @@ class ZeroPlane(object):
         from .. import parallel
 
         assert self.buckets is not None
+        MATERIALIZATIONS.inc()
         out: List[Any] = [None] * len(self.plan.sig)
         repl = self._repl()
         for b, positions in enumerate(self.plan.buckets):
@@ -334,6 +347,67 @@ class ZeroPlane(object):
     def state_handles(self) -> List[ShardedState]:
         return [ShardedState(self, pos)
                 for pos in range(len(self.plan.sig))]
+
+    # -- sharded checkpoint I/O ----------------------------------------
+    def shard_export(self):
+        """Host copies of the persistent sharded state, one dict per dp
+        rank, WITHOUT materializing: each dp-partitioned bucket leaf is
+        read shard-by-shard (``addressable_shards`` — a 1/dp device→host
+        copy per rank, no cross-device collective), replicated slots
+        (the level-1 fp32 masters) once. Returns ``(meta, shards, repl)``:
+
+        * ``meta`` — the topology the restore needs to re-bucket onto ANY
+          dp size: plan signature/buckets/padding, indices, level, state
+          treedef templates (integer-leaf pytrees whose
+          ``tree_structure`` IS the treedef — pickle-stable where raw
+          treedefs are not), and which slots are replicated;
+        * ``shards[r]`` — ``"b{bucket}.s{slot}" -> np.ndarray`` of rank
+          ``r``'s contiguous piece of each sharded flat slot;
+        * ``repl`` — the same keying for replicated slots.
+
+        The device→host bytes are accounted under transfer path
+        ``ckpt.shard``; :data:`MATERIALIZATIONS` does not move.
+        """
+        assert self.buckets is not None
+        templates = [
+            jax.tree_util.tree_unflatten(td, list(range(td.num_leaves)))
+            for td in self._treedefs]
+        shards: List[Dict[str, np.ndarray]] = [dict()
+                                               for _ in range(self.dp)]
+        repl: Dict[str, np.ndarray] = {}
+        repl_slots = []
+        moved = []
+        for b, bucket in enumerate(self.buckets):
+            leaves = jax.tree_util.tree_leaves(bucket)
+            for j, leaf in enumerate(leaves):
+                key = "b%d.s%d" % (b, j)
+                sharding = getattr(leaf, "sharding", None)
+                if sharding is None or sharding.is_fully_replicated:
+                    repl[key] = np.asarray(leaf)
+                    repl_slots.append(key)
+                    moved.append(repl[key])
+                    continue
+                shard_len = leaf.shape[0] // self.dp
+                for s in leaf.addressable_shards:
+                    r = int(s.index[0].start or 0) // shard_len
+                    piece = np.asarray(s.data)
+                    shards[r][key] = piece
+                    moved.append(piece)
+        telemetry.record_transfer("ckpt.shard", moved)
+        meta = {
+            "dp": self.dp,
+            "level": self.level,
+            "indices": list(self.indices),
+            "mp_flags": list(self.mp_flags),
+            "sig": self.plan.sig,
+            "buckets": [tuple(b) for b in self.plan.buckets],
+            "pad_to": self.plan.pad_to,
+            "templates": templates,
+            "repl_slots": repl_slots,
+            "mesh_shape": {a: int(self.mesh.shape[a])
+                           for a in self.mesh.axis_names},
+        }
+        return meta, shards, repl
 
     # -- the shard-local update ----------------------------------------
     def _expand(self, b: int, vals, pad_value: float):
@@ -685,6 +759,43 @@ def apply(updater, triples, positions: int = 1) -> bool:
         materialize_updater(updater)
         return False
     return True
+
+
+def states_from_export(meta, slot_arrays) -> List[Any]:
+    """Rebuild plain per-parameter state trees from a
+    :meth:`ZeroPlane.shard_export` — the restore half of the sharded
+    checkpoint. ``slot_arrays`` maps ``"b{b}.s{j}"`` to the FULL flat
+    slot (the per-rank pieces concatenated in rank order; padding tail
+    included and never read). Re-bucketing is the same static layout
+    walk ``bucketing.Plan`` packs with, so the round trip is exact and
+    independent of the dp size the checkpoint was written at — the next
+    sharded step re-packs onto whatever mesh is live via ``flat_plan``.
+
+    Returns state trees in plan-position order (parallel to
+    ``meta["indices"]``)."""
+    sig = tuple((tuple(s), str(d)) for s, d in meta["sig"])
+    plan = bucketing.Plan(sig, [tuple(b) for b in meta["buckets"]], [],
+                          pad_to=int(meta["pad_to"]))
+    out: List[Any] = [None] * len(sig)
+    for b, positions in enumerate(plan.buckets):
+        sizes, padded = plan.bucket_layout(b)
+        treedef = jax.tree_util.tree_structure(meta["templates"][b])
+        slots = []
+        for j in range(treedef.num_leaves):
+            flat = np.asarray(slot_arrays["b%d.s%d" % (b, j)]).reshape(-1)
+            if flat.shape[0] < sum(sizes):
+                raise ValueError(
+                    "sharded checkpoint slot b%d.s%d is short: %d < %d"
+                    % (b, j, flat.shape[0], sum(sizes)))
+            slots.append(flat)
+        off = 0
+        for pos, size in zip(positions, sizes):
+            shape = sig[pos][0]
+            leaves = [jnp.asarray(s[off:off + size].reshape(shape))
+                      for s in slots]
+            out[pos] = jax.tree_util.tree_unflatten(treedef, leaves)
+            off += size
+    return out
 
 
 def state_bytes_on(device, updater) -> int:

@@ -97,7 +97,15 @@ class DataBatch(object):
 
 
 class DataIter(object):
-    """Base iterator (reference io.py:182)."""
+    """Base iterator (reference io.py:182).
+
+    Iterators that support deterministic elastic resume implement the
+    cursor protocol: ``state_dict()`` returns a small picklable dict and
+    ``set_state(state)`` repositions the stream so the NEXT batch
+    delivered is exactly the one an uninterrupted run would have seen.
+    ``elastic.CheckpointManager.save_training`` captures it per
+    checkpoint; iterators without the protocol resume from the epoch
+    start (replaying data — the pre-v2 behavior)."""
 
     def __init__(self, batch_size=0):
         self.batch_size = batch_size
@@ -242,6 +250,16 @@ class NDArrayIter(DataIter):
             return self.cursor + self.batch_size - self.num_data
         return 0
 
+    def state_dict(self):
+        """Resume cursor. The shuffle permutation (applied once at
+        construction from the seeded ``mx.random`` host stream) is NOT
+        part of the state: a resumed run reconstructs the iterator under
+        the same seed and gets the same order."""
+        return {"cursor": int(self.cursor)}
+
+    def set_state(self, state):
+        self.cursor = int(state["cursor"])
+
 
 class ResizeIter(DataIter):
     """Resize an iterator to a fixed number of batches (reference
@@ -317,6 +335,7 @@ class PrefetchingIter(DataIter):
         self.next_batch = [None for _ in range(self.n_iter)]
         self._errors = [None for _ in range(self.n_iter)]
         self._failed = False
+        self._delivered = 0  # batches handed to the CONSUMER this pass
 
         def fetch_one(i):
             def attempt():
@@ -381,6 +400,7 @@ class PrefetchingIter(DataIter):
             i.reset()
         self._errors = [None for _ in range(self.n_iter)]
         self._failed = False
+        self._delivered = 0
         for e in self.data_ready:
             e.clear()
         for e in self.data_taken:
@@ -417,6 +437,7 @@ class PrefetchingIter(DataIter):
             e.clear()
         for e in self.data_taken:
             e.set()
+        self._delivered += 1
         return True
 
     def getdata(self):
@@ -430,6 +451,23 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+    def state_dict(self):
+        """Resume cursor: batches DELIVERED to the consumer (the workers'
+        own read-ahead is deliberately not part of the state — an
+        in-flight prefetched batch was never trained on)."""
+        return {"delivered": int(self._delivered)}
+
+    def set_state(self, state):
+        """Reposition by reset + host-side replay: the worker protocol
+        starts fetching the moment the base iterators reset, so skipping
+        at the base level would race it; consuming ``delivered`` batches
+        through the normal path is the interleaving-safe equivalent and
+        costs only host batch assembly (no training, no device work)."""
+        self.reset()
+        delivered = int(state.get("delivered", 0))  # host cursor, no device value
+        for _ in range(delivered):
+            self.next()
 
 
 class CSVIter(NDArrayIter):
@@ -727,6 +765,8 @@ class DevicePrefetchIter(DataIter):
         self._sentinel = object()
         self._thread = None
         self._done = False
+        self._delivered = 0  # batches handed to the consumer this pass
+        self._skip = 0       # host-side fast-forward for set_state resume
         self._start()
 
     @property
@@ -786,6 +826,14 @@ class DevicePrefetchIter(DataIter):
         def worker():
             it = iter(self.base)
             try:
+                # elastic resume: fast-forward the base stream host-side
+                # (no staging, no device transfer) to the restored cursor
+                skip, self._skip = self._skip, 0
+                for _ in range(skip):
+                    try:
+                        fetch(it)
+                    except StopIteration:
+                        break
                 while True:
                     try:
                         batch = fetch(it)
@@ -802,8 +850,9 @@ class DevicePrefetchIter(DataIter):
                                          name="mxtpu-device-infeed")
         self._thread.start()
 
-    def reset(self):
-        # drain the in-flight queue, then restart on a fresh pass
+    def _drain(self):
+        """Join the in-flight worker by draining its queue (it exits after
+        the sentinel/error once nothing blocks its puts)."""
         while self._thread is not None and self._thread.is_alive():
             try:
                 self._queue.get(timeout=0.1)
@@ -811,8 +860,34 @@ class DevicePrefetchIter(DataIter):
                 continue
         while not self._queue.empty():
             self._queue.get_nowait()
+
+    def reset(self):
+        # drain the in-flight queue, then restart on a fresh pass
+        self._drain()
         self.base.reset()
         self._done = False
+        self._delivered = 0
+        self._skip = 0
+        self._start()
+
+    def state_dict(self):
+        """Resume cursor: batches DELIVERED to the consumer; the worker's
+        staged read-ahead (and its device copies) is not state — those
+        batches were never trained on."""
+        return {"delivered": int(self._delivered)}
+
+    def set_state(self, state):
+        """Reposition: restart the base stream and hand the worker a
+        host-side skip count — the skipped batches are fetched but never
+        staged, so resume costs no device transfers for data already
+        consumed before the checkpoint. Exact when the base stream is
+        deterministic (same seed/order), which elastic resume guarantees
+        by restoring the RNG snapshot first."""
+        self._drain()
+        self.base.reset()
+        self._done = False
+        self._delivered = int(state.get("delivered", 0))
+        self._skip = self._delivered
         self._start()
 
     def next(self):
@@ -827,6 +902,7 @@ class DevicePrefetchIter(DataIter):
         if isinstance(item, Exception):
             self._done = True
             raise item
+        self._delivered += 1
         return item
 
     def iter_next(self):

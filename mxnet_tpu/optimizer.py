@@ -929,6 +929,27 @@ class Updater(object):
         }
         self.states_synced = dict.fromkeys(self.states.keys(), False)
 
+    def adopt_states(self, states: Dict, optimizer=None):
+        """Install plain per-index ``states`` directly (no pickle round
+        trip) — the sharded-checkpoint restore path: ``elastic`` rebuilds
+        per-parameter trees from shard files and hands them here. Any
+        attached ZeRO plane is dropped (its handles would alias a layout
+        that no longer owns the state; the next sharded step re-adopts
+        onto the live mesh), numpy leaves rehydrate to jax arrays, and
+        ``optimizer`` — when given — replaces the owned optimizer so the
+        restored step counters (``num_update``, per-index counts) become
+        the live ones."""
+        self._zero_plane = None
+        if optimizer is not None:
+            self.optimizer = optimizer
+        self.states = {
+            k: jax.tree_util.tree_map(
+                lambda a: jnp.asarray(a) if isinstance(a, np.ndarray) else a,
+                v)
+            for k, v in states.items()
+        }
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
     def get_states(self, dump_optimizer=False):
         """Serialize states (optionally with the optimizer) to bytes.
         Sharded (ZeRO) states are materialized back to the plain

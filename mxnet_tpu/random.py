@@ -34,3 +34,23 @@ def seed(seed_state, ctx="all"):
     _global.seed(seed_state)
     np.random.seed(seed_state % (2**32))
     _NP_RNG.seed(seed_state % (2**32))
+
+
+def get_state():
+    """Snapshot every RNG stream a training run draws from — the device
+    key stream, the host setup stream (:func:`np_rng`) and the global
+    numpy stream — as one picklable dict. Elastic checkpoints
+    (``elastic.CheckpointManager.save_training``) carry it so a
+    killed-and-resumed run replays randomness bit-identically."""
+    return {
+        "device_key": _global.rng_snapshot(),
+        "np_rng": _NP_RNG.get_state(),
+        "np_global": np.random.get_state(),
+    }
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot (inverse, between steps)."""
+    _global.restore_rng_snapshot(state["device_key"])
+    _NP_RNG.set_state(state["np_rng"])
+    np.random.set_state(state["np_global"])

@@ -40,13 +40,15 @@ from . import breaker as breaker_mod
 from . import chaos
 from . import policies
 from .breaker import CircuitBreaker, CircuitOpenError, breaker
-from .chaos import FaultInjected, maybe_fail
+from .chaos import (ChaosAction, DropShard, FaultInjected, Killed,
+                    TornWrite, maybe_fail)
 from .policies import DEFAULT_RETRY_ON, Deadline, RetryPolicy, TransientError
 
 __all__ = [
     "RetryPolicy", "Deadline", "TransientError", "DEFAULT_RETRY_ON",
     "CircuitBreaker", "CircuitOpenError", "breaker",
-    "chaos", "FaultInjected", "maybe_fail",
+    "chaos", "FaultInjected", "ChaosAction", "Killed", "TornWrite",
+    "DropShard", "maybe_fail",
     "call", "default_policy", "reset_default_policy", "snapshot",
 ]
 

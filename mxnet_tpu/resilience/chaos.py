@@ -27,6 +27,22 @@ at        colon-separated 1-based call indices that *always* fault
           (per rule, per site), e.g. ``at=2:5`` — the deterministic
           schedule for "the 3rd push fails" tests
 max       cap on total faults injected by the rule (default unlimited)
+action    what an injection does (default ``fault``):
+
+          * ``fault`` — raise :class:`FaultInjected` (a transient error,
+            exercised by the retry/breaker machinery);
+          * ``kill`` — raise :class:`Killed`: an abrupt process death at
+            that call (kill-at-step preemption). NOT transient — nothing
+            retries it; it unwinds to the elastic supervisor
+            (``elastic.run_elastic``), which restarts from the last
+            committed checkpoint;
+          * ``torn-write`` — raise :class:`TornWrite`: the elastic shard
+            writer catches it and commits deliberately truncated bytes
+            (a silently torn write — bitrot, a filesystem that lied
+            about fsync), proving restore's content-hash fallback;
+          * ``drop-shard`` — raise :class:`DropShard`: the shard writer
+            skips that shard's file entirely (post-commit loss), proving
+            the missing-file fallback.
 ========  ==================================================================
 
 Determinism contract: each (rule, site) pair draws from its own
@@ -56,7 +72,8 @@ from typing import Dict, List, Optional, Tuple
 from ..base import MXNetError, get_env
 from .policies import TransientError
 
-__all__ = ["FaultInjected", "maybe_fail", "configure", "disable", "active",
+__all__ = ["FaultInjected", "ChaosAction", "Killed", "TornWrite",
+           "DropShard", "maybe_fail", "configure", "disable", "active",
            "parse_spec", "injected_counts", "summary", "ENABLED"]
 
 
@@ -68,6 +85,44 @@ class FaultInjected(TransientError):
                          % (site, call_index))
         self.site = site
         self.call_index = call_index
+
+
+class ChaosAction(MXNetError):
+    """Base of the non-``fault`` schedule actions. Deliberately NOT a
+    :class:`TransientError`: a simulated process kill or torn write must
+    reach the layer that owns that failure mode (the elastic supervisor,
+    the shard writer) — a retry policy "recovering" it would fake the
+    very resilience the schedule exists to prove."""
+
+    action = "action"
+
+    def __init__(self, site: str, call_index: int):
+        super().__init__("chaos: injected %s at %s (call #%d)"
+                         % (self.action, site, call_index))
+        self.site = site
+        self.call_index = call_index
+
+
+class Killed(ChaosAction):
+    """Simulated abrupt process death (``action=kill`` — kill-at-step)."""
+
+    action = "kill"
+
+
+class TornWrite(ChaosAction):
+    """Simulated silently-torn file write (``action=torn-write``)."""
+
+    action = "torn-write"
+
+
+class DropShard(ChaosAction):
+    """Simulated post-commit loss of one shard file (``action=drop-shard``)."""
+
+    action = "drop-shard"
+
+
+_ACTIONS = {"fault": None, "kill": Killed, "torn-write": TornWrite,
+            "torn": TornWrite, "drop-shard": DropShard, "drop": DropShard}
 
 
 #: THE disabled-path switch: ``maybe_fail`` reads this module global and
@@ -92,15 +147,17 @@ def _faults_counter():
 
 
 class _Rule:
-    __slots__ = ("pattern", "p", "at", "max_faults", "injected")
+    __slots__ = ("pattern", "p", "at", "max_faults", "injected", "action")
 
     def __init__(self, pattern: str = "*", p: float = 0.0,
-                 at: Tuple[int, ...] = (), max_faults: Optional[int] = None):
+                 at: Tuple[int, ...] = (), max_faults: Optional[int] = None,
+                 action: str = "fault"):
         self.pattern = pattern
         self.p = p
         self.at = frozenset(at)
         self.max_faults = max_faults
         self.injected = 0
+        self.action = action
 
 
 def parse_spec(spec: str) -> Tuple[int, List[_Rule]]:
@@ -136,6 +193,12 @@ def parse_spec(spec: str) -> Tuple[int, List[_Rule]]:
                         raise ValueError(val)
                 elif key == "max":
                     rule.max_faults = int(val)
+                elif key == "action":
+                    if val not in _ACTIONS:
+                        raise MXNetError(
+                            "chaos spec: unknown action %r (choose from %s)"
+                            % (val, "/".join(sorted(set(_ACTIONS)))))
+                    rule.action = val
                 else:
                     raise MXNetError("chaos spec: unknown key %r in %r"
                                      % (key, tok))
@@ -182,6 +245,9 @@ class _ChaosState:
                     rule.injected += 1
                     self._injected[site] = self._injected.get(site, 0) + 1
                     _faults_counter().inc(site=site)
+                    exc_cls = _ACTIONS.get(rule.action)
+                    if exc_cls is not None:
+                        raise exc_cls(site, n)
                     raise FaultInjected(site, n)
 
     def injected_counts(self) -> Dict[str, int]:

@@ -35,9 +35,12 @@ and the metric naming scheme.
 from __future__ import annotations
 
 from . import accounting, exporters, registry, spans
-from .accounting import (COMPILE_CACHE_HITS, COMPILE_CACHE_MISSES,
-                         COMPILE_SECONDS, HBM_BYTES_IN_USE, HBM_BYTES_PEAK,
-                         OPT_DISPATCHES, PROFILER_COUNTER,
+from .accounting import (CKPT_BYTES, CKPT_CORRUPTION, CKPT_RESTORE_MS,
+                         CKPT_SAVE_MS, COMPILE_CACHE_HITS,
+                         COMPILE_CACHE_MISSES,
+                         COMPILE_SECONDS, ELASTIC_GOODPUT, ELASTIC_RESTARTS,
+                         HBM_BYTES_IN_USE, HBM_BYTES_PEAK,
+                         OPT_DISPATCHES, PREEMPTIONS, PROFILER_COUNTER,
                          RECOMPILES, STEADY_STATE_RECOMPILES, STEP_DISPATCHES,
                          TRANSFER_BYTES,
                          TRANSFERS, jit_cache_size, jit_call, note_recompile,
@@ -60,6 +63,8 @@ __all__ = [
     "HBM_BYTES_IN_USE", "HBM_BYTES_PEAK",
     "OPT_DISPATCHES", "STEP_DISPATCHES",
     "COMPILE_CACHE_HITS", "COMPILE_CACHE_MISSES",
+    "CKPT_SAVE_MS", "CKPT_RESTORE_MS", "CKPT_BYTES",
+    "PREEMPTIONS", "CKPT_CORRUPTION", "ELASTIC_GOODPUT", "ELASTIC_RESTARTS",
     "render_prometheus", "snapshot", "Emitter", "start_emitter",
     "stop_emitter",
 ]

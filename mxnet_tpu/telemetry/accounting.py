@@ -27,6 +27,9 @@ __all__ = ["RECOMPILES", "COMPILE_SECONDS", "STEADY_STATE_RECOMPILES",
            "OPT_DISPATCHES", "STEP_DISPATCHES",
            "COMPILE_CACHE_HITS", "COMPILE_CACHE_MISSES",
            "HBM_BYTES_IN_USE", "HBM_BYTES_PEAK",
+           "CKPT_SAVE_MS", "CKPT_RESTORE_MS", "CKPT_BYTES",
+           "PREEMPTIONS", "CKPT_CORRUPTION", "ELASTIC_GOODPUT",
+           "ELASTIC_RESTARTS",
            "jit_call", "jit_cache_size", "note_recompile",
            "record_transfer", "sample_hbm",
            "set_steady_state_recompiles"]
@@ -95,6 +98,54 @@ HBM_BYTES_PEAK = _registry.gauge(
     "peak device memory allocated since process start, per device "
     "(sample_hbm; absent where the backend has no stats)",
     labels=("device",))
+
+# -- elastic/checkpoint accounting (published by mxnet_tpu.elastic) --------
+# A preemptible fleet is managed by exactly these numbers: how long saves
+# stall or overlap steps, how many bytes the checkpoint plane moves, how
+# often preemptions fire, whether restores ever hit corrupt shards, and
+# what fraction of wall time across restarts was productive training.
+
+CKPT_SAVE_MS = _registry.histogram(
+    "mxnet_ckpt_save_duration_ms",
+    "wall duration of one training checkpoint save; mode=sync covers the "
+    "whole commit, mode=async only the caller-visible snapshot (writes "
+    "overlap subsequent steps)",
+    labels=("mode",))
+
+CKPT_RESTORE_MS = _registry.histogram(
+    "mxnet_ckpt_restore_duration_ms",
+    "wall duration of one training checkpoint restore (params + state + "
+    "iterator/rng), including any corruption-fallback walk")
+
+CKPT_BYTES = _registry.counter(
+    "mxnet_ckpt_bytes_total",
+    "bytes committed to checkpoint storage by kind: params, states "
+    "(materialized optimizer state), shard (per-dp-rank ZeRO state), "
+    "repl (replicated slots of a sharded save), meta, train (iterator/"
+    "rng cursors), manifest",
+    labels=("kind",))
+
+PREEMPTIONS = _registry.counter(
+    "mxnet_preemptions_total",
+    "preemption notices honored (SIGTERM / MXNET_PREEMPTION_FILE): a "
+    "best-effort checkpoint-now followed by a clean Preempted exit")
+
+CKPT_CORRUPTION = _registry.counter(
+    "mxnet_ckpt_corruption_total",
+    "committed checkpoints rejected at restore (missing shard/param file "
+    "or content-hash mismatch) — each one fell back to an older epoch")
+
+ELASTIC_GOODPUT = _registry.gauge(
+    "mxnet_elastic_goodput_ratio",
+    "productive train time over wall time across an elastic run's "
+    "restarts (attempts that advanced the committed epoch count as "
+    "productive; crash-and-replay time does not)")
+
+ELASTIC_RESTARTS = _registry.counter(
+    "mxnet_elastic_restarts_total",
+    "run_elastic restarts by reason (exception = train_fn raised, "
+    "stall = no step progress within MXNET_ELASTIC_STALL_SECS)",
+    labels=("reason",))
 
 PROFILER_COUNTER = _registry.gauge(
     "mxnet_profiler_counter",

@@ -689,7 +689,8 @@ def _global_key():
 
 
 def fit(net, loss_fn, trainer, train_data, epochs=1, batch_axis=0,
-        mesh=None, batch_end_callback=None):
+        mesh=None, batch_end_callback=None, checkpoint=None,
+        checkpoint_every=1, resume=True):
     """Train ``net`` over ``train_data`` through the active plane.
 
     ``train_data`` yields ``io.DataBatch``es (any ``DataIter``) or
@@ -698,6 +699,16 @@ def fit(net, loss_fn, trainer, train_data, epochs=1, batch_axis=0,
     ``DevicePrefetchIter`` laid out over the mesh's ``dp`` axis, so the
     step never pays a dispatch-serializing ``device_put``. Returns the
     :class:`TrainPlane` (inspect ``plane.plane`` for which path ran).
+
+    ``checkpoint`` (an ``elastic.CheckpointManager``) makes the loop
+    preemption-aware: it resumes net/trainer/iterator/RNG from the
+    latest committed epoch (``resume=True`` — mid-epoch preemption saves
+    resume mid-epoch, replaying nothing), calls
+    ``elastic.step_boundary`` before every batch (the stall heartbeat,
+    the kill-at-step chaos site, and the SIGTERM/preemption-file
+    checkpoint-now), and commits an async sharded-aware checkpoint every
+    ``checkpoint_every`` epochs. Wrap the whole call in
+    ``elastic.run_elastic`` for supervised restarts.
     """
     from . import io as io_mod
 
@@ -713,11 +724,43 @@ def fit(net, loss_fn, trainer, train_data, epochs=1, batch_axis=0,
             plane._mesh = _default_mesh(int(bs))
         feed = io_mod.DevicePrefetchIter(
             train_data, sharding=plane.feed_sharding)
-    for epoch in range(epochs):
-        if epoch and hasattr(feed, "reset"):
+
+    start, mid = 0, False
+    if checkpoint is not None and resume:
+        from . import elastic
+
+        restored = checkpoint.restore_training(net=net, trainer=trainer,
+                                               train_iter=feed)
+        if restored >= 0:
+            extra = checkpoint.last_restored_extra or {}
+            mid = bool(extra.get("mid_epoch"))
+            start = restored if mid else restored + 1
+
+    first_pass = True
+    for epoch in range(start, epochs):
+        # reset before every epoch except the very first pass when the
+        # iterator is fresh — or carries a restored mid-epoch cursor
+        if hasattr(feed, "reset") and (not first_pass
+                                       or (epoch and not mid)):
             feed.reset()
+        first_pass = False
         nbatch = 0
-        for batch in feed:
+        feed_iter = iter(feed)
+        while True:
+            if checkpoint is not None:
+                from . import elastic
+
+                # BEFORE the fetch: a preemption save here records an
+                # iterator cursor where every consumed batch was trained
+                elastic.step_boundary(
+                    manager=checkpoint,
+                    save_fn=lambda: checkpoint.save_training(
+                        epoch, net=net, trainer=trainer, train_iter=feed,
+                        extra={"mid_epoch": True}))
+            try:
+                batch = next(feed_iter)
+            except StopIteration:
+                break
             if isinstance(batch, io_mod.DataBatch):
                 data, label = batch.data[0], batch.label[0]
             else:
@@ -726,6 +769,15 @@ def fit(net, loss_fn, trainer, train_data, epochs=1, batch_axis=0,
             nbatch += 1
             if batch_end_callback is not None:
                 batch_end_callback(epoch, nbatch, loss)
+        if checkpoint is not None and (
+                (epoch + 1) % max(1, checkpoint_every) == 0
+                or epoch == epochs - 1):
+            checkpoint.save_training(epoch, net=net, trainer=trainer,
+                                     train_iter=feed,
+                                     extra={"mid_epoch": False},
+                                     async_save=True)
+    if checkpoint is not None:
+        checkpoint.wait()
     return plane
 
 

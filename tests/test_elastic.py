@@ -188,3 +188,290 @@ def test_async_save_snapshots_trainer_state(tmp_path):
     t3.step(4)
     cm.restore(net=net3, trainer=t3)
     assert t3._updaters[0].get_states(dump_optimizer=False) == states
+
+
+# ---------------------------------------------------------------------------
+# elastic v2: retention/commit bugfixes
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _clear_preemption():
+    elastic.clear_preemption()
+    yield
+    elastic.clear_preemption()
+
+
+def test_retention_never_retires_newest_committed(tmp_path):
+    """Regression: a misconfigured (negative) retention used to retire
+    EVERY epoch including the newest committed one; GC must keep >= 1."""
+    cm = elastic.CheckpointManager(str(tmp_path), max_keep=-5)
+    for e in range(3):
+        cm.save(e, params={"w": mx.nd.full((1,), float(e))})
+    assert cm.latest_epoch() == 2
+    np.testing.assert_allclose(cm.load_params()["w"].asnumpy(), [2.0])
+
+
+def test_retention_protects_newest_committed_over_quota(tmp_path):
+    """The newest COMMITTED manifest survives GC even when a newer (but
+    uncommitted — files missing) manifest sits above it in the quota:
+    the quota would retire epoch 0, but epoch 1 lost its params file, so
+    0 is the last restorable state and must outrank the quota."""
+    cm = elastic.CheckpointManager(str(tmp_path), max_keep=0)  # GC off
+    for e in range(3):
+        cm.save(e, params={"w": mx.nd.full((1,), float(e))})
+    os.remove(cm._params_path(1))  # epochs 1 and 2 now read uncommitted
+    os.remove(cm._params_path(2))
+    cm.max_keep = 1
+    cm._retire_old()               # quota says keep only [2]
+    assert cm.latest_epoch() == 0  # but 0 is the newest committed
+    np.testing.assert_allclose(cm.load_params()["w"].asnumpy(), [0.0])
+
+
+def test_latest_epoch_skips_manifest_with_missing_files(tmp_path):
+    """Regression: a manifest whose referenced files vanished must read
+    as uncommitted — resume anchors on the previous committed epoch."""
+    cm = elastic.CheckpointManager(str(tmp_path))
+    cm.save(0, params={"w": mx.nd.ones((2,))})
+    cm.save(1, params={"w": mx.nd.zeros((2,))})
+    os.remove(cm._params_path(1))
+    assert cm.latest_epoch() == 0
+    np.testing.assert_allclose(cm.load_params()["w"].asnumpy(), [1.0, 1.0])
+
+
+def test_restart_budget_resets_on_progress(tmp_path):
+    """Regression: a long run with occasional preemptions must not be
+    killed by max_restarts accumulated across its lifetime — an attempt
+    that commits a newer epoch resets the consecutive-failure budget."""
+    cm = elastic.CheckpointManager(str(tmp_path))
+    calls = {"n": 0}
+
+    def train_fn(start_epoch, manager):
+        manager.save(start_epoch, params={"w": mx.nd.ones((1,))})
+        calls["n"] += 1
+        if calls["n"] <= 5:  # 5 failures against a budget of 2 — but each
+            raise RuntimeError("preempted %d" % calls["n"])  # made progress
+        return "done"
+
+    assert elastic.run_elastic(train_fn, cm, max_restarts=2,
+                               restart_delay=0) == "done"
+    assert calls["n"] == 6
+
+
+def test_run_elastic_still_exhausts_without_progress(tmp_path):
+    cm = elastic.CheckpointManager(str(tmp_path))
+    calls = {"n": 0}
+
+    def train_fn(start_epoch, manager):
+        calls["n"] += 1
+        raise RuntimeError("no progress")
+
+    with pytest.raises(RuntimeError, match="no progress"):
+        elastic.run_elastic(train_fn, cm, max_restarts=2, restart_delay=0)
+    assert calls["n"] == 3
+
+
+# ---------------------------------------------------------------------------
+# preemption watcher + step_boundary
+# ---------------------------------------------------------------------------
+
+def test_step_boundary_preemption_saves_then_exits(tmp_path):
+    cm = elastic.CheckpointManager(str(tmp_path))
+    saved = []
+    elastic.request_preemption()
+    with pytest.raises(elastic.Preempted):
+        elastic.step_boundary(manager=cm, save_fn=lambda: saved.append(True))
+    assert saved == [True]
+    from mxnet_tpu import telemetry
+
+    assert telemetry.PREEMPTIONS.value() >= 1
+
+
+def test_step_boundary_preemption_save_failure_is_best_effort():
+    elastic.request_preemption()
+
+    def bad_save():
+        raise RuntimeError("disk full")
+
+    with pytest.raises(elastic.Preempted):  # NOT the RuntimeError
+        elastic.step_boundary(save_fn=bad_save)
+
+
+def test_preemption_file_polled(tmp_path, monkeypatch):
+    flag = tmp_path / "evict-notice"
+    monkeypatch.setenv("MXNET_PREEMPTION_FILE", str(flag))
+    assert elastic.preempt_requested() is False
+    flag.write_text("")
+    assert elastic.preempt_requested() is True
+
+
+def test_run_elastic_preempted_does_not_consume_restart(tmp_path):
+    cm = elastic.CheckpointManager(str(tmp_path))
+    calls = {"n": 0}
+
+    def train_fn(start_epoch, manager):
+        calls["n"] += 1
+        manager.save(0, params={"w": mx.nd.ones((1,))}, async_save=True)
+        elastic.request_preemption()
+        elastic.step_boundary(manager=manager)
+
+    with pytest.raises(elastic.Preempted):
+        elastic.run_elastic(train_fn, cm, max_restarts=3, restart_delay=0)
+    assert calls["n"] == 1        # no in-process restart: clean exit
+    assert cm.latest_epoch() == 0  # the flush barrier joined the async save
+
+
+def test_stall_watchdog_restarts(tmp_path):
+    import threading
+
+    cm = elastic.CheckpointManager(str(tmp_path))
+    wedge = threading.Event()
+    attempts = []
+
+    def train_fn(start_epoch, manager):
+        attempts.append(start_epoch)
+        if len(attempts) == 1:
+            wedge.wait(10)  # hung: no step_boundary, no commit
+            return "late"
+        return "ok"
+
+    try:
+        out = elastic.run_elastic(train_fn, cm, max_restarts=2,
+                                  restart_delay=0, stall_timeout=0.3)
+    finally:
+        wedge.set()
+    assert out == "ok"
+    assert len(attempts) == 2
+    from mxnet_tpu import telemetry
+
+    assert telemetry.ELASTIC_RESTARTS.value(reason="stall") >= 1
+
+
+# ---------------------------------------------------------------------------
+# chaos schedule actions
+# ---------------------------------------------------------------------------
+
+def test_chaos_action_parse_and_kill():
+    from mxnet_tpu.resilience import chaos
+
+    with chaos.active("site=elastic.step,at=2,action=kill"):
+        elastic.step_boundary()  # call 1: clean
+        with pytest.raises(chaos.Killed):
+            elastic.step_boundary()  # call 2: the kill
+        elastic.step_boundary()  # call 3: clean again
+    with pytest.raises(Exception):
+        chaos.parse_spec("site=x,at=1,action=definitely-not-an-action")
+    # Killed is NOT transient: the retry machinery must not "recover" it
+    from mxnet_tpu.resilience import TransientError
+
+    assert not issubclass(chaos.Killed, TransientError)
+
+
+def test_kill_at_step_restarts_from_committed(tmp_path):
+    from mxnet_tpu.resilience import chaos
+
+    cm = elastic.CheckpointManager(str(tmp_path))
+    trained = []
+
+    def train_fn(start_epoch, manager):
+        for step in range(start_epoch, 5):
+            elastic.step_boundary(manager=manager)
+            trained.append(step)
+            manager.save(step, params={"w": mx.nd.full((1,), float(step))})
+        return "ok"
+
+    with chaos.active("site=elastic.step,at=3,action=kill"):
+        assert elastic.run_elastic(train_fn, cm, max_restarts=2,
+                                   restart_delay=0) == "ok"
+    # killed entering step 2; resumed from the last committed epoch (1)
+    assert trained == [0, 1, 2, 3, 4]
+    assert cm.latest_epoch() == 4
+
+
+# ---------------------------------------------------------------------------
+# iterator + RNG resume state
+# ---------------------------------------------------------------------------
+
+def _seq_iter(n=10, batch_size=2):
+    data = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    return mx.io.NDArrayIter(data, np.arange(n, dtype=np.float32),
+                             batch_size=batch_size)
+
+
+def test_ndarray_iter_state_roundtrip():
+    it = _seq_iter()
+    for _ in range(3):
+        it.next()
+    state = it.state_dict()
+    want = it.next().data[0].asnumpy()
+    it2 = _seq_iter()
+    it2.set_state(state)
+    np.testing.assert_array_equal(it2.next().data[0].asnumpy(), want)
+
+
+def test_prefetching_iter_state_roundtrip():
+    from mxnet_tpu.io import PrefetchingIter
+
+    it = PrefetchingIter(_seq_iter())
+    for _ in range(3):
+        it.next()
+    state = it.state_dict()
+    assert state == {"delivered": 3}
+    want = it.next().data[0].asnumpy()
+    it2 = PrefetchingIter(_seq_iter())
+    it2.set_state(state)
+    np.testing.assert_array_equal(it2.next().data[0].asnumpy(), want)
+
+
+def test_device_prefetch_iter_state_roundtrip():
+    from mxnet_tpu.io import DevicePrefetchIter
+
+    it = DevicePrefetchIter(_seq_iter())
+    for _ in range(3):
+        it.next()
+    state = it.state_dict()
+    want = it.next().data[0].asnumpy()
+    it2 = DevicePrefetchIter(_seq_iter())
+    it2.set_state(state)
+    np.testing.assert_array_equal(it2.next().data[0].asnumpy(), want)
+    # and the stream still ends where it should (no off-by-one)
+    seen = 1
+    try:
+        while True:
+            it2.next()
+            seen += 1
+    except StopIteration:
+        pass
+    assert seen == 10 // 2 - 3
+
+
+def test_rng_state_roundtrip():
+    from mxnet_tpu import _global
+
+    mx.random.seed(11)
+    state = mx.random.get_state()
+    k1 = np.asarray(_global.next_key())
+    h1 = mx.random.np_rng().rand(3)
+    mx.random.set_state(state)
+    np.testing.assert_array_equal(np.asarray(_global.next_key()), k1)
+    np.testing.assert_array_equal(mx.random.np_rng().rand(3), h1)
+
+
+def test_save_training_carries_iter_rng_and_extra(tmp_path):
+    cm = elastic.CheckpointManager(str(tmp_path))
+    it = _seq_iter()
+    for _ in range(2):
+        it.next()
+    mx.random.seed(13)
+    cm.save_training(0, params={"w": mx.nd.ones((1,))}, train_iter=it,
+                     extra={"mid_epoch": True, "note": "x"})
+    want = it.next().data[0].asnumpy()
+    want_key = np.asarray(__import__("mxnet_tpu")._global.next_key())
+
+    it2 = _seq_iter()
+    mx.random.seed(99)  # scrambled; restore must bring 13's stream back
+    assert cm.restore_training(train_iter=it2) == 0
+    assert cm.last_restored_extra == {"mid_epoch": True, "note": "x"}
+    np.testing.assert_array_equal(it2.next().data[0].asnumpy(), want)
+    from mxnet_tpu import _global
+
+    np.testing.assert_array_equal(np.asarray(_global.next_key()), want_key)

@@ -1,0 +1,412 @@
+"""Elastic v2 acceptance suite: sharded checkpoints and bitwise
+kill-and-resume.
+
+The PR-4 chaos discipline applied to the whole save→kill→resume cycle:
+
+* a ZeRO-partitioned updater checkpoints each dp shard DIRECTLY — zero
+  all-gathers, asserted via the ``mxnet_zero_materializations_total``
+  counter (telemetry accounting, not assumption) — and restore re-buckets
+  exactly onto a different dp size;
+* torn-write and drop-one-shard chaos against a committed epoch fall back
+  to the previous committed epoch, never a crash;
+* a kill-at-step preemption resumed through ``run_elastic`` is BITWISE
+  identical to the uninterrupted run — final params, optimizer state,
+  data cursor and step counters — for SGD and Adam at ``MXNET_ZERO=0``
+  and ``1`` on a 2-device CPU mesh through the trainplane graph path.
+
+Runs on the conftest 8-virtual-device CPU backend.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import elastic, gluon, nd, parallel, telemetry, trainplane
+from mxnet_tpu.fastpath import zero
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import chaos
+
+B = 8
+STEPS = 6
+CKPT_EVERY = 2
+
+
+@pytest.fixture(autouse=True)
+def _clear_preemption():
+    elastic.clear_preemption()
+    yield
+    elastic.clear_preemption()
+
+
+def _make(prefix, opt_name, opt_params):
+    mx.random.seed(7)
+    net = nn.HybridSequential(prefix=prefix)
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+    net.initialize()
+    with mx.autograd.pause():
+        net(nd.ones((B, 6)))
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), opt_name,
+                            dict(opt_params))
+    return net, trainer
+
+
+def _data(seed=3):
+    rs = np.random.RandomState(seed)
+    return (rs.rand(STEPS * B, 6).astype(np.float32),
+            rs.randint(0, 8, (STEPS * B,)).astype(np.float32))
+
+
+def _materialized_states(trainer):
+    upd = trainer._updaters[0]
+    zero.materialize_updater(upd)
+    return {k: [np.asarray(x) for x in jax.tree_util.tree_leaves(v)]
+            for k, v in upd.states.items()}
+
+
+def _params_of(net):
+    # key by the prefix-free tail ("dense0_weight") so runs built under
+    # different name prefixes compare parameter-for-parameter
+    return {n[n.index("dense"):]: np.asarray(p.data()._data)
+            for n, p in net.collect_params().items()}
+
+
+# ---------------------------------------------------------------------------
+# sharded checkpoints
+# ---------------------------------------------------------------------------
+
+
+def _train_sharded(tag, opt_name, opt_params, steps=3):
+    """Eager fastpath training with the ZeRO plane attached; returns the
+    live (net, trainer) with sharded updater state."""
+    X, Y = _data()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    net, trainer = _make(tag, opt_name, opt_params)
+    for s in range(steps):
+        with mx.autograd.record():
+            loss = loss_fn(net(nd.array(X[s * B:(s + 1) * B])),
+                           nd.array(Y[s * B:(s + 1) * B]))
+        loss.backward()
+        trainer.step(B)
+    return net, trainer
+
+
+def test_sharded_save_performs_zero_allgathers(tmp_path, monkeypatch):
+    """The sharded save reads per-rank device shards directly: the
+    materialization counter must not move, the state stays sharded, and
+    the per-shard files + hashed manifest land committed-last."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    monkeypatch.setenv("MXNET_ZERO", "1")
+    monkeypatch.setenv("MXNET_ZERO_DEVICES", "2")
+    net, trainer = _train_sharded("zsg_", "adam", {"learning_rate": 0.01})
+    upd = trainer._updaters[0]
+    assert zero.plane_of(upd) is not None
+
+    cm = elastic.CheckpointManager(str(tmp_path))
+    m0 = zero.MATERIALIZATIONS.value()
+    t0 = telemetry.TRANSFER_BYTES.value(path="ckpt.shard")
+    cm.save_training(0, net=net, trainer=trainer)
+    assert zero.MATERIALIZATIONS.value() == m0  # NO all-gather
+    assert telemetry.TRANSFER_BYTES.value(path="ckpt.shard") > t0
+    assert all(zero.is_sharded(s) for s in upd.states.values())
+    names = sorted(os.listdir(tmp_path))
+    assert any(".shard0-of-2" in n for n in names)
+    assert any(".shard1-of-2" in n for n in names)
+    assert any(".zmeta" in n for n in names)
+
+    import json
+
+    manifest = json.load(open(cm._manifest_path(0)))
+    assert manifest["sharded"] == {"dp": 2, "level": 1,
+                                   "mesh_shape": {"dp": 2}}
+    assert len(manifest["shards"]) == 2
+    assert all(s["sha256"] for s in manifest["shards"])
+
+
+@pytest.mark.parametrize("opt_name,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_sharded_restore_roundtrip_exact(tmp_path, monkeypatch, opt_name,
+                                         opt_params):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    monkeypatch.setenv("MXNET_ZERO", "1")
+    monkeypatch.setenv("MXNET_ZERO_DEVICES", "2")
+    net, trainer = _train_sharded("zrt%s_" % opt_name, opt_name, opt_params)
+    cm = elastic.CheckpointManager(str(tmp_path))
+    cm.save_training(0, net=net, trainer=trainer)
+
+    net2, trainer2 = _make("zrr%s_" % opt_name, opt_name, opt_params)
+    assert cm.restore_training(net=net2, trainer=trainer2) == 0
+    want_states = _materialized_states(trainer)
+    got_states = _materialized_states(trainer2)
+    assert set(want_states) == set(got_states)
+    for k in want_states:
+        for a, b in zip(want_states[k], got_states[k]):
+            np.testing.assert_array_equal(a, b, err_msg=str(k))
+    want_p, got_p = _params_of(net), _params_of(net2)
+    for k in want_p:
+        np.testing.assert_array_equal(want_p[k], got_p[k], err_msg=k)
+    assert trainer2._optimizer.num_update == trainer._optimizer.num_update
+    assert trainer2._optimizer._index_update_count == \
+        trainer._optimizer._index_update_count
+
+
+def test_sharded_save_replicated_masters_roundtrip(tmp_path, monkeypatch):
+    """bf16 weights + fp32 masters at level 1: the masters are classic-
+    ZeRO-1 replicated, land once in the .repl file, and the whole state
+    (masters + sharded base) round-trips bitwise."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MXNET_ZERO", "1")
+    monkeypatch.setenv("MXNET_ZERO_DEVICES", "2")
+    X, Y = _data()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    opt_params = {"learning_rate": 0.1, "momentum": 0.9,
+                  "multi_precision": True}
+    net, trainer = _make("zmp_", "sgd", opt_params)
+    net.cast("bfloat16")
+    for s in range(2):
+        x = mx.nd.NDArray(jnp.asarray(X[s * B:(s + 1) * B], jnp.bfloat16),
+                          mx.cpu())
+        with mx.autograd.record():
+            loss = loss_fn(net(x), nd.array(Y[s * B:(s + 1) * B]))
+        loss.backward()
+        trainer.step(B)
+    assert zero.plane_of(trainer._updaters[0]) is not None
+
+    cm = elastic.CheckpointManager(str(tmp_path))
+    cm.save_training(0, net=net, trainer=trainer)
+    assert any(n.endswith(".repl") for n in os.listdir(tmp_path))
+
+    net2, trainer2 = _make("zmq_", "sgd", opt_params)
+    net2.cast("bfloat16")
+    assert cm.restore_training(net=net2, trainer=trainer2) == 0
+    want = _materialized_states(trainer)
+    got = _materialized_states(trainer2)
+    assert set(want) == set(got)
+    for k in want:
+        for a, b in zip(want[k], got[k]):
+            np.testing.assert_array_equal(a, b, err_msg=str(k))
+
+
+def test_sharded_restore_onto_different_dp(tmp_path, monkeypatch):
+    """Save at dp=2, resume at dp=4: the flat-plan re-bucketing makes the
+    layout change invisible — materialized state is bitwise the dp=2
+    run's, and the next sharded step adopts at the new width."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    monkeypatch.setenv("MXNET_ZERO", "1")
+    monkeypatch.setenv("MXNET_ZERO_DEVICES", "2")
+    net, trainer = _train_sharded("zdp_", "adam", {"learning_rate": 0.01})
+    cm = elastic.CheckpointManager(str(tmp_path))
+    cm.save_training(0, net=net, trainer=trainer)
+
+    monkeypatch.setenv("MXNET_ZERO_DEVICES", "4")
+    net2, trainer2 = _make("zdq_", "adam", {"learning_rate": 0.01})
+    assert cm.restore_training(net=net2, trainer=trainer2) == 0
+    want = _materialized_states(trainer)
+    got = _materialized_states(trainer2)
+    for k in want:
+        for a, b in zip(want[k], got[k]):
+            np.testing.assert_array_equal(a, b, err_msg=str(k))
+    # one more step adopts the restored state onto the dp=4 mesh
+    X, Y = _data()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    with mx.autograd.record():
+        loss = loss_fn(net2(nd.array(X[:B])), nd.array(Y[:B]))
+    loss.backward()
+    trainer2.step(B)
+    plane = zero.plane_of(trainer2._updaters[0])
+    assert plane is not None and plane.dp == 4
+
+
+def _corruption_case(tmp_path, monkeypatch, spec):
+    """Two committed sharded epochs; the second saved under a chaos spec
+    that corrupts/loses a shard. Returns (manager, trainer-at-epoch-0
+    snapshot states, restored trainer, restored epoch, corruption delta)."""
+    monkeypatch.setenv("MXNET_ZERO", "1")
+    monkeypatch.setenv("MXNET_ZERO_DEVICES", "2")
+    X, Y = _data()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    net, trainer = _make("zcc_", "adam", {"learning_rate": 0.01})
+    cm = elastic.CheckpointManager(str(tmp_path))
+
+    def step(s):
+        with mx.autograd.record():
+            loss = loss_fn(net(nd.array(X[s * B:(s + 1) * B])),
+                           nd.array(Y[s * B:(s + 1) * B]))
+        loss.backward()
+        trainer.step(B)
+
+    step(0)
+    step(1)
+    cm.save_training(0, net=net, trainer=trainer)
+    want = _materialized_states(trainer)  # snapshot AT epoch 0
+    # (materialize detached the plane; the next step re-adopts)
+    step(2)
+    with chaos.active(spec):
+        cm.save_training(1, net=net, trainer=trainer)
+    c0 = telemetry.CKPT_CORRUPTION.value()
+    net2, trainer2 = _make("zcd_", "adam", {"learning_rate": 0.01})
+    epoch = cm.restore_training(net=net2, trainer=trainer2)
+    return cm, want, trainer2, epoch, telemetry.CKPT_CORRUPTION.value() - c0
+
+
+def test_torn_write_falls_back_to_previous_epoch(tmp_path, monkeypatch):
+    """A committed-looking epoch whose shard bytes tore (hash mismatch)
+    restores the PREVIOUS committed epoch — counted, never a crash."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    cm, want, trainer2, epoch, corrupt = _corruption_case(
+        tmp_path, monkeypatch, "site=ckpt.shard,at=1,action=torn-write")
+    assert cm.latest_epoch() == 1      # files all exist: LOOKS committed
+    assert epoch == 0                  # ...but restore detected the tear
+    assert corrupt >= 1
+    got = _materialized_states(trainer2)
+    for k in want:
+        for a, b in zip(want[k], got[k]):
+            np.testing.assert_array_equal(a, b, err_msg=str(k))
+
+
+def test_drop_one_shard_falls_back_to_previous_epoch(tmp_path, monkeypatch):
+    """A lost shard file makes the epoch read UNCOMMITTED everywhere:
+    latest_epoch skips it and restore lands on the previous epoch."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    cm, want, trainer2, epoch, _corrupt = _corruption_case(
+        tmp_path, monkeypatch, "site=ckpt.shard,at=1,action=drop-shard")
+    assert cm.latest_epoch() == 0      # missing file == uncommitted
+    assert epoch == 0
+    got = _materialized_states(trainer2)
+    for k in want:
+        for a, b in zip(want[k], got[k]):
+            np.testing.assert_array_equal(a, b, err_msg=str(k))
+
+
+# ---------------------------------------------------------------------------
+# bitwise kill-and-resume through the trainplane graph path
+# ---------------------------------------------------------------------------
+
+
+def _elastic_run(tmpdir, tag, opt_name, opt_params, kill_spec=None):
+    """Train STEPS steps through TrainPlane on a 2-device mesh under
+    run_elastic, checkpointing (async, sharded-aware) every CKPT_EVERY
+    steps; returns the final state fingerprint."""
+    X, Y = _data()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    cm = elastic.CheckpointManager(str(tmpdir))
+    final = {}
+
+    def train_fn(start_epoch, manager):
+        net, trainer = _make(tag, opt_name, opt_params)
+        plane = trainplane.TrainPlane(net, loss_fn, trainer,
+                                      mesh=parallel.device_mesh(2))
+        it = mx.io.NDArrayIter(X, Y, batch_size=B)
+        last = manager.restore_training(net=net, trainer=trainer,
+                                        train_iter=it)
+        for step in range(last + 1, STEPS):
+            elastic.step_boundary(manager=manager)
+            batch = it.next()
+            plane.step(batch.data[0], batch.label[0])
+            if (step + 1) % CKPT_EVERY == 0:
+                manager.save_training(step, net=net, trainer=trainer,
+                                      train_iter=it, async_save=True)
+        manager.wait()
+        final["net"], final["trainer"], final["it"] = net, trainer, it
+        final["plane"] = plane
+        return "done"
+
+    if kill_spec:
+        with chaos.active(kill_spec):
+            assert elastic.run_elastic(train_fn, cm, max_restarts=3,
+                                       restart_delay=0) == "done"
+    else:
+        assert elastic.run_elastic(train_fn, cm, max_restarts=0,
+                                   restart_delay=0) == "done"
+    net, trainer, it = final["net"], final["trainer"], final["it"]
+    assert final["plane"].plane == "graph"  # the acceptance path
+    return {
+        "params": _params_of(net),
+        "states": _materialized_states(trainer),
+        "cursor": int(it.cursor),
+        "num_update": trainer._optimizer.num_update,
+        "index_counts": dict(trainer._optimizer._index_update_count),
+    }
+
+
+@pytest.mark.parametrize("opt_name,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+])
+@pytest.mark.parametrize("zero_level", [0, 1])
+def test_kill_at_step_resume_bitwise(tmp_path, monkeypatch, opt_name,
+                                     opt_params, zero_level):
+    """ACCEPTANCE: kill-at-step → resume is bitwise identical to the
+    uninterrupted run — final params, optimizer state, data cursor and
+    step counters — for SGD/Adam at MXNET_ZERO=0 and 1 on a 2-device CPU
+    mesh through the trainplane graph path."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    if zero_level:
+        monkeypatch.setenv("MXNET_ZERO", "1")
+        monkeypatch.setenv("MXNET_ZERO_DEVICES", "2")
+    else:
+        monkeypatch.delenv("MXNET_ZERO", raising=False)
+    tag = "kr%s%d_" % (opt_name, zero_level)
+
+    ref = _elastic_run(tmp_path / "ref", tag + "a_", opt_name, opt_params)
+    # the 4th step boundary = entering step 3: steps 2 (unsaved) and 3
+    # are killed mid-window and must replay from the epoch-1 checkpoint
+    got = _elastic_run(tmp_path / "kill", tag + "b_", opt_name, opt_params,
+                       kill_spec="site=elastic.step,at=4,action=kill")
+
+    assert got["cursor"] == ref["cursor"]
+    assert got["num_update"] == ref["num_update"]
+    assert got["index_counts"] == ref["index_counts"]
+    assert set(got["params"]) == set(ref["params"])
+    for k in ref["params"]:
+        np.testing.assert_array_equal(got["params"][k], ref["params"][k],
+                                      err_msg="param %s" % k)
+    assert set(got["states"]) == set(ref["states"])
+    for k in ref["states"]:
+        assert len(got["states"][k]) == len(ref["states"][k])
+        for a, b in zip(ref["states"][k], got["states"][k]):
+            np.testing.assert_array_equal(b, a, err_msg="state %s" % str(k))
+
+
+def test_trainplane_fit_checkpoint_resume(tmp_path, monkeypatch):
+    """trainplane.fit(checkpoint=...) under a kill: run_elastic restarts
+    it and fit resumes from the committed epoch; the run completes with
+    every epoch's checkpoint committed."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    monkeypatch.delenv("MXNET_ZERO", raising=False)
+    X, Y = _data()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    cm = elastic.CheckpointManager(str(tmp_path))
+
+    def train_fn(start_epoch, manager):
+        net, trainer = _make("fitck_", "sgd",
+                             {"learning_rate": 0.1, "momentum": 0.9})
+        trainplane.fit(net, loss_fn, trainer,
+                       mx.io.NDArrayIter(X, Y, batch_size=B),
+                       epochs=3, mesh=parallel.device_mesh(2),
+                       checkpoint=manager)
+        return "ok"
+
+    # 3 epochs x 6 batches: kill at the 8th step boundary (epoch 1)
+    with chaos.active("site=elastic.step,at=8,action=kill"):
+        assert elastic.run_elastic(train_fn, cm, max_restarts=2,
+                                   restart_delay=0) == "ok"
+    assert cm.latest_epoch() == 2
+    assert cm.restore_training() == 2
+    assert (cm.last_restored_extra or {}).get("mid_epoch") is False

@@ -867,3 +867,85 @@ def test_replicated_state_repo_gate_clean():
                                       passes=["replicated-state"])
                 if f.rule == "replicated-state"]
     assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# non-atomic-write
+# ---------------------------------------------------------------------------
+
+def test_non_atomic_write_flags_bare_open_on_ckpt_path():
+    f = lint("""
+        def store(ckpt_path, blob):
+            with open(ckpt_path, "wb") as fh:
+                fh.write(blob)
+        """, rule="non-atomic-write")
+    assert len(f) == 1 and "open" in f[0].message
+    # checkpoint-ish by FUNCTION even when the path arg is opaque
+    f = lint("""
+        def save_states(fname, blob):
+            open(fname, "wb").write(blob)
+        """, rule="non-atomic-write")
+    assert len(f) == 1
+
+
+def test_non_atomic_write_flags_np_save_and_pickle_dump():
+    f = lint("""
+        def snapshot(path, arr):
+            np.save(path, arr)
+        """, rule="non-atomic-write")
+    assert len(f) == 1 and "np.save" in f[0].message
+    f = lint("""
+        def write(obj, manifest_file):
+            pickle.dump(obj, manifest_file)
+        """, rule="non-atomic-write")
+    assert len(f) == 1 and "pickle.dump" in f[0].message
+
+
+def test_non_atomic_write_negative_cases():
+    # reads are fine, and writes to non-checkpoint paths are out of scope
+    assert lint("""
+        def load(ckpt_path):
+            with open(ckpt_path, "rb") as fh:
+                return fh.read()
+        """, rule="non-atomic-write") == []
+    assert lint("""
+        def emit(log_path, line):
+            open(log_path, "a").write(line)
+        """, rule="non-atomic-write") == []
+    # tools/tests are out of scope — only mxnet_tpu/ carries the contract
+    assert lint("""
+        def save(ckpt_path, blob):
+            open(ckpt_path, "wb").write(blob)
+        """, rule="non-atomic-write", relpath="tools/whatever.py") == []
+
+
+def test_non_atomic_write_commit_helpers_exempt():
+    # the atomic helpers themselves, and writer lambdas routed through
+    # them, ARE the sanctioned implementation
+    assert lint("""
+        def _atomic_write(path, writer):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(b"checkpoint")
+            os.replace(tmp, path)
+        """, rule="non-atomic-write") == []
+    assert lint("""
+        def save(self, epoch, blob):
+            self._commit(self._params_path(epoch),
+                         lambda p: open(p, "wb").write(blob))
+        """, rule="non-atomic-write") == []
+    assert lint("""
+        def save(self, epoch, blob):
+            self._commit_bytes(self._shard_path(epoch), blob, "shard")
+        """, rule="non-atomic-write") == []
+
+
+def test_non_atomic_write_repo_gate_clean():
+    # every pre-existing bare write rides the committed baseline; the
+    # elastic checkpoint plane itself must be finding-free
+    files = collect_files(["mxnet_tpu"], root=REPO)
+    findings = [f for f in lint_files(files, root=REPO,
+                                      passes=["non-atomic-write"])]
+    baseline = load_baseline(DEFAULT_BASELINE)
+    assert apply_baseline(findings, baseline) == []
+    assert [f for f in findings if "elastic" in f.path] == []

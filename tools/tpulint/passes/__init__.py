@@ -6,6 +6,7 @@ from . import eager_step  # noqa: F401
 from . import env_knob  # noqa: F401
 from . import host_sync  # noqa: F401
 from . import native_guard  # noqa: F401
+from . import non_atomic_write  # noqa: F401
 from . import perparam_jit  # noqa: F401
 from . import replicated_state  # noqa: F401
 from . import swallowed_error  # noqa: F401

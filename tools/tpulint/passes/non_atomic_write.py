@@ -1,0 +1,139 @@
+"""non-atomic-write: bare writes to checkpoint-ish paths outside the
+atomic-commit helpers.
+
+The elastic checkpoint protocol (``mxnet_tpu/elastic.py``) is
+tmp + fsync + ``os.replace`` + directory-fsync, manifest committed last —
+a crash or preemption at ANY moment leaves either the old state or the
+new, never a readable-but-torn file. That guarantee only holds if every
+write to checkpoint-shaped storage routes through the helpers
+(``CheckpointManager._atomic_write``/``_commit``/``_commit_bytes``). A
+bare ``open(path, "w")``/``np.save``/``pickle.dump`` straight onto a
+checkpoint path re-introduces the torn-write window the PR-4/PR-9 chaos
+gates exist to rule out: a kill between ``open`` and ``close`` leaves a
+truncated file under the committed name.
+
+Flagged in ``mxnet_tpu/``:
+
+- ``open(path, "w"/"wb"/"a"/"ab")`` where the path expression or the
+  enclosing function name reads checkpoint-ish (``ckpt``, ``checkpoint``,
+  ``manifest``, ``shard``, ``save_states``, ``save_checkpoint``,
+  ``optimizer_states``, ``save_parameters``, ``snapshot``, or a bare
+  ``save``/``dump`` function);
+- ``np.save``/``np.savez[_compressed]`` and ``pickle.dump`` under the
+  same path/function test.
+
+Exempt: code nested (lexically) inside a call to ``_atomic_write``/
+``_commit``/``_commit_bytes`` (the writer lambdas), and the bodies of
+functions by those names — the helpers ARE the implementation.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from ..core import (FileContext, Finding, Pass, ancestors, dotted_name,
+                    enclosing_function, register)
+
+_WRITE_MODES = ("w", "wb", "a", "ab", "wt", "w+", "wb+", "w+b")
+
+_CKPT_PATH_RE = re.compile(
+    r"ckpt|checkpoint|manifest|shard|states|snapshot|\.params")
+_CKPT_FN_RE = re.compile(
+    r"ckpt|checkpoint|manifest|shard|snapshot|save_states|"
+    r"save_checkpoint|optimizer_states|save_parameters|^save$|^_save")
+
+_HELPERS = ("_atomic_write", "_commit", "_commit_bytes")
+
+_NP_SAVERS = {"np.save", "np.savez", "np.savez_compressed",
+              "numpy.save", "numpy.savez", "numpy.savez_compressed"}
+_PICKLE_DUMPERS = {"pickle.dump", "cPickle.dump"}
+
+
+def _expr_is_ckpt(node: ast.AST) -> bool:
+    """Whether the path expression names checkpoint-ish storage: an
+    identifier, attribute, call name or string constant matching the
+    checkpoint vocabulary."""
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            name = sub.value
+        if name and _CKPT_PATH_RE.search(name.lower()):
+            return True
+    return False
+
+
+def _fn_name(node: ast.AST) -> Optional[str]:
+    fn = enclosing_function(node)
+    return getattr(fn, "name", None) if fn is not None else None
+
+
+def _in_helper(node: ast.AST) -> bool:
+    """Inside an atomic-commit helper: the helper function's own body, or
+    a writer lambda/def passed (lexically) into a ``_commit``-family
+    call."""
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and anc.name in _HELPERS:
+            return True
+        if isinstance(anc, ast.Call):
+            tail = (dotted_name(anc.func) or "").rsplit(".", 1)[-1]
+            if tail in _HELPERS:
+                return True
+    return False
+
+
+def _is_ckpt_site(node: ast.Call, path_arg: Optional[ast.AST]) -> bool:
+    if path_arg is not None and _expr_is_ckpt(path_arg):
+        return True
+    fn = _fn_name(node)
+    return bool(fn and _CKPT_FN_RE.search(fn.lower()))
+
+
+@register
+class NonAtomicWritePass(Pass):
+    name = "non-atomic-write"
+    description = ("bare open(w)/np.save/pickle.dump onto checkpoint-ish "
+                   "paths outside the _atomic_write/_commit helpers — "
+                   "a crash mid-write leaves a torn file under a "
+                   "committed name")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if name == "open" and len(node.args) >= 2:
+                mode = node.args[1]
+                if not (isinstance(mode, ast.Constant)
+                        and isinstance(mode.value, str)
+                        and mode.value in _WRITE_MODES):
+                    continue
+                if _in_helper(node) or not _is_ckpt_site(node, node.args[0]):
+                    continue
+                yield ctx.finding(
+                    node, self.name,
+                    "bare open(..., %r) onto a checkpoint-ish path — "
+                    "commit through CheckpointManager._atomic_write/"
+                    "_commit (tmp+fsync+rename, manifest last)"
+                    % mode.value)
+            elif name in _NP_SAVERS or name in _PICKLE_DUMPERS:
+                path_arg = None
+                if name in _NP_SAVERS and node.args:
+                    path_arg = node.args[0]
+                elif name in _PICKLE_DUMPERS and len(node.args) >= 2:
+                    path_arg = node.args[1]
+                if _in_helper(node) or not _is_ckpt_site(node, path_arg):
+                    continue
+                yield ctx.finding(
+                    node, self.name,
+                    "bare `%s(...)` onto a checkpoint-ish path — commit "
+                    "through CheckpointManager._atomic_write/_commit "
+                    "(tmp+fsync+rename, manifest last)" % name)
