@@ -60,7 +60,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from . import resilience, telemetry
-from .base import MXNetError, get_env
+from .base import MXNetError, fetch_host, get_env
 from .resilience import chaos
 
 __all__ = ["CheckpointManager", "run_elastic", "start_heartbeat",
@@ -372,6 +372,32 @@ def _write_bytes(path: str, data: bytes) -> None:
         f.write(data)
 
 
+def commit_bytes(path: str, data: bytes, kind: str) -> None:
+    """One durable standalone commit for callers OUTSIDE a
+    :class:`CheckpointManager` (symbol/module save paths): the same
+    tmp+fsync+rename atomic write, ``ckpt.commit`` retry policy,
+    ``mxnet_ckpt_bytes_total`` accounting and stall-watchdog progress
+    the manager's ``_commit_bytes`` gives every managed file."""
+    telemetry.CKPT_BYTES.inc(len(data), kind=kind)
+    resilience.call(
+        "ckpt.commit",
+        lambda: CheckpointManager._atomic_write(
+            path, lambda p: _write_bytes(p, data)))
+    note_progress()
+
+
+def _host_snapshot(params: Dict) -> Dict:
+    """Host copies of a name→array dict in ONE batched transfer
+    (``base.fetch_host``) — the save IS the host snapshot, but it needn't
+    drain the device stream once per parameter the way a per-item
+    ``.asnumpy()`` loop does."""
+    nd_keys = [k for k, v in params.items() if hasattr(v, "asnumpy")]
+    fetched = dict(zip(nd_keys, fetch_host([params[k] for k in nd_keys])
+                       if nd_keys else []))
+    return {k: fetched[k] if k in fetched else np.asarray(v)
+            for k, v in params.items()}
+
+
 def _sha256(data: bytes) -> str:
     return hashlib.sha256(data).hexdigest()
 
@@ -510,8 +536,8 @@ class CheckpointManager(object):
         note_progress()
 
     def _commit_bytes(self, path: str, data: bytes, kind: str) -> None:
-        telemetry.CKPT_BYTES.inc(len(data), kind=kind)
-        self._commit(path, lambda p: _write_bytes(p, data))
+        # one commit idiom, shared with standalone callers (symbol/module)
+        commit_bytes(path, data, kind)
 
     @staticmethod
     def _torn_write(path: str, data: bytes) -> None:
@@ -549,8 +575,7 @@ class CheckpointManager(object):
             elif params is not None:
                 from .ndarray import io_utils
 
-                snap = {k: (v.asnumpy() if hasattr(v, "asnumpy") else
-                            np.asarray(v)) for k, v in params.items()}
+                snap = _host_snapshot(params)
                 params_bytes = _bytes_of(lambda p: io_utils.save(p, snap))
             states_bytes = None
             if trainer is not None:
@@ -648,8 +673,7 @@ class CheckpointManager(object):
         elif params is not None:
             from .ndarray import io_utils
 
-            snap = {k: (v.asnumpy() if hasattr(v, "asnumpy") else  # tpulint: disable=host-sync - the save IS the host snapshot
-                        np.asarray(v)) for k, v in params.items()}
+            snap = _host_snapshot(params)
             add("params", self._params_path(epoch),
                 _bytes_of(lambda p: io_utils.save(p, snap)), "params")
 

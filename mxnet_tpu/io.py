@@ -16,7 +16,7 @@ from collections import namedtuple
 import numpy as np
 
 from . import resilience, telemetry
-from .base import MXNetError
+from .base import MXNetError, fetch_host
 from .context import cpu
 from .ndarray import ndarray as nd_mod
 from .ndarray.ndarray import NDArray
@@ -159,12 +159,14 @@ def _init_data(data, allow_empty, default_name):
                 [("_%d_%s" % (i, default_name), d) for i, d in enumerate(data)])
     if isinstance(data, dict):
         data = OrderedDictItems(sorted(data.items()))
-    out = []
-    for k, v in data:
-        if isinstance(v, NDArray):
-            v = v.asnumpy()
-        out.append((k, np.asarray(v)))
-    return out
+    # ONE batched device->host transfer for every NDArray input instead
+    # of a per-item .asnumpy() sync in the loop
+    items = list(data)
+    nd_idx = [i for i, (_k, v) in enumerate(items) if isinstance(v, NDArray)]
+    fetched = dict(zip(nd_idx, fetch_host([items[i][1] for i in nd_idx])
+                       if nd_idx else []))
+    return [(k, np.asarray(fetched[i] if i in fetched else v))
+            for i, (k, v) in enumerate(items)]
 
 
 class OrderedDictItems(list):

@@ -348,8 +348,12 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+            # atomic commit under the ckpt.commit retry policy: optimizer
+            # state is checkpoint state — a kill mid-write must never
+            # leave a torn file under the final name
+            from ..elastic import commit_bytes
+
+            commit_bytes(fname, self._updater.get_states(), kind="states")
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized

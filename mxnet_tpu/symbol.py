@@ -406,8 +406,12 @@ class Symbol(object):
         return _subgraph.partition_graph(self, backend)
 
     def save(self, fname):
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+        # atomic commit (tmp+fsync+rename) under the ckpt.commit retry
+        # policy: a crash mid-save must leave the old symbol file or the
+        # new one, never a torn JSON
+        from .elastic import commit_bytes
+
+        commit_bytes(fname, self.tojson().encode("utf-8"), kind="symbol")
 
     # ------------------------------------------------------------------
     # evaluation / binding

@@ -1,0 +1,59 @@
+"""Seeded synthetic bugs for the tpulint whole-program engine — one per
+interprocedural pass, each invisible to every file-local pass.
+
+``tests/test_tpulint.py::test_seeded_bugs_*`` lints this file under a
+``mxnet_tpu/`` pseudo-path and asserts each pass catches EXACTLY its
+seeded bug (and nothing else fires): the regression gate proving the
+engine still sees through call indirection, donation windows and thread
+boundaries. Not imported at runtime — pure fixture source.
+"""
+import threading
+
+import numpy as np
+
+
+# -- bug 1: traced host-sync, two calls below the traced entry point --------
+# `_leaf_step` is a traced seed (every fused/graph-plane jit traces it);
+# the float() sync hides two frames down, where the file-local host-sync
+# pass (no loop, no same-file jit wrap) cannot see it.
+
+def _leaf_step(w, g, state):
+    return _apply_update(w, g, state)
+
+
+def _apply_update(w, g, state):
+    return _normalize(w - g), state
+
+
+def _normalize(x):
+    return x / float(x.sum())  # BUG: trace-time device sync, frozen scalar
+
+
+# -- bug 2: read-after-donate ----------------------------------------------
+# `weights` is donated through fused_apply; the return still reads it.
+
+def fused_apply(optimizer, indices, grads, weights, states):
+    raise NotImplementedError  # stand-in for the fastpath entry point
+
+
+def apply_and_peek(optimizer, indices, grads, weights, states):
+    new_w, new_s = fused_apply(optimizer, indices, grads, weights, states)
+    return weights[0], new_w, new_s  # BUG: stale handle over a donated buffer
+
+
+# -- bug 3: unlocked cross-thread write ------------------------------------
+# the worker mutates `_count` off-thread; `snapshot` reads it from the
+# caller; neither side holds the (existing!) lock.
+
+class SeededWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            self._count += 1  # BUG: unlocked write on the worker thread
+
+    def snapshot(self):
+        return np.int64(self._count)  # unlocked read from the caller

@@ -949,3 +949,788 @@ def test_non_atomic_write_repo_gate_clean():
     baseline = load_baseline(DEFAULT_BASELINE)
     assert apply_baseline(findings, baseline) == []
     assert [f for f in findings if "elastic" in f.path] == []
+
+
+# ---------------------------------------------------------------------------
+# whole-program graph engine (symbol table / call graph / lattices)
+# ---------------------------------------------------------------------------
+
+from tools.tpulint import graph as graph_mod  # noqa: E402
+from tools.tpulint.core import FileContext, lint_sources  # noqa: E402
+
+
+def make_graph(files, depth=graph_mod.DEFAULT_DEPTH):
+    """Build a ProjectGraph over {relpath: source} fixtures."""
+    ctxs = [FileContext(rp, textwrap.dedent(src), filename=rp)
+            for rp, src in sorted(files.items())]
+    return graph_mod.build_graph([(c.relpath, c.tree) for c in ctxs],
+                                 depth=depth)
+
+
+def fn_of(gph, qname):
+    for info in gph.funcs.values():
+        if info.qname == qname:
+            return info
+    raise AssertionError("no function %r in graph (have: %s)"
+                         % (qname, sorted(i.qname for i in gph.funcs.values())))
+
+
+def test_graph_aliased_import_call_edges():
+    gph = make_graph({
+        "mxnet_tpu/a.py": """
+            def helper(x):
+                return x + 1
+        """,
+        "mxnet_tpu/b.py": """
+            from mxnet_tpu.a import helper as h2
+            import mxnet_tpu.a as amod
+            from .a import helper as h3
+
+            def via_from_alias(x):
+                return h2(x)
+
+            def via_module_alias(x):
+                return amod.helper(x)
+
+            def via_relative(x):
+                return h3(x)
+        """})
+    helper = fn_of(gph, "mxnet_tpu/a.py::helper")
+    for caller in ("via_from_alias", "via_module_alias", "via_relative"):
+        info = fn_of(gph, "mxnet_tpu/b.py::%s" % caller)
+        assert helper in info.callees, caller
+
+
+def test_graph_package_init_reexport_resolves():
+    # `from .mod import helper` inside pkg/__init__.py resolves against
+    # pkg itself (not one level up), so re-export chains through package
+    # __init__ files keep their call edges — the mxnet_tpu subpackages
+    # (fastpath, serving, telemetry) all re-export this way
+    gph = make_graph({
+        "pkg/__init__.py": """
+            from .mod import helper
+        """,
+        "pkg/mod.py": """
+            def helper(x):
+                return x.asnumpy()
+        """,
+        "pkg/use.py": """
+            import jax
+            from pkg import helper
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+        """})
+    helper = fn_of(gph, "pkg/mod.py::helper")
+    step = fn_of(gph, "pkg/use.py::step")
+    assert helper in step.callees
+    assert gph.is_traced(helper.node)
+
+
+def test_graph_method_binding_self_and_base_class():
+    gph = make_graph({
+        "mxnet_tpu/base_mod.py": """
+            class Base:
+                def shared(self):
+                    return 1
+        """,
+        "mxnet_tpu/impl.py": """
+            from mxnet_tpu.base_mod import Base
+
+            class Impl(Base):
+                def own(self):
+                    return 2
+
+                def caller(self):
+                    return self.own() + self.shared() + Impl.own(self)
+        """})
+    caller = fn_of(gph, "mxnet_tpu/impl.py::Impl.caller")
+    own = fn_of(gph, "mxnet_tpu/impl.py::Impl.own")
+    shared = fn_of(gph, "mxnet_tpu/base_mod.py::Base.shared")
+    assert own in caller.callees          # self-binding (and Class.method)
+    assert shared in caller.callees       # base-class binding by name
+
+
+def test_graph_decorated_functions_still_resolve():
+    gph = make_graph({
+        "mxnet_tpu/d.py": """
+            import functools
+
+            def deco(fn):
+                return fn
+
+            @deco
+            def decorated(x):
+                return x
+
+            def caller(x):
+                return decorated(x)
+        """})
+    assert fn_of(gph, "mxnet_tpu/d.py::decorated") in \
+        fn_of(gph, "mxnet_tpu/d.py::caller").callees
+
+
+def test_graph_recursion_terminates_and_depth_cutoff():
+    # direct + mutual recursion must terminate; a chain longer than the
+    # propagation bound is cut off at DEFAULT_DEPTH frames from the seed.
+    # (Seeded via the graph-only `_leaf_step` name seed: the same-file
+    # jit closure in `core.jit_functions` is deliberately unbounded.)
+    depth = graph_mod.DEFAULT_DEPTH
+    n = depth + 2
+    chain = "\n".join(
+        "def f%d(x):\n    return f%d(x)" % (i, i + 1) for i in range(n))
+    src = """
+        import jax
+
+        def rec(x):
+            return rec(x)
+
+        def _leaf_step(x):
+            return f0(x)
+
+        %s
+
+        def f%d(x):
+            return x
+
+        jax.jit(rec)
+    """ % (chain.replace("\n", "\n        "), n)
+    gph = make_graph({"mxnet_tpu/r.py": src})
+    assert gph.is_traced(fn_of(gph, "mxnet_tpu/r.py::rec").node)
+    # fk sits at distance k+1 from the seed: within the bound traced,
+    # beyond it cut off
+    assert gph.is_traced(fn_of(gph, "mxnet_tpu/r.py::f%d" % (depth - 1)).node)
+    assert not gph.is_traced(fn_of(gph, "mxnet_tpu/r.py::f%d" % depth).node)
+    assert not gph.is_traced(fn_of(gph, "mxnet_tpu/r.py::f%d" % n).node)
+
+
+def test_graph_traced_lattice_seeds_and_chain():
+    gph = make_graph({
+        "mxnet_tpu/opt.py": """
+            class SGD:
+                def _leaf_step(self, w, g):
+                    return self._clip(w - g)
+
+                def _clip(self, x):
+                    return x
+        """,
+        "mxnet_tpu/plane.py": """
+            import jax
+
+            class Plane:
+                def _build_step(self):
+                    def step(x):
+                        return helper(x)
+                    return step
+
+                def activate(self):
+                    self._fn = jax.jit(self._build_step())
+
+            def helper(x):
+                return x
+        """})
+    clip = fn_of(gph, "mxnet_tpu/opt.py::SGD._clip")
+    assert gph.is_traced(clip.node)                 # seeded at _leaf_step
+    assert gph.traced_chain(clip.node) == ["SGD._leaf_step", "SGD._clip"]
+    # factory-returned nested function + its callees are traced
+    step = fn_of(gph, "mxnet_tpu/plane.py::Plane._build_step.step")
+    helper = fn_of(gph, "mxnet_tpu/plane.py::helper")
+    assert gph.is_traced(step.node) and gph.is_traced(helper.node)
+
+
+def test_graph_thread_lattice_seeds():
+    gph = make_graph({
+        "mxnet_tpu/w.py": """
+            import threading
+
+            class Emitter(threading.Thread):
+                def run(self):
+                    self.emit()
+
+                def emit(self):
+                    pass
+
+            class Server:
+                def start(self):
+                    self._t = threading.Thread(target=self._worker)
+
+                def _worker(self):
+                    helper()
+
+            class Saver:
+                def save(self):
+                    def commit():
+                        finish()
+                    self._engine.push(commit)
+
+            def helper():
+                pass
+
+            def finish():
+                pass
+
+            def main_only():
+                helper()
+        """})
+    for q in ("Emitter.run", "Emitter.emit", "Server._worker", "helper",
+              "Saver.save.commit", "finish"):
+        assert gph.is_threaded(fn_of(gph, "mxnet_tpu/w.py::%s" % q).node), q
+    assert not gph.is_threaded(fn_of(gph, "mxnet_tpu/w.py::main_only").node)
+    assert gph.thread_entry(
+        fn_of(gph, "mxnet_tpu/w.py::Server._worker").node) == "Server._worker"
+
+
+# ---------------------------------------------------------------------------
+# traced-host-sync
+# ---------------------------------------------------------------------------
+
+def test_traced_host_sync_two_calls_below_leaf_step():
+    f = lint("""
+        def _leaf_step(w, g):
+            return _apply(w, g)
+
+        def _apply(w, g):
+            return _norm(w - g)
+
+        def _norm(x):
+            return x / float(x.sum())
+    """, "traced-host-sync")
+    assert len(f) == 1
+    assert "float()" in f[0].message and "_leaf_step" in f[0].message
+    assert "_norm" in f[0].message
+
+
+def test_traced_host_sync_cross_file_jit_reachability():
+    found = lint_sources([
+        ("mxnet_tpu/helpers.py", textwrap.dedent("""
+            def helper(x):
+                return x.asnumpy()
+        """)),
+        ("mxnet_tpu/steps.py", textwrap.dedent("""
+            import jax
+            from mxnet_tpu.helpers import helper
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+        """)),
+    ], passes=["traced-host-sync"])
+    assert len(found) == 1 and found[0].path == "mxnet_tpu/helpers.py"
+    assert ".asnumpy()" in found[0].message
+
+
+def test_traced_host_sync_flags_get_env_and_locks():
+    f = lint("""
+        def _leaf_step(w):
+            knob = get_env("MXNET_X", 0, int, cache=False)
+            with self._lock:
+                w = w + knob
+            self._mu.acquire()
+            return w
+    """, "traced-host-sync")
+    msgs = " ".join(x.message for x in f)
+    assert len(f) == 3
+    assert "get_env(cache=False)" in msgs and "lock" in msgs
+
+
+def test_traced_host_sync_negative_and_no_double_report():
+    # not reachable from any traced seed -> clean
+    assert lint("""
+        def host_loop(xs):
+            return xs[0].asnumpy()
+    """, "traced-host-sync") == []
+    # lexically inside a same-file jit closure: host-sync owns the report
+    src = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.item()
+    """
+    assert lint(src, "traced-host-sync") == []
+    assert len(lint(src, "host-sync")) == 1
+
+
+def test_traced_host_sync_scoped_to_mxnet_tpu():
+    src = """
+        def _leaf_step(w):
+            return float(w.sum())
+    """
+    assert lint(src, "traced-host-sync", relpath="tools/x.py") == []
+    assert len(lint(src, "traced-host-sync")) == 1
+
+
+# ---------------------------------------------------------------------------
+# use-after-donate
+# ---------------------------------------------------------------------------
+
+def test_use_after_donate_read_after_fused_apply():
+    f = lint("""
+        def apply(opt, idx, grads, weights, states):
+            new_w, new_s = fused_apply(opt, idx, grads, weights, states)
+            return weights[0], new_w
+    """, "use-after-donate")
+    assert len(f) == 1 and "`weights`" in f[0].message
+
+
+def test_use_after_donate_rebind_and_invalidate_clear():
+    assert lint("""
+        def rebound(opt, idx, g, weights, states):
+            weights = fused_apply(opt, idx, g, weights, states)
+            return weights
+    """, "use-after-donate") == []
+    assert lint("""
+        def disciplined(opt, idx, g, weights, states):
+            new_w = fused_apply(opt, idx, g, weights, states)
+            invalidate_consumed(consumed, (new_w,))
+            return weights
+    """, "use-after-donate") == []
+
+
+def test_use_after_donate_donation_prep_window_opens_at_consumer():
+    # reads between prep and the consuming jit are the sanctioned pattern
+    assert lint("""
+        def ok(flat_ws, buckets, fn):
+            argnums, consumed = donation_prep(flat_ws, buckets)
+            new_ws, new_buckets = fn(flat_ws, buckets)
+            buckets = new_buckets
+            return new_ws
+    """, "use-after-donate") == []
+    # ...but a read AFTER the consumer is stale
+    f = lint("""
+        def stale(flat_ws, buckets, fn):
+            argnums, consumed = donation_prep(flat_ws, buckets)
+            new_ws = fn(flat_ws, buckets)
+            return flat_ws[0]
+    """, "use-after-donate")
+    assert len(f) == 1 and "`flat_ws`" in f[0].message
+
+
+def test_use_after_donate_local_donating_jit_and_self_attr():
+    f = lint("""
+        import jax
+
+        def local_jit(pools, x):
+            step = jax.jit(kernel, donate_argnums=(0,))
+            out = step(pools, x)
+            return pools[0]
+    """, "use-after-donate")
+    assert len(f) == 1 and "`pools`" in f[0].message
+    # the decode pattern: a donating jit installed in __init__, the pool
+    # donated in another method, rebound from the jit's outputs -> clean
+    assert lint("""
+        import jax
+
+        class Engine:
+            def __init__(self):
+                self._step = jax.jit(kernel, donate_argnums=(0,))
+
+            def tick(self, x):
+                out, pools = self._step(self._pools, x)
+                self._pools = pools
+                return out
+    """, "use-after-donate") == []
+    # ...without the rebind, the next read is stale
+    f = lint("""
+        import jax
+
+        class Engine:
+            def __init__(self):
+                self._step = jax.jit(kernel, donate_argnums=(0,))
+
+            def tick(self, x):
+                out = self._step(self._pools, x)
+                return self._pools
+    """, "use-after-donate")
+    assert len(f) == 1 and "self._pools" in f[0].message
+
+
+def test_use_after_donate_fused_py_is_exempt():
+    src = """
+        def probe(weights):
+            new = fused_apply(None, None, None, weights, None)
+            return weights
+    """
+    assert lint(src, "use-after-donate",
+                relpath="mxnet_tpu/fastpath/fused.py") == []
+    assert len(lint(src, "use-after-donate")) == 1
+
+
+# ---------------------------------------------------------------------------
+# shared-state-race
+# ---------------------------------------------------------------------------
+
+def test_shared_state_race_unlocked_cross_thread_write():
+    f = lint("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._n = 0
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                self._n += 1
+
+            def snapshot(self):
+                return self._n
+    """, "shared-state-race")
+    assert len(f) == 1
+    assert "`self._n`" in f[0].message and "W.snapshot" in f[0].message
+
+
+def test_shared_state_race_common_lock_is_clean():
+    assert lint("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                with self._lock:
+                    self._n += 1
+
+            def snapshot(self):
+                with self._lock:
+                    return self._n
+    """, "shared-state-race") == []
+
+
+def test_shared_state_race_one_sided_lock_still_flagged():
+    f = lint("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                with self._lock:
+                    self._n += 1
+
+            def snapshot(self):
+                return self._n
+    """, "shared-state-race")
+    assert len(f) == 1
+
+
+def test_shared_state_race_init_exemptions():
+    # writes in __init__ are pre-start() on either side — including an
+    # object CONSTRUCTED on the worker thread (publication via queue)
+    assert lint("""
+        import threading
+
+        class Batch:
+            def __init__(self, data):
+                self.data = data
+
+            def __str__(self):
+                return str(self.data)
+
+        class W:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                b = Batch([1])
+                self._q.put(b)
+    """, "shared-state-race") == []
+
+
+def test_shared_state_race_worker_closure_in_init_is_thread_context():
+    # a closure defined in __init__ but handed to Thread(target=...) runs
+    # on the worker — its writes do NOT get the construction exemption
+    f = lint("""
+        import threading
+
+        class W:
+            def __init__(self):
+                def worker():
+                    self._state = 1
+                self._t = threading.Thread(target=worker)
+
+            def peek(self):
+                return self._state
+    """, "shared-state-race")
+    assert len(f) == 1 and "`self._state`" in f[0].message
+
+
+def test_shared_state_race_scoped_to_mxnet_tpu():
+    src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._n = 0
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                self._n += 1
+
+            def peek(self):
+                return self._n
+    """
+    assert lint(src, "shared-state-race", relpath="tools/x.py") == []
+    assert len(lint(src, "shared-state-race")) == 1
+
+
+def test_shared_state_race_repo_findings_are_baselined_with_justifications():
+    # every baselined interprocedural finding must carry a one-line
+    # justification (the acceptance contract for the whole-program gate)
+    counts = load_baseline(DEFAULT_BASELINE)
+    justs = core.load_justifications(DEFAULT_BASELINE)
+    race_keys = [k for k in counts if "::shared-state-race::" in k]
+    assert race_keys, "expected the known worker-counter findings baselined"
+    for k in race_keys:
+        assert justs.get(k), "baselined finding lacks a justification: %s" % k
+
+
+# ---------------------------------------------------------------------------
+# seeded synthetic bugs (fixture module): each pass catches exactly its bug
+# ---------------------------------------------------------------------------
+
+SEEDED = (REPO / "tests" / "fixtures" / "tpulint_seeded_bugs.py").read_text()
+
+
+def _lint_seeded(rule):
+    # linted under a mxnet_tpu/ pseudo-path: the passes police the
+    # framework package only
+    return lint_source("mxnet_tpu/_seeded_bugs.py", SEEDED, passes=[rule])
+
+
+def test_seeded_bug_traced_host_sync():
+    f = _lint_seeded("traced-host-sync")
+    assert len(f) == 1
+    assert "float()" in f[0].message and "_leaf_step" in f[0].message
+
+
+def test_seeded_bug_use_after_donate():
+    f = _lint_seeded("use-after-donate")
+    assert len(f) == 1 and "`weights`" in f[0].message
+
+
+def test_seeded_bug_shared_state_race():
+    f = _lint_seeded("shared-state-race")
+    assert len(f) == 1 and "`self._count`" in f[0].message
+
+
+def test_seeded_bugs_exactly_three_across_all_passes():
+    f = lint_source("mxnet_tpu/_seeded_bugs.py", SEEDED)
+    assert sorted(x.rule for x in f) == \
+        ["shared-state-race", "traced-host-sync", "use-after-donate"]
+
+
+# ---------------------------------------------------------------------------
+# incremental cache + --stats + runtime gates
+# ---------------------------------------------------------------------------
+
+from tools.tpulint.cache import LintCache  # noqa: E402
+
+
+def test_cache_warm_hits_and_identical_findings(tmp_path):
+    a = tmp_path / "a.py"
+    a.write_text("def f(xs):\n    return [x.asnumpy() for x in xs]\n")
+    cache1 = LintCache(tmp_path / "c.json")
+    cold = lint_files([a], root=tmp_path, cache=cache1)
+    assert cache1.hits == 0 and cache1.misses > 0
+    cache2 = LintCache(tmp_path / "c.json")
+    warm = lint_files([a], root=tmp_path, cache=cache2)
+    assert cache2.misses == 0 and cache2.hits > 0
+    assert [str(f) for f in warm] == [str(f) for f in cold]
+
+
+def test_cache_invalidated_by_edit_and_scope_change(tmp_path):
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text("def f(xs):\n    return [x.asnumpy() for x in xs]\n")
+    b.write_text("X = 1\n")
+    path = tmp_path / "c.json"
+    lint_files([a, b], root=tmp_path, cache=LintCache(path))
+
+    # editing b: a's LOCAL results stay cached, project results (keyed by
+    # the scope signature) re-run for everyone
+    b.write_text("X = 2\n")
+    c = LintCache(path)
+    stats = {}
+    lint_files([a, b], root=tmp_path, cache=c, stats=stats)
+    assert c.hits > 0 and c.misses > 0
+    from tools.tpulint.core import all_passes
+    n_project = sum(1 for p in all_passes().values() if p.project)
+    # both files re-run every project pass; only b re-runs local passes
+    assert c.misses >= 2 * n_project
+
+    # unchanged again -> full hit, and no pass executed at all
+    c2 = LintCache(path)
+    stats2 = {}
+    lint_files([a, b], root=tmp_path, cache=c2, stats=stats2)
+    assert c2.misses == 0 and stats2["pass_ms"] == {}
+
+
+def test_cache_findings_survive_roundtrip_suppressed(tmp_path):
+    # suppressions live in the hashed content: cached results honor them
+    a = tmp_path / "a.py"
+    a.write_text("def f(xs):\n"
+                 "    return [x.asnumpy() for x in xs]"
+                 "  # tpulint: disable=host-sync\n")
+    path = tmp_path / "c.json"
+    assert lint_files([a], root=tmp_path, cache=LintCache(path),
+                      passes=["host-sync"]) == []
+    assert lint_files([a], root=tmp_path, cache=LintCache(path),
+                      passes=["host-sync"]) == []
+
+
+def test_cli_stats_flag(tmp_path, capsys):
+    bad = tmp_path / "v.py"
+    bad.write_text("def f(xs):\n    return [x.asnumpy() for x in xs]\n")
+    rc = main([str(bad), "--stats", "--format", "json",
+               "--cache", str(tmp_path / "c.json")])
+    captured = capsys.readouterr()
+    assert rc == 1
+    # stats go to stderr so --format json keeps a parseable stdout
+    json.loads(captured.out)
+    assert "tpulint --stats:" in captured.err and "cache:" in captured.err \
+        and "pass " in captured.err and "total:" in captured.err
+
+
+def test_runtime_gate_cold_under_30s_warm_under_5s(tmp_path):
+    """The tier-1 cost contract for the whole-program engine: a cold run
+    over mxnet_tpu/ completes in under 30s, a warm (fully cached) run in
+    under 5s."""
+    import time
+
+    cache = str(tmp_path / "gate-cache.json")
+    t0 = time.monotonic()
+    cold = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "mxnet_tpu",
+         "--cache", cache],
+        cwd=str(REPO), capture_output=True, text=True)
+    cold_s = time.monotonic() - t0
+    assert cold.returncode == 0, cold.stdout + cold.stderr
+    assert cold_s < 30.0, "cold whole-program lint took %.1fs" % cold_s
+
+    t0 = time.monotonic()
+    warm = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "mxnet_tpu",
+         "--cache", cache],
+        cwd=str(REPO), capture_output=True, text=True)
+    warm_s = time.monotonic() - t0
+    assert warm.returncode == 0, warm.stdout + warm.stderr
+    assert warm_s < 5.0, "warm (cached) lint took %.1fs" % warm_s
+
+
+def test_write_baseline_preserves_justifications(tmp_path, capsys):
+    bad = tmp_path / "v.py"
+    bad.write_text("def f(xs):\n    return [x.asnumpy() for x in xs]\n")
+    bl = tmp_path / "bl.json"
+    assert main([str(bad), "--baseline", str(bl), "--write-baseline",
+                 "--cache", str(tmp_path / "c.json")]) == 0
+    counts = load_baseline(bl)
+    (key,) = counts
+    core.write_baseline_counts(counts, bl, justifications={key: "because"})
+    assert core.load_justifications(bl) == {key: "because"}
+    # a rewrite keeps the surviving entry's justification
+    capsys.readouterr()
+    assert main([str(bad), "--baseline", str(bl), "--write-baseline",
+                 "--cache", str(tmp_path / "c.json")]) == 0
+    assert core.load_justifications(bl) == {key: "because"}
+
+
+def test_lint_sources_duplicate_relpath_does_not_crash():
+    # lint_sources is the documented multi-file entry point; duplicate
+    # relpaths must not crash the graph build's ordering
+    pairs = [("mxnet_tpu/x.py", "def f(xs):\n    return [x.item() for x in xs]\n"),
+             ("mxnet_tpu/x.py", "def g():\n    return 1\n")]
+    found = lint_sources(pairs, passes=["host-sync"])
+    assert len(found) == 1
+
+
+def test_cache_prunes_entries_for_deleted_files(tmp_path):
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text("X = 1\n")
+    b.write_text("Y = 2\n")
+    path = tmp_path / "c.json"
+    lint_files([a, b], root=tmp_path, cache=LintCache(path))
+    b.unlink()
+    lint_files([a], root=tmp_path, cache=LintCache(path))
+    import json as _json
+    entries = _json.loads(path.read_text())["files"]
+    assert "a.py" in entries and "b.py" not in entries
+
+
+def test_use_after_donate_intermediate_introspection_not_a_consumer():
+    # len()/logging touching a prep'd name first must NOT open the
+    # donation window (and must not steal the consumer's identity)
+    assert lint("""
+        def ok(flat_ws, buckets, fn, log):
+            argnums, consumed = donation_prep(flat_ws, buckets)
+            n = len(flat_ws)
+            log.debug("packing %d", n)
+            new_ws = fn(flat_ws, buckets)
+            return new_ws
+    """, "use-after-donate") == []
+    # the real consumer still opens it
+    f = lint("""
+        def stale(flat_ws, buckets, fn):
+            argnums, consumed = donation_prep(flat_ws, buckets)
+            n = len(flat_ws)
+            new_ws = fn(flat_ws, buckets)
+            return flat_ws[0]
+    """, "use-after-donate")
+    assert len(f) == 1 and "`flat_ws`" in f[0].message
+
+
+def test_use_after_donate_same_statement_read_after_call():
+    # positional order approximates evaluation order: a read AFTER the
+    # donating call in one statement is stale...
+    f = lint("""
+        def bad(opt, idx, g, weights, states):
+            out = fused_apply(opt, idx, g, weights, states) + weights[0]
+            return out
+    """, "use-after-donate")
+    assert len(f) == 1 and "`weights`" in f[0].message
+    # ...a read BEFORE it is not
+    assert lint("""
+        def ok(opt, idx, g, weights, states):
+            out = weights[0] + fused_apply(opt, idx, g, weights, states)
+            return out
+    """, "use-after-donate") == []
+
+
+def test_project_scope_gives_changed_only_cross_file_context(tmp_path):
+    # --changed-only semantics: report only changed files, but keep the
+    # full scope as graph context so cross-file traced seeds still reach
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    helpers = pkg / "helpers.py"
+    steps = pkg / "steps.py"
+    helpers.write_text("def helper(x):\n    return x.asnumpy()\n")
+    steps.write_text("import jax\n"
+                     "from mxnet_tpu.helpers import helper\n\n"
+                     "@jax.jit\n"
+                     "def step(x):\n"
+                     "    return helper(x)\n")
+    # changed file alone: no seed visible, false clean
+    alone = lint_files([helpers], root=tmp_path,
+                       passes=["traced-host-sync"])
+    assert alone == []
+    # with the unchanged file as graph context: the hazard is visible,
+    # and findings still come only from the changed file
+    ctxd = lint_files([helpers], root=tmp_path, passes=["traced-host-sync"],
+                      project_scope=[helpers, steps])
+    assert len(ctxd) == 1 and ctxd[0].path == "mxnet_tpu/helpers.py"
+
+
+def test_cli_stats_emitted_with_write_baseline(tmp_path, capsys):
+    bad = tmp_path / "v.py"
+    bad.write_text("def f(xs):\n    return [x.asnumpy() for x in xs]\n")
+    assert main([str(bad), "--write-baseline", "--stats",
+                 "--baseline", str(tmp_path / "bl.json"),
+                 "--cache", str(tmp_path / "c.json")]) == 0
+    assert "tpulint --stats:" in capsys.readouterr().err

@@ -1,0 +1,164 @@
+"""File-hash-keyed incremental result cache for tpulint.
+
+The tier-1 gate runs the full linter on every test invocation; with the
+whole-program layer (parse every file, build the call graph, propagate
+two lattices) a from-scratch run costs seconds. The cache keeps the gate
+negligible:
+
+- **local passes** (one file in, findings out) are keyed by the file's
+  content hash — an unchanged file never re-runs them;
+- **project passes** (interprocedural: need the cross-file lattices) are
+  additionally keyed by a *scope signature* — the hash of every file in
+  the linted scope — because an edit anywhere can change reachability
+  everywhere. Unchanged scope → every project result is a hit and the
+  graph is never built (the warm run does hashing + JSON only);
+- the whole cache is versioned by a hash of the linter's own sources
+  (:data:`LINT_SOURCE_VERSION`), so editing a pass invalidates stale
+  results without a manual version bump.
+
+Cached findings are stored *post-suppression* (suppression comments live
+in the hashed file content, so a hit is exact). Writes are atomic
+(tmp + ``os.replace``) — concurrent runs at worst lose an update, never
+corrupt the file.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Finding
+
+DEFAULT_CACHE_PATH = Path(__file__).resolve().parent.parent.parent \
+    / ".tpulint-cache.json"
+
+
+def _source_version() -> str:
+    """Hash of the linter's own source files — any edit to core, graph,
+    cache or a pass invalidates every cached result."""
+    h = hashlib.sha256()
+    pkg = Path(__file__).resolve().parent
+    for p in sorted(pkg.rglob("*.py")):
+        h.update(p.as_posix().encode())
+        try:
+            h.update(p.read_bytes())
+        except OSError:
+            pass
+    return h.hexdigest()[:16]
+
+
+LINT_SOURCE_VERSION = _source_version()
+
+
+def file_sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()[:16]
+
+
+def scope_signature(shas: Sequence[Tuple[str, str]]) -> str:
+    """Signature of a whole lint scope: ``(relpath, sha)`` of every file,
+    order-independent."""
+    h = hashlib.sha256()
+    h.update(LINT_SOURCE_VERSION.encode())
+    for rel, sha in sorted(shas):
+        h.update(rel.encode())
+        h.update(sha.encode())
+    return h.hexdigest()[:16]
+
+
+def _finding_to_dict(f: Finding) -> dict:
+    return f.as_dict()
+
+
+def _finding_from_dict(d: dict) -> Finding:
+    return Finding(d["rule"], d["path"], d["line"], d["col"], d["message"])
+
+
+class LintCache:
+    """On-disk cache of per-(file, pass) findings."""
+
+    def __init__(self, path: Path = DEFAULT_CACHE_PATH):
+        self.path = Path(path)
+        self.hits = 0
+        self.misses = 0
+        self._dirty = False
+        self._entries: Dict[str, dict] = {}
+        try:
+            data = json.loads(self.path.read_text(encoding="utf-8"))
+            if data.get("version") == LINT_SOURCE_VERSION:
+                self._entries = data.get("files", {})
+        except (OSError, ValueError):
+            pass
+
+    # -- local passes -------------------------------------------------------
+
+    def get_local(self, relpath: str, sha: str,
+                  pass_name: str) -> Optional[List[Finding]]:
+        ent = self._entries.get(relpath)
+        if ent and ent.get("sha") == sha and pass_name in ent.get("local", {}):
+            self.hits += 1
+            return [_finding_from_dict(d) for d in ent["local"][pass_name]]
+        self.misses += 1
+        return None
+
+    def put_local(self, relpath: str, sha: str, pass_name: str,
+                  findings: Sequence[Finding]) -> None:
+        ent = self._fresh_entry(relpath, sha)
+        ent.setdefault("local", {})[pass_name] = \
+            [_finding_to_dict(f) for f in findings]
+        self._dirty = True
+
+    # -- project (interprocedural) passes -----------------------------------
+
+    def get_project(self, relpath: str, sha: str, scope_sig: str,
+                    pass_name: str) -> Optional[List[Finding]]:
+        ent = self._entries.get(relpath)
+        if ent and ent.get("sha") == sha and ent.get("scope_sig") == scope_sig \
+                and pass_name in ent.get("project", {}):
+            self.hits += 1
+            return [_finding_from_dict(d) for d in ent["project"][pass_name]]
+        self.misses += 1
+        return None
+
+    def put_project(self, relpath: str, sha: str, scope_sig: str,
+                    pass_name: str, findings: Sequence[Finding]) -> None:
+        ent = self._fresh_entry(relpath, sha)
+        if ent.get("scope_sig") != scope_sig:
+            ent["scope_sig"] = scope_sig
+            ent["project"] = {}
+        ent.setdefault("project", {})[pass_name] = \
+            [_finding_to_dict(f) for f in findings]
+        self._dirty = True
+
+    def _fresh_entry(self, relpath: str, sha: str) -> dict:
+        ent = self._entries.get(relpath)
+        if ent is None or ent.get("sha") != sha:
+            ent = {"sha": sha}
+            self._entries[relpath] = ent
+        return ent
+
+    def save(self, root: Optional[Path] = None) -> None:
+        # prune entries whose file no longer exists under the lint root
+        # (deleted/renamed — keeps the cache from growing monotonically
+        # across refactors); out-of-scope but LIVE files are deliberately
+        # kept, so a narrowed run never evicts the full-scope cache
+        if root is not None:
+            for rel in list(self._entries):
+                p = Path(rel) if os.path.isabs(rel) else Path(root) / rel
+                if not p.exists():
+                    del self._entries[rel]
+                    self._dirty = True
+        if not self._dirty:
+            return
+        payload = {"version": LINT_SOURCE_VERSION, "files": self._entries}
+        tmp = "%s.tmp.%d" % (self.path, os.getpid())
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh)
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass

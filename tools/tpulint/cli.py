@@ -10,11 +10,12 @@ import sys
 from pathlib import Path
 from typing import List, Optional, Sequence, Tuple
 
+from .cache import DEFAULT_CACHE_PATH, LintCache
 from .core import (DEFAULT_BASELINE, DEFAULT_ROOTS, REPO_ROOT, Finding,
                    all_passes, apply_baseline, baseline_counts, collect_files,
-                   key_scope, lint_files, load_baseline, relpath_of,
-                   write_baseline_counts)
-from .reporters import render_json, render_text
+                   key_scope, lint_files, load_baseline, load_justifications,
+                   relpath_of, write_baseline_counts)
+from .reporters import render_json, render_stats, render_text
 
 
 def changed_files(root: Path = REPO_ROOT) -> Optional[List[str]]:
@@ -72,6 +73,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="comma-separated subset of rules to run")
     parser.add_argument("--list-rules", action="store_true",
                         help="list available rules and exit")
+    parser.add_argument("--stats", action="store_true",
+                        help="print per-pass timing and cache hit rate")
+    parser.add_argument("--cache", type=Path, default=DEFAULT_CACHE_PATH,
+                        metavar="PATH",
+                        help="incremental cache file (default: "
+                             ".tpulint-cache.json at the repo root)")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="run every pass from scratch, don't touch the cache")
     args = parser.parse_args(argv)
 
     registry = all_passes()
@@ -98,18 +107,36 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 2
 
     files = collect_files(args.paths)
+    project_scope = None
     if args.changed_only:
         changed = changed_files()
         if changed is None:
             print("tpulint: --changed-only requires a working `git diff`; "
                   "run on explicit paths instead", file=sys.stderr)
             return 2
+        # report only on changed files, but keep the WHOLE collected
+        # scope as graph context: a traced/thread seed in an unchanged
+        # file must still reach a hazard in a changed one
+        project_scope = files
         files = filter_to_scope(changed, files)
         if not files:
             print("tpulint: no changed files in scope")
             return 0
 
-    findings = lint_files(files, passes=passes)
+    import time
+
+    t0 = time.perf_counter()
+    cache = None if args.no_cache else LintCache(args.cache)
+    stats: dict = {}
+    findings = lint_files(files, passes=passes, cache=cache, stats=stats,
+                          project_scope=project_scope)
+    stats["total_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+
+    def emit_stats():
+        if args.stats:
+            # stderr: --format json consumers must keep a parseable stdout
+            print(render_stats(stats), file=sys.stderr)
+
     counts = baseline_counts(findings)
     # Scope actually covered by this run: baseline keys outside it (files
     # not linted, rules not selected) carry no evidence either way.
@@ -125,10 +152,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         for k, v in load_baseline(args.baseline).items():
             if not in_scope(k):  # narrowed run must not drop other entries
                 merged[k] = v
-        write_baseline_counts(merged, args.baseline)
+        # keep each surviving entry's one-line justification
+        write_baseline_counts(merged, args.baseline,
+                              justifications=load_justifications(args.baseline))
         print("tpulint: wrote %d finding(s) to %s (%d kept from outside this "
               "run's scope)" % (sum(merged.values()), args.baseline,
                                sum(merged.values()) - len(findings)))
+        emit_stats()
         return 0
 
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
@@ -137,6 +167,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     render = render_json if args.format == "json" else render_text
     print(render(new, len(findings), len(findings) - len(new), stale))
+    emit_stats()
     return 1 if new else 0
 
 

@@ -76,6 +76,10 @@ class FileContext:
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=filename)
         attach_parents(self.tree)
+        #: the whole-program :class:`tools.tpulint.graph.ProjectGraph` when
+        #: this file is linted as part of a project scope (None for a
+        #: lone-snippet lint); project passes read their lattices from it.
+        self.project = None
         self._suppressions: Dict[int, set] = {}
         for lineno, line in enumerate(self.lines, 1):
             m = _SUPPRESS_RE.search(line)
@@ -245,10 +249,15 @@ def in_jit(node: ast.AST, jitted: set) -> bool:
 class Pass:
     """One analysis. Subclasses set ``name``/``description`` and implement
     :meth:`run`; ``applies`` restricts a pass to part of the tree (e.g.
-    env-knob only polices the framework package, not user-facing tools)."""
+    env-knob only polices the framework package, not user-facing tools).
+    ``project = True`` marks an *interprocedural* pass: it reads the
+    whole-program lattices from ``ctx.project`` and its results depend on
+    every file in the lint scope (the incremental cache keys them by the
+    scope signature, not just the file hash)."""
 
     name = ""
     description = ""
+    project = False
 
     def applies(self, relpath: str) -> bool:
         return True
@@ -303,45 +312,191 @@ def relpath_of(path: Path, root: Path = REPO_ROOT) -> str:
         return path.as_posix()
 
 
-def lint_source(relpath: str, source: str,
-                passes: Optional[Sequence[str]] = None) -> List[Finding]:
-    """Lint one in-memory source blob; returns suppression-filtered findings."""
-    registry = all_passes()
-    names = passes if passes is not None else sorted(registry)
-    ctx = FileContext(relpath, source, filename=relpath)
-    findings: List[Finding] = []
-    for name in names:
-        p = registry[name]
-        if not p.applies(relpath):
-            continue
+def _run_pass(ctx: FileContext, p: Pass) -> List[Finding]:
+    """Run one pass on one file, suppression-filtered and sorted."""
+    out: List[Finding] = []
+    if p.applies(ctx.relpath):
         for f in p.run(ctx):
             if not ctx.suppressed(f.rule, f.line):
-                findings.append(f)
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def _parse_error(rel: str, exc: BaseException) -> Finding:
+    if isinstance(exc, SyntaxError):
+        return Finding("parse-error", rel, exc.lineno or 1, 0,
+                       "file does not parse: %s" % exc.msg)
+    if isinstance(exc, UnicodeDecodeError):
+        return Finding("parse-error", rel, 1, 0,
+                       "file is not UTF-8: %s" % exc.reason)
+    return Finding("parse-error", rel, 1, 0, "file does not parse: %s" % exc)
+
+
+def lint_sources(pairs: Sequence[tuple],
+                 passes: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint in-memory ``(relpath, source)`` blobs as ONE project scope:
+    all files join the same symbol table / call graph, so interprocedural
+    passes see cross-file reachability. Returns suppression-filtered
+    findings. (The multi-file entry point for tests and tools; the CLI
+    path goes through :func:`lint_files`.)"""
+    from . import graph as graph_mod
+
+    registry = all_passes()
+    names = list(passes) if passes is not None else sorted(registry)
+    contexts: List[FileContext] = []
+    findings: List[Finding] = []
+    for relpath, source in pairs:
+        try:
+            contexts.append(FileContext(relpath, source, filename=relpath))
+        except (SyntaxError, ValueError) as exc:
+            findings.append(_parse_error(relpath, exc))
+    project = graph_mod.build_graph([(c.relpath, c.tree) for c in contexts])
+    for ctx in contexts:
+        ctx.project = project
+        for name in names:
+            findings.extend(_run_pass(ctx, registry[name]))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 
+def lint_source(relpath: str, source: str,
+                passes: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint one in-memory source blob; returns suppression-filtered
+    findings. Interprocedural passes see a single-file project graph."""
+    return lint_sources([(relpath, source)], passes=passes)
+
+
 def lint_files(files: Sequence[Path], root: Path = REPO_ROOT,
-               passes: Optional[Sequence[str]] = None) -> List[Finding]:
+               passes: Optional[Sequence[str]] = None,
+               cache=None, stats: Optional[Dict] = None,
+               project_scope: Optional[Sequence[Path]] = None,
+               ) -> List[Finding]:
+    """Lint files from disk as one project scope.
+
+    ``cache`` is an optional :class:`tools.tpulint.cache.LintCache`:
+    local-pass results are reused per file hash, interprocedural results
+    per (file hash, scope signature) — an unchanged scope runs no pass
+    and never parses a file. ``stats`` (a dict, filled in place) collects
+    per-pass timings and cache hit counts for ``--stats``.
+
+    ``project_scope`` widens the symbol-table/call-graph scope beyond the
+    reported files: findings come only from ``files``, but the context
+    lattices (and the cache's scope signature) are computed over the
+    union — so a ``--changed-only`` run still sees traced/thread seeds
+    living in unchanged files, and its project results share cache
+    entries with the full run.
+    """
+    import time
+
+    from . import graph as graph_mod
+    from .cache import file_sha, scope_signature
+
+    registry = all_passes()
+    names = list(passes) if passes is not None else sorted(registry)
+    local_names = [n for n in names if not registry[n].project]
+    project_names = [n for n in names if registry[n].project]
+    stats = stats if stats is not None else {}
+    pass_ms = stats.setdefault("pass_ms", {})
     findings: List[Finding] = []
-    for path in files:
+
+    # 1. read + hash every file in scope
+    def read_blob(path, report_errors):
         rel = relpath_of(path, root)
         try:
-            source = path.read_text(encoding="utf-8")
+            raw = path.read_bytes()
+            return (rel, raw.decode("utf-8"), file_sha(raw))
         except OSError:
-            continue
+            return None
         except UnicodeDecodeError as exc:
-            findings.append(Finding("parse-error", rel, 1, 0,
-                                    "file is not UTF-8: %s" % exc.reason))
+            if report_errors:
+                findings.append(_parse_error(rel, exc))
+            return None
+
+    blobs: List[tuple] = []  # (rel, source, sha) — the files we REPORT on
+    for path in files:
+        blob = read_blob(path, report_errors=True)
+        if blob is not None:
+            blobs.append(blob)
+    reported = {rel for rel, _s, _h in blobs}
+    extra_blobs: List[tuple] = []  # graph-only context, never reported
+    for path in project_scope or ():
+        blob = read_blob(path, report_errors=False)
+        if blob is not None and blob[0] not in reported:
+            extra_blobs.append(blob)
+    stats["files"] = len(blobs)
+    scope_sig = scope_signature(
+        [(rel, sha) for rel, _s, sha in blobs + extra_blobs])
+
+    # 2. consult the cache; decide what must actually run
+    todo: Dict[str, List[str]] = {}  # rel -> pass names to run
+    for rel, _source, sha in blobs:
+        for name in local_names:
+            hit = cache.get_local(rel, sha, name) if cache is not None else None
+            if hit is None:
+                todo.setdefault(rel, []).append(name)
+            else:
+                findings.extend(hit)
+        for name in project_names:
+            hit = (cache.get_project(rel, sha, scope_sig, name)
+                   if cache is not None else None)
+            if hit is None:
+                todo.setdefault(rel, []).append(name)
+            else:
+                findings.extend(hit)
+
+    # 3. parse what's needed: files with work, plus — when any
+    # interprocedural pass must run anywhere — the WHOLE scope including
+    # graph-only context files (the lattices are only sound over all of it)
+    need_graph = project_names and any(
+        any(n in project_names for n in ns) for ns in todo.values())
+    t0 = time.perf_counter()
+    contexts: Dict[str, FileContext] = {}
+    for rel, source, sha in blobs + (extra_blobs if need_graph else []):
+        if rel not in todo and not need_graph:
             continue
         try:
-            findings.extend(lint_source(rel, source, passes=passes))
-        except SyntaxError as exc:
-            findings.append(Finding("parse-error", rel, exc.lineno or 1, 0,
-                                    "file does not parse: %s" % exc.msg))
-        except ValueError as exc:  # e.g. null bytes in source
-            findings.append(Finding("parse-error", rel, 1, 0,
-                                    "file does not parse: %s" % exc))
+            contexts[rel] = FileContext(rel, source, filename=rel)
+        except (SyntaxError, ValueError) as exc:
+            if rel in todo:
+                findings.append(_parse_error(rel, exc))
+                del todo[rel]
+    stats["parse_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+
+    project = None
+    if need_graph:
+        t0 = time.perf_counter()
+        project = graph_mod.build_graph(
+            [(c.relpath, c.tree) for c in contexts.values()])
+        for ctx in contexts.values():
+            ctx.project = project
+        stats["graph_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+
+    # 4. run the missing (file, pass) pairs; store results back
+    sha_of = {rel: sha for rel, _s, sha in blobs}
+    for rel in sorted(todo):
+        ctx = contexts.get(rel)
+        if ctx is None:
+            continue
+        for name in todo[rel]:
+            p = registry[name]
+            t0 = time.perf_counter()
+            result = _run_pass(ctx, p)
+            pass_ms[name] = pass_ms.get(name, 0.0) \
+                + (time.perf_counter() - t0) * 1000
+            findings.extend(result)
+            if cache is not None:
+                if p.project:
+                    cache.put_project(rel, sha_of[rel], scope_sig, name, result)
+                else:
+                    cache.put_local(rel, sha_of[rel], name, result)
+
+    if cache is not None:
+        cache.save(root=root)
+        stats["cache_hits"] = cache.hits
+        stats["cache_misses"] = cache.misses
+    for name, ms in list(pass_ms.items()):
+        pass_ms[name] = round(ms, 1)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -361,8 +516,13 @@ def write_baseline(findings: Sequence[Finding], path: Path) -> None:
     write_baseline_counts(baseline_counts(findings), path)
 
 
-def write_baseline_counts(counts: Dict[str, int], path: Path) -> None:
+def write_baseline_counts(counts: Dict[str, int], path: Path,
+                          justifications: Optional[Dict[str, str]] = None,
+                          ) -> None:
     data = {"version": 1, "counts": dict(sorted(counts.items()))}
+    justs = {k: v for k, v in (justifications or {}).items() if k in counts}
+    if justs:
+        data["justifications"] = dict(sorted(justs.items()))
     path.write_text(json.dumps(data, indent=2, sort_keys=True) + "\n",
                     encoding="utf-8")
 
@@ -378,6 +538,15 @@ def load_baseline(path: Path) -> Dict[str, int]:
         return {}
     data = json.loads(path.read_text(encoding="utf-8"))
     return {str(k): int(v) for k, v in data.get("counts", {}).items()}
+
+
+def load_justifications(path: Path) -> Dict[str, str]:
+    """The optional per-entry one-line justifications riding next to the
+    baseline counts (same keys)."""
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return {str(k): str(v) for k, v in data.get("justifications", {}).items()}
 
 
 def apply_baseline(findings: Sequence[Finding],

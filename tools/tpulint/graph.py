@@ -1,0 +1,572 @@
+"""tpulint whole-program layer: symbol table, call graph, context lattices.
+
+The 11 file-local passes see one :class:`~tools.tpulint.core.FileContext`
+at a time, so any hazard that crosses a function call is invisible to
+them: a host sync buried two frames below a traced ``_leaf_step``, a
+field mutated on the serving worker thread and read by the caller, a
+``get_env(cache=False)`` re-read reached from inside a jit trace. This
+module builds the project-wide structure those hazards live in:
+
+- a **symbol table** per module (top-level functions, classes with their
+  methods, ``import``/``from-import`` aliases, relative imports resolved
+  against the package path derived from the file's repo-relative path);
+- a **call graph**: for every function (top-level, method, nested def,
+  lambda) the set of project functions it calls, resolved through local
+  scopes, module scope, import aliases, ``self.``/``cls.``/``Class.``
+  method binding (including base classes by name), and dotted
+  module-attribute chains;
+- two **context lattices** propagated over that graph with a bounded
+  depth (:data:`DEFAULT_DEPTH` — the recursion/blow-up cutoff):
+
+  * **traced context** — functions whose bodies run under jax tracing:
+    seeded at ``jax.jit``/``pl.pallas_call`` wrap sites (including
+    factory calls ``jax.jit(self._build_step(...))``, which seed the
+    nested functions the factory *returns*) and at the framework's
+    known kernel entry points (``_leaf_step``, ``tree_kernel``), then
+    closed over call edges — tracing inlines the whole call tree;
+  * **thread context** — functions that run off the main thread: seeded
+    at ``threading.Thread(target=...)`` sites, ``run`` methods of
+    ``threading.Thread`` subclasses (the telemetry Emitter), and
+    callbacks pushed onto the host engine (``engine.push(fn)``,
+    ``self._engine.push(fn)`` — the elastic async-checkpoint commit
+    path), then closed over call edges.
+
+Pure stdlib ``ast`` — no JAX import, no device work. Resolution is
+deliberately *conservative*: an attribute call on an object of unknown
+type resolves to nothing rather than to every same-named method in the
+project, so context never spreads through a speculative edge. The cost
+is under-approximation (a hazard behind a duck-typed call is missed);
+the gate's job is to make the common hazard shapes impossible, not to
+prove the program race-free.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import dotted_name, jit_functions
+
+#: Propagation/search depth bound: call chains longer than this from a
+#: seed are not marked (recursion and adversarial chains cut off here).
+DEFAULT_DEPTH = 10
+
+#: Functions that are traced by construction — the per-param optimizer
+#: kernel every fused/graph-plane jit traces, and the shared whole-tree
+#: kernel both step compilers consume.
+TRACED_SEED_NAMES = ("_leaf_step", "tree_kernel")
+
+_JIT_TAILS = {"jit", "pjit", "filter_jit"}
+_PALLAS_TAILS = {"pallas_call"}
+_THREAD_TAILS = {"Thread"}
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_ANY_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def module_name_of(relpath: str) -> str:
+    """``mxnet_tpu/fastpath/fused.py`` → ``mxnet_tpu.fastpath.fused``;
+    ``pkg/__init__.py`` → ``pkg``."""
+    parts = relpath[:-3].split("/") if relpath.endswith(".py") else relpath.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class FuncInfo:
+    """One function in the project graph (top-level, method, nested def
+    or lambda)."""
+
+    __slots__ = ("qname", "relpath", "module", "node", "name", "cls",
+                 "callees", "returned_inner")
+
+    def __init__(self, qname: str, relpath: str, module: str,
+                 node: ast.AST, name: str, cls: Optional[str]):
+        self.qname = qname
+        self.relpath = relpath
+        self.module = module
+        self.node = node
+        self.name = name
+        self.cls = cls
+        self.callees: List["FuncInfo"] = []
+        self.returned_inner: List["FuncInfo"] = []
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "FuncInfo(%s)" % self.qname
+
+
+class ClassInfo:
+    __slots__ = ("name", "qname", "module", "node", "base_names", "methods")
+
+    def __init__(self, name: str, qname: str, module: str, node: ast.ClassDef):
+        self.name = name
+        self.qname = qname
+        self.module = module
+        self.node = node
+        self.base_names: List[str] = []
+        self.methods: Dict[str, FuncInfo] = {}
+
+
+class _ModuleInfo:
+    __slots__ = ("relpath", "module", "tree", "top", "is_pkg")
+
+    def __init__(self, relpath: str, module: str, tree: ast.AST):
+        self.relpath = relpath
+        self.module = module
+        self.tree = tree
+        self.is_pkg = relpath.endswith("/__init__.py") \
+            or relpath == "__init__.py"
+        # name -> FuncInfo | ClassInfo | ("mod", module_name)
+        #                  | ("sym", module_name, symbol_name)
+        self.top: Dict[str, object] = {}
+
+
+def _own_nodes(fn_node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's body WITHOUT descending into nested function
+    definitions — those are separate graph nodes with their own edges."""
+    body = fn_node.body if isinstance(fn_node, _FUNC_DEFS) else [fn_node.body]
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _ANY_FUNC):
+                continue
+            stack.append(child)
+
+
+class ProjectGraph:
+    """Symbol table + call graph + context lattices over a file set."""
+
+    def __init__(self, files: Sequence[Tuple[str, ast.AST]],
+                 depth: int = DEFAULT_DEPTH):
+        self.depth = depth
+        self.modules: Dict[str, _ModuleInfo] = {}
+        self.funcs: Dict[ast.AST, FuncInfo] = {}       # def node -> info
+        self.classes_by_name: Dict[str, List[ClassInfo]] = {}
+        self._locals: Dict[Tuple[ast.AST, str], FuncInfo] = {}
+        self._traced: Dict[ast.AST, Tuple[FuncInfo, Optional[FuncInfo], int]] = {}
+        self._threaded: Dict[ast.AST, Tuple[FuncInfo, Optional[FuncInfo], int]] = {}
+
+        # key on relpath only: trees don't compare, and duplicate relpaths
+        # (possible through lint_sources) must not crash the sort
+        ordered = sorted(files, key=lambda pair: pair[0])
+        for relpath, tree in ordered:
+            self._index_file(relpath, tree)
+        for relpath, tree in ordered:
+            self._build_edges(relpath, tree)
+        traced_seeds, thread_seeds = self._collect_seeds(ordered)
+        self._traced = self._propagate(traced_seeds)
+        self._threaded = self._propagate(thread_seeds)
+
+    # -- indexing -----------------------------------------------------------
+
+    def _index_file(self, relpath: str, tree: ast.AST) -> None:
+        module = module_name_of(relpath)
+        minfo = _ModuleInfo(relpath, module, tree)
+        self.modules[module] = minfo
+
+        def add_func(node, name, cls, prefix):
+            qname = "%s::%s" % (relpath, prefix + name)
+            info = FuncInfo(qname, relpath, module, node, name, cls)
+            self.funcs[node] = info
+            return info
+
+        def index_body(body, cls, prefix, owner_top):
+            for node in body:
+                if isinstance(node, _FUNC_DEFS):
+                    info = add_func(node, node.name, cls, prefix)
+                    if owner_top is not None:
+                        owner_top[node.name] = info
+                    self._index_nested(node, prefix + node.name + ".")
+                elif isinstance(node, ast.ClassDef):
+                    cinfo = ClassInfo(node.name, "%s::%s" % (relpath, node.name),
+                                      module, node)
+                    for base in node.bases:
+                        d = dotted_name(base)
+                        if d:
+                            cinfo.base_names.append(d.rsplit(".", 1)[-1])
+                    if owner_top is not None:
+                        owner_top[node.name] = cinfo
+                    self.classes_by_name.setdefault(node.name, []).append(cinfo)
+                    for sub in node.body:
+                        if isinstance(sub, _FUNC_DEFS):
+                            m = add_func(sub, sub.name, node.name,
+                                         prefix + node.name + ".")
+                            cinfo.methods[sub.name] = m
+                            self._index_nested(
+                                sub, prefix + node.name + "." + sub.name + ".")
+                elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                    self._index_import(minfo, node)
+                elif isinstance(node, (ast.If, ast.Try)):
+                    # conditionally-defined top-level symbols (compat shims)
+                    for sub_body in _stmt_bodies(node):
+                        index_body(sub_body, cls, prefix, owner_top)
+
+        index_body(tree.body, None, "", minfo.top)
+
+    def _index_nested(self, fn_node: ast.AST, prefix: str) -> None:
+        """Nested defs/lambdas inside a function: graph nodes + local-scope
+        bindings keyed by their *enclosing* function node."""
+        minfo_mod = self.funcs[fn_node].module
+        relpath = self.funcs[fn_node].relpath
+        counter = [0]
+
+        def visit(owner, body, pfx):
+            for node in _iter_direct(body):
+                if isinstance(node, _FUNC_DEFS):
+                    qname = "%s::%s" % (relpath, pfx + node.name)
+                    info = FuncInfo(qname, relpath, minfo_mod, node,
+                                    node.name, self.funcs[fn_node].cls)
+                    self.funcs[node] = info
+                    self._locals[(owner, node.name)] = info
+                    visit(node, node.body, pfx + node.name + ".")
+                elif isinstance(node, ast.Lambda):
+                    counter[0] += 1
+                    qname = "%s::%s<lambda%d>" % (relpath, pfx, counter[0])
+                    info = FuncInfo(qname, relpath, minfo_mod, node,
+                                    "<lambda>", self.funcs[fn_node].cls)
+                    self.funcs[node] = info
+                    visit(node, [node.body], pfx)
+
+        visit(fn_node, fn_node.body, prefix)
+
+    def _index_import(self, minfo: _ModuleInfo, node) -> None:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    minfo.top[alias.asname] = ("mod", alias.name)
+                else:
+                    # `import a.b` binds `a`; the resolver walks the chain
+                    minfo.top[alias.name.split(".")[0]] = \
+                        ("mod", alias.name.split(".")[0])
+        else:  # ImportFrom
+            if node.level:
+                # level=1 resolves against the module's own package: for
+                # `pkg/mod.py` that strips the module name, but for a
+                # package `pkg/__init__.py` the module name IS the
+                # package — strip nothing; each extra level strips one
+                # more package
+                pkg_parts = minfo.module.split(".")
+                if not minfo.is_pkg:
+                    pkg_parts = pkg_parts[:-1]
+                cut = node.level - 1
+                base = pkg_parts[:len(pkg_parts) - cut] if cut else pkg_parts
+                target = ".".join(base + ([node.module] if node.module else []))
+            else:
+                target = node.module or ""
+            for alias in node.names:
+                bound = alias.asname or alias.name
+                if alias.name == "*":
+                    continue
+                full = ("%s.%s" % (target, alias.name)) if target else alias.name
+                if full in self.modules:
+                    minfo.top[bound] = ("mod", full)
+                else:
+                    minfo.top[bound] = ("sym", target, alias.name)
+
+    # -- resolution ---------------------------------------------------------
+
+    def _resolve_in_module(self, module: str, name: str,
+                           _depth: int = 0) -> Optional[object]:
+        """A top-level symbol of `module`, following from-import aliases
+        up to a small re-export depth."""
+        minfo = self.modules.get(module)
+        if minfo is None or _depth > 4:
+            return None
+        ent = minfo.top.get(name)
+        if isinstance(ent, tuple) and ent[0] == "sym":
+            sub = self._resolve_in_module(ent[1], ent[2], _depth + 1)
+            return sub if sub is not None else ent
+        return ent
+
+    def _method_of(self, cinfo: ClassInfo, name: str,
+                   _depth: int = 0) -> Optional[FuncInfo]:
+        """Method lookup through the by-name base-class chain (same-module
+        base preferred; bounded against cycles)."""
+        if name in cinfo.methods:
+            return cinfo.methods[name]
+        if _depth >= 6:
+            return None
+        for base in cinfo.base_names:
+            cands = self.classes_by_name.get(base, ())
+            same = [c for c in cands if c.module == cinfo.module]
+            for cand in same or cands:
+                found = self._method_of(cand, name, _depth + 1)
+                if found is not None:
+                    return found
+        return None
+
+    def _resolve_ref(self, minfo: _ModuleInfo, cls: Optional[str],
+                     fstack: Sequence[ast.AST], expr: ast.AST,
+                     as_call: bool) -> List[FuncInfo]:
+        """Resolve a Name/Attribute reference to project function(s).
+        ``as_call=True`` maps a class to its ``__init__``."""
+        def from_entity(ent) -> List[FuncInfo]:
+            if isinstance(ent, FuncInfo):
+                return [ent]
+            if isinstance(ent, ClassInfo):
+                if as_call:
+                    init = self._method_of(ent, "__init__")
+                    return [init] if init is not None else []
+                return []
+            return []
+
+        if isinstance(expr, ast.Name):
+            for owner in reversed(fstack):
+                hit = self._locals.get((owner, expr.id))
+                if hit is not None:
+                    return [hit]
+            ent = self._resolve_in_module(minfo.module, expr.id)
+            if isinstance(ent, tuple):
+                if ent[0] == "sym":
+                    return from_entity(
+                        self._resolve_in_module(ent[1], ent[2]))
+                return []
+            return from_entity(ent)
+
+        dotted = dotted_name(expr)
+        if not dotted:
+            return []
+        parts = dotted.split(".")
+        head, rest = parts[0], parts[1:]
+
+        if head in ("self", "cls") and cls is not None and len(rest) == 1:
+            for cinfo in self.classes_by_name.get(cls, ()):
+                if cinfo.module != minfo.module:
+                    continue
+                m = self._method_of(cinfo, rest[0])
+                if m is not None:
+                    return [m]
+            return []
+
+        ent = self._resolve_in_module(minfo.module, head)
+        if isinstance(ent, ClassInfo) and len(rest) == 1:
+            m = self._method_of(ent, rest[0])
+            return [m] if m is not None else []
+        if isinstance(ent, tuple) and ent[0] == "mod":
+            # walk the module chain: `a.b.f()` with `import a.b`
+            mod = ent[1]
+            while len(rest) > 1 and ("%s.%s" % (mod, rest[0])) in self.modules:
+                mod = "%s.%s" % (mod, rest[0])
+                rest = rest[1:]
+            if len(rest) == 1:
+                return from_entity(self._resolve_in_module(mod, rest[0]))
+            if len(rest) == 2:
+                sub = self._resolve_in_module(mod, rest[0])
+                if isinstance(sub, ClassInfo):
+                    m = self._method_of(sub, rest[1])
+                    return [m] if m is not None else []
+        return []
+
+    # -- edges --------------------------------------------------------------
+
+    def _build_edges(self, relpath: str, tree: ast.AST) -> None:
+        minfo = self.modules[module_name_of(relpath)]
+        for fn_node, info in list(self.funcs.items()):
+            if info.relpath != relpath:
+                continue
+            fstack = self._enclosing_stack(fn_node)
+            seen: Set[ast.AST] = set()
+            for node in _own_nodes(fn_node):
+                if isinstance(node, ast.Call):
+                    for target in self._resolve_ref(minfo, info.cls, fstack,
+                                                    node.func, as_call=True):
+                        if target.node not in seen:
+                            seen.add(target.node)
+                            info.callees.append(target)
+                elif isinstance(node, ast.Return) and node.value is not None:
+                    for t in self._returned_funcs(fn_node, node.value):
+                        info.returned_inner.append(t)
+
+    def _returned_funcs(self, owner: ast.AST, expr: ast.AST) -> List[FuncInfo]:
+        out = []
+        if isinstance(expr, ast.Name):
+            hit = self._locals.get((owner, expr.id))
+            if hit is not None:
+                out.append(hit)
+        elif isinstance(expr, ast.Tuple):
+            for elt in expr.elts:
+                out.extend(self._returned_funcs(owner, elt))
+        return out
+
+    def _enclosing_stack(self, fn_node: ast.AST) -> List[ast.AST]:
+        """Function nodes lexically enclosing `fn_node` (outer→inner,
+        inclusive) — the scopes local-name resolution may search."""
+        stack: List[ast.AST] = []
+        node = fn_node
+        while node is not None:
+            if isinstance(node, _ANY_FUNC):
+                stack.append(node)
+            node = getattr(node, "tpulint_parent", None)
+        stack.reverse()
+        return stack
+
+    # -- seeds --------------------------------------------------------------
+
+    def _collect_seeds(self, files) -> Tuple[List[FuncInfo], List[FuncInfo]]:
+        traced: List[FuncInfo] = []
+        threaded: List[FuncInfo] = []
+
+        for info in sorted(self.funcs.values(), key=lambda i: i.qname):
+            if info.name in TRACED_SEED_NAMES:
+                traced.append(info)
+
+        for relpath, tree in files:
+            minfo = self.modules[module_name_of(relpath)]
+            # same-file jit closure (decorators, jax.jit(fn), partial wraps)
+            for fn_node in jit_functions(tree):
+                info = self.funcs.get(fn_node)
+                if info is not None:
+                    traced.append(info)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+                owner = self._nearest_func(node)
+                fstack = self._enclosing_stack(node)
+                cls = self.funcs[owner].cls if owner in self.funcs else None
+                if tail in _JIT_TAILS or tail in _PALLAS_TAILS:
+                    if not node.args:
+                        continue
+                    arg0 = node.args[0]
+                    if isinstance(arg0, (ast.Name, ast.Attribute)):
+                        traced.extend(self._resolve_ref(
+                            minfo, cls, fstack, arg0, as_call=False))
+                    elif isinstance(arg0, ast.Call):
+                        # jax.jit(self._build_step(...)): the factory's
+                        # RETURNED nested functions are what gets traced
+                        for factory in self._resolve_ref(
+                                minfo, cls, fstack, arg0.func, as_call=True):
+                            traced.extend(factory.returned_inner)
+                    elif isinstance(arg0, ast.Lambda) and arg0 in self.funcs:
+                        traced.append(self.funcs[arg0])
+                elif tail in _THREAD_TAILS:
+                    for kw in node.keywords:
+                        if kw.arg == "target" and isinstance(
+                                kw.value, (ast.Name, ast.Attribute)):
+                            threaded.extend(self._resolve_ref(
+                                minfo, cls, fstack, kw.value, as_call=False))
+                elif tail == "push" and node.args:
+                    recv = (dotted_name(node.func) or "")[:-len(".push")]
+                    if "engine" in recv.lower():
+                        arg0 = node.args[0]
+                        if isinstance(arg0, (ast.Name, ast.Attribute)):
+                            threaded.extend(self._resolve_ref(
+                                minfo, cls, fstack, arg0, as_call=False))
+                        elif isinstance(arg0, ast.Lambda) and arg0 in self.funcs:
+                            threaded.append(self.funcs[arg0])
+
+        for cands in self.classes_by_name.values():
+            for cinfo in cands:
+                if any(b == "Thread" for b in cinfo.base_names):
+                    run = cinfo.methods.get("run")
+                    if run is not None:
+                        threaded.append(run)
+        return traced, threaded
+
+    def _nearest_func(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = getattr(node, "tpulint_parent", None)
+        while cur is not None:
+            if isinstance(cur, _ANY_FUNC):
+                return cur
+            cur = getattr(cur, "tpulint_parent", None)
+        return None
+
+    # -- lattice propagation ------------------------------------------------
+
+    def _propagate(self, seeds: Sequence[FuncInfo]
+                   ) -> Dict[ast.AST, Tuple[FuncInfo, Optional[FuncInfo], int]]:
+        """BFS closure over call edges, bounded by :attr:`depth`.
+        Value per reached def node: ``(seed, parent, depth)`` — enough to
+        reconstruct a seed→site chain for the finding message."""
+        reached: Dict[ast.AST, Tuple[FuncInfo, Optional[FuncInfo], int]] = {}
+        frontier: List[FuncInfo] = []
+        for seed in sorted(set(seeds), key=lambda i: i.qname):
+            if seed.node not in reached:
+                reached[seed.node] = (seed, None, 0)
+                frontier.append(seed)
+        depth = 0
+        while frontier and depth < self.depth:
+            depth += 1
+            nxt: List[FuncInfo] = []
+            for info in frontier:
+                seed = reached[info.node][0]
+                for callee in info.callees:
+                    if callee.node not in reached:
+                        reached[callee.node] = (seed, info, depth)
+                        nxt.append(callee)
+            frontier = nxt
+        return reached
+
+    # -- queries ------------------------------------------------------------
+
+    def info_of(self, fn_node: ast.AST) -> Optional[FuncInfo]:
+        return self.funcs.get(fn_node)
+
+    def is_traced(self, fn_node: ast.AST) -> bool:
+        return fn_node in self._traced
+
+    def is_threaded(self, fn_node: ast.AST) -> bool:
+        return fn_node in self._threaded
+
+    def _chain(self, table, fn_node) -> Optional[List[str]]:
+        if fn_node not in table:
+            return None
+        names: List[str] = []
+        cur = fn_node
+        while cur is not None:
+            seed, parent_info, _d = table[cur]
+            this = self.funcs.get(cur)
+            if this is not None:
+                names.append(this.name if this.cls is None
+                             else "%s.%s" % (this.cls, this.name))
+            if parent_info is None:
+                break
+            cur = parent_info.node
+        names.reverse()
+        return names
+
+    def traced_chain(self, fn_node: ast.AST) -> Optional[List[str]]:
+        """``[seed, ..., fn]`` names when `fn_node` is in traced context."""
+        return self._chain(self._traced, fn_node)
+
+    def threaded_chain(self, fn_node: ast.AST) -> Optional[List[str]]:
+        """``[entry, ..., fn]`` names when `fn_node` runs on a worker
+        thread."""
+        return self._chain(self._threaded, fn_node)
+
+    def thread_entry(self, fn_node: ast.AST) -> Optional[str]:
+        tup = self._threaded.get(fn_node)
+        if tup is None:
+            return None
+        seed = tup[0]
+        return seed.name if seed.cls is None else "%s.%s" % (seed.cls, seed.name)
+
+
+def _stmt_bodies(node) -> Iterator[list]:
+    for field in ("body", "orelse", "finalbody"):
+        body = getattr(node, field, None)
+        if body:
+            yield body
+    for h in getattr(node, "handlers", ()):
+        yield h.body
+
+
+def _iter_direct(body) -> Iterator[ast.AST]:
+    """All nodes in `body` reachable without crossing a nested function
+    boundary — used to find nested defs/lambdas owned by one function."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _ANY_FUNC):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def build_graph(files: Sequence[Tuple[str, ast.AST]],
+                depth: int = DEFAULT_DEPTH) -> ProjectGraph:
+    """Build a :class:`ProjectGraph` over ``(relpath, parsed-tree)`` pairs.
+    Trees must already carry ``tpulint_parent`` links
+    (:func:`tools.tpulint.core.attach_parents`)."""
+    return ProjectGraph(files, depth=depth)

@@ -9,5 +9,8 @@ from . import native_guard  # noqa: F401
 from . import non_atomic_write  # noqa: F401
 from . import perparam_jit  # noqa: F401
 from . import replicated_state  # noqa: F401
+from . import shared_state_race  # noqa: F401
 from . import swallowed_error  # noqa: F401
+from . import traced_host_sync  # noqa: F401
 from . import tracer_leak  # noqa: F401
+from . import use_after_donate  # noqa: F401

@@ -1,0 +1,180 @@
+"""shared-state-race: unlocked cross-thread access to instance state.
+
+The planes that keep the TPU fed all run worker threads — the serving
+batcher/decode workers, the telemetry Emitter, the io prefetchers, the
+elastic host-engine commits — and the invariants protecting their shared
+state are enforced today only by convention (``_atomic_write``,
+per-metric locks, careful field discipline). The bug class PR 5-9 kept
+fixing by hand is a field mutated on the worker and read by the caller
+with no lock on one side: it works in CPython most of the time, then a
+torn multi-field update or a stale read shows up as a poisoned prefetch
+or an emitter race under load.
+
+This pass combines the whole-program **thread-context lattice**
+(:mod:`tools.tpulint.graph` — seeded at ``threading.Thread(target=...)``
+sites, ``run`` methods of Thread subclasses, and engine-push callbacks,
+closed over calls) with per-class lexical lock tracking:
+
+- for every class, every ``self.X`` attribute **write** in a method that
+  runs in thread context is paired against every ``self.X`` access
+  (read or write) in a method that does not;
+- each access carries the set of locks held lexically around it
+  (``with self._lock:`` — any ``self`` attribute whose name reads
+  lock-ish counts, including via ``self._lock:`` condition objects);
+- the pair is a finding when the two sides hold **no common lock**;
+  one finding per (class, attribute), reported at the thread-side write.
+
+``__init__`` (and ``__new__``) accesses are exempt: construction happens
+before the worker starts, by the ``Thread.start()`` happens-before edge.
+Lock-named attributes themselves are exempt (assigning the lock is
+setup, not shared state).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import (FileContext, Finding, Pass, ancestors, dotted_name,
+                    register)
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_LOCKISH = ("lock", "mutex", "cond", "_cv", "_mu", "sem")
+_EXEMPT_METHODS = {"__init__", "__new__"}
+
+
+def _lockish(name: str) -> bool:
+    low = name.lower()
+    return any(t in low for t in _LOCKISH)
+
+
+def _locks_held(node: ast.AST, method: ast.AST) -> frozenset:
+    """Lock names (dotted, e.g. ``self._lock``) held lexically at `node`
+    within `method` via ``with`` blocks."""
+    held: Set[str] = set()
+    for anc in ancestors(node):
+        if anc is method:
+            break
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                d = dotted_name(item.context_expr)
+                if d is None and isinstance(item.context_expr, ast.Call):
+                    d = dotted_name(item.context_expr.func)
+                if d and _lockish(d.rsplit(".", 1)[-1]):
+                    held.add(d)
+    return frozenset(held)
+
+
+class _Access:
+    __slots__ = ("method", "scope", "locks", "is_write", "node",
+                 "threaded", "exempt")
+
+    def __init__(self, method, scope, locks, is_write, node,
+                 threaded, exempt):
+        self.method = method      # the class-level method owning the code
+        self.scope = scope        # nearest enclosing function (may be nested)
+        self.locks = locks
+        self.is_write = is_write
+        self.node = node
+        self.threaded = threaded
+        self.exempt = exempt
+
+
+@register
+class SharedStateRacePass(Pass):
+    name = "shared-state-race"
+    description = ("instance attribute written from thread context and "
+                   "accessed from non-thread context with no common lock "
+                   "held on both sides")
+    project = True
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        graph = ctx.project
+        if graph is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._scan_class(ctx, graph, node)
+
+    def _scan_class(self, ctx, graph, cls) -> Iterator[Finding]:
+        accesses: Dict[str, List[_Access]] = {}
+        for method in cls.body:
+            if not isinstance(method, _FUNCS):
+                continue
+            for attr, acc in self._method_accesses(graph, method):
+                accesses.setdefault(attr, []).append(acc)
+
+        for attr in sorted(accesses):
+            if _lockish(attr):
+                continue
+            group = accesses[attr]
+            # construction writes are exempt on BOTH sides: an object
+            # built ON the worker (e.g. a batch) publishes through a
+            # queue/join edge before anyone else can see it
+            thread_writes = [a for a in group
+                             if a.is_write and a.threaded and not a.exempt]
+            other = [a for a in group if not a.threaded and not a.exempt]
+            hit = self._unlocked_pair(thread_writes, other)
+            if hit is None:
+                continue
+            tw, oa = hit
+            entry = graph.thread_entry(tw.scope) \
+                or graph.thread_entry(tw.method) or "?"
+            yield ctx.finding(
+                tw.node, self.name,
+                "`self.%s` is written on a worker thread (%s.%s, entered "
+                "via `%s`) and %s without a common lock from %s.%s — "
+                "guard both sides with one lock or confine the field to "
+                "the worker" % (
+                    attr, cls.name, tw.method.name, entry,
+                    "written" if oa.is_write else "read",
+                    cls.name, oa.method.name))
+
+    @staticmethod
+    def _unlocked_pair(thread_writes: List[_Access], other: List[_Access]
+                       ) -> Optional[Tuple[_Access, _Access]]:
+        for tw in thread_writes:
+            for oa in other:
+                if not (tw.locks & oa.locks):
+                    return tw, oa
+        return None
+
+    def _method_accesses(self, graph, method) -> Iterator[Tuple[str, _Access]]:
+        """(attr, access) for every ``self.X`` touch in `method`.
+
+        Thread context is taken from the *nearest* enclosing function: a
+        closure defined inside ``__init__`` and handed to
+        ``threading.Thread(target=...)`` runs on the worker even though
+        ``__init__`` itself does not — and only accesses that really run
+        during construction get the pre-``start()`` exemption."""
+        method_threaded = graph.is_threaded(method)
+        for node in ast.walk(method):
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                continue
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            # `self.x += 1` parses the target as Store; reads in Load.
+            # Attribute *method calls* (`self._q.put(x)`) are a Load of
+            # the attr — mutation of its referent is out of scope (the
+            # referent, e.g. a Queue, owns its own locking).
+            scope = _nearest_func(node, method)
+            threaded = method_threaded or graph.is_threaded(scope)
+            # only code in __init__'s OWN body runs during construction —
+            # a closure defined there executes whenever it is called
+            # (possibly on the worker it was handed to)
+            exempt = (method.name in _EXEMPT_METHODS and scope is method)
+            locks = _locks_held(node, method)
+            yield node.attr, _Access(method, scope, locks, is_write, node,
+                                     threaded, exempt)
+
+
+def _nearest_func(node: ast.AST, method: ast.AST) -> ast.AST:
+    for anc in ancestors(node):
+        if isinstance(anc, _FUNCS + (ast.Lambda,)):
+            return anc
+        if anc is method:
+            break
+    return method

@@ -1,0 +1,126 @@
+"""traced-host-sync: host syncs/impurities *reachable* from traced code.
+
+The file-local ``host-sync`` pass sees a ``.asnumpy()`` inside a jitted
+function only when the jit wrap and the sync share a file — PR 5-9 kept
+finding the other shape by hand: a sync buried two frames below a traced
+``_leaf_step``, or inside a helper a whole-step jit inlines from another
+module. This pass walks the whole-program **traced-context lattice**
+(:mod:`tools.tpulint.graph`): a function is traced when it is seeded at a
+``jax.jit``/``pl.pallas_call`` site or a known kernel entry point
+(``_leaf_step``/``tree_kernel``) or called — to a bounded depth — from
+one that is.
+
+Flagged inside traced context, anywhere in ``mxnet_tpu/``:
+
+- ``.asnumpy()``/``.item()``/``.tolist()``/``.wait_to_read()``/
+  ``.block_until_ready()`` and ``fetch_host(...)``/``jax.device_get(...)``
+  — concretize the tracer at trace time (error, or a stale constant baked
+  into the compiled program);
+- ``float(...)``/``int(...)`` on a computed value, and
+  ``np.asarray``/``np.array`` — same trace-time materialization;
+- ``get_env(..., cache=False)`` — the knob is *designed* to be re-read
+  per call, but under tracing it is read once and frozen: the program
+  silently stops honoring the knob;
+- lock acquisition (``with self._lock:`` / ``.acquire()``) — the lock is
+  taken at trace time and never inside the compiled step: the guard the
+  author wrote does not exist at runtime.
+
+Sites already covered by the file-local pass (lexically inside a
+same-file jit closure) are skipped — this pass reports only what the
+whole-program lattice adds, so existing baselines don't double.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import (FileContext, Finding, Pass, dotted_name,
+                    enclosing_function, in_jit, register)
+
+_SYNC_METHODS = {"asnumpy", "item", "tolist", "wait_to_read",
+                 "block_until_ready"}
+_FETCH_TAILS = {"fetch_host", "device_get"}
+_NP_CONVERTERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_SCALAR_SAFE_CALLEES = {"len", "str", "ord", "round", "hash", "id"}
+_LOCKISH = ("lock", "mutex", "cond", "_cv", "_mu")
+
+
+def _lockish(name: Optional[str]) -> bool:
+    low = (name or "").lower()
+    return any(t in low for t in _LOCKISH)
+
+
+def _classify_call(node: ast.Call) -> Optional[str]:
+    """A short description of why this call is a trace-time hazard, or
+    None."""
+    if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_METHODS:
+        return "`.%s()`" % node.func.attr
+    fname = dotted_name(node.func) or ""
+    tail = fname.rsplit(".", 1)[-1]
+    if tail in _FETCH_TAILS:
+        return "`%s()`" % tail
+    if fname in _NP_CONVERTERS:
+        return "`%s()`" % fname
+    if fname in ("float", "int") and len(node.args) == 1 \
+            and isinstance(node.args[0], ast.Call) \
+            and dotted_name(node.args[0].func) not in _SCALAR_SAFE_CALLEES:
+        return "`%s()` on a computed value" % fname
+    if tail == "get_env":
+        for kw in node.keywords:
+            if kw.arg == "cache" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is False:
+                return "`get_env(cache=False)` (per-call re-read, frozen "\
+                       "to one trace-time value)"
+    if tail == "acquire" and isinstance(node.func, ast.Attribute) \
+            and _lockish(dotted_name(node.func.value)):
+        return "lock `.acquire()`"
+    return None
+
+
+@register
+class TracedHostSyncPass(Pass):
+    name = "traced-host-sync"
+    description = ("host syncs, get_env(cache=False) re-reads and lock "
+                   "acquisition reachable (interprocedurally) from "
+                   "jit/pallas-traced context")
+    project = True
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        graph = ctx.project
+        if graph is None:
+            return
+        jitted_local = ctx.jit_functions()
+        for node in ast.walk(ctx.tree):
+            what = None
+            if isinstance(node, ast.Call):
+                what = _classify_call(node)
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    d = dotted_name(item.context_expr)
+                    if d and _lockish(d.rsplit(".", 1)[-1]):
+                        what = "`with %s:` lock acquisition" % d
+                        break
+            if what is None:
+                continue
+            fn = enclosing_function(node)
+            if fn is None:
+                continue
+            chain = graph.traced_chain(fn)
+            if chain is None:
+                continue
+            # lexically inside a same-file jit closure: the file-local
+            # host-sync/tracer-leak passes own that report
+            if in_jit(node, jitted_local) or fn in jitted_local:
+                continue
+            # name only the seed and the enclosing function (not the whole
+            # chain): baseline keys embed the message, and intermediate
+            # frames churn on refactors the finding shouldn't care about
+            yield ctx.finding(
+                node, self.name,
+                "%s in `%s` runs under jax tracing (reachable from traced "
+                "`%s`) — a device sync or impure effect at trace time, "
+                "frozen or erroring in the compiled step"
+                % (what, chain[-1], chain[0]))

@@ -1,0 +1,289 @@
+"""use-after-donate: reading a value after its buffer was donated.
+
+Buffer donation (``donate_argnums`` on the fused/zero/decode jits,
+``fastpath.fused_apply``'s whole-tree donation) hands the argument's
+device memory to XLA for reuse — after the call the old handle points at
+freed (or silently recycled) storage. The fastpath discipline is
+``donation_prep`` → jit → ``invalidate_consumed``, which makes a stale
+read *raise*; the bug class this pass guards is the silent one: code
+that keeps using the Python variable after passing it to a donating
+call, without a rebind. That read works on CPU (donation is a no-op
+there), and on TPU returns garbage or a use-after-free — the PR-5/8
+stale-handle guards exist because it happened.
+
+A **local data-flow pass** (per function, statements in source order,
+both branches of a conditional taken — a deliberate over-approximation):
+
+- a call to a *donating callee* marks its plain-name and ``self.attr``
+  arguments donated: ``fused_apply`` (the fastpath donation surface)
+  and any name bound in the same scope from
+  ``jax.jit(..., donate_argnums=...)`` (the pool-donating decode/zero
+  jits); ``donation_prep(X, ...)`` marks its arguments *pending* — the
+  prep only probes buffers — and the next call that receives a pending
+  name is its consumer: the donation window opens there;
+- a later ``Load`` of a donated name is the finding;
+- rebinding the name (assignment, tuple unpack, for-target, with-as),
+  ``del``, or an intervening ``invalidate_consumed(...)`` /
+  ``.delete()`` call clears it — the discipline is in place.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from ..core import (FileContext, Finding, Pass, dotted_name,
+                    enclosing_function, register)
+
+_DONATING_TAILS = {"fused_apply"}
+_PREP_TAILS = {"donation_prep"}
+_CLEARING_TAILS = {"invalidate_consumed", "delete"}
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+# calls that can receive a donation_prep'd name WITHOUT consuming its
+# buffer: introspection, logging, container plumbing — only a real
+# compute call opens the donation window
+_NON_CONSUMING_TAILS = {
+    "len", "print", "str", "repr", "format", "isinstance", "type", "id",
+    "hash", "zip", "enumerate", "sorted", "reversed", "list", "tuple",
+    "dict", "set", "sum", "min", "max", "any", "all", "getattr",
+    "hasattr", "range", "debug", "info", "warning", "error", "exception",
+    "append", "extend", "inc", "set_", "observe", "add",
+}
+
+
+def _name_of(expr: ast.AST) -> str:
+    """A trackable key for a donated argument: a bare name or a short
+    ``self.x`` attribute; '' for anything else."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        return "%s.%s" % (expr.value.id, expr.attr)
+    return ""
+
+
+def _jit_donating_names(scope_body: List[ast.stmt]) -> Set[str]:
+    """Names bound (in this statement list) from a ``jax.jit(...)`` call
+    carrying ``donate_argnums`` — calls through them donate."""
+    out: Set[str] = set()
+    for stmt in scope_body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            tail = (dotted_name(call.func) or "").rsplit(".", 1)[-1]
+            if tail not in ("jit", "pjit"):
+                continue
+            if not any(kw.arg == "donate_argnums" for kw in call.keywords):
+                continue
+            for tgt in node.targets:
+                key = _name_of(tgt)
+                if key:
+                    out.add(key)
+    return out
+
+
+def _assigned_keys(target: ast.AST) -> Iterator[str]:
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _assigned_keys(elt)
+    elif isinstance(target, ast.Starred):
+        yield from _assigned_keys(target.value)
+    else:
+        key = _name_of(target)
+        if key:
+            yield key
+
+
+@register
+class UseAfterDonatePass(Pass):
+    name = "use-after-donate"
+    description = ("a variable is read after being passed to a donating "
+                   "call (fused_apply/donation_prep/donate_argnums jit) "
+                   "with no rebind or invalidate_consumed between")
+
+    def applies(self, relpath: str) -> bool:
+        # fastpath/fused.py IS the donation discipline: it probes, deletes
+        # and re-reads handles deliberately, under its own guards
+        return relpath.startswith("mxnet_tpu/") \
+            and relpath != "mxnet_tpu/fastpath/fused.py"
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        # donating jits installed as instance attrs in ONE method
+        # (`self._step = jax.jit(..., donate_argnums=...)` in __init__)
+        # donate when called from ANY method of the class
+        class_attrs: Dict[ast.AST, Set[str]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                acc: Set[str] = set()
+                for sub in node.body:
+                    if isinstance(sub, _FUNCS):
+                        acc |= {k for k in _jit_donating_names(sub.body)
+                                if "." in k}
+                class_attrs[node] = acc
+        for node in ast.walk(ctx.tree):
+            # only scope roots: nested defs are scanned (with inherited
+            # donating names) by the recursive walk below
+            if isinstance(node, _FUNCS) and enclosing_function(node) is None:
+                parent = getattr(node, "tpulint_parent", None)
+                extra = class_attrs.get(parent, set())
+                yield from self._scan_function(ctx, node, extra)
+
+    # -- per-function linear data flow --------------------------------------
+
+    def _scan_function(self, ctx: FileContext, fn, extra=()) -> Iterator[Finding]:
+        donating = set(_DONATING_TAILS) | set(extra) \
+            | _jit_donating_names(fn.body)
+        donated: Dict[str, Tuple[int, str]] = {}  # key -> (line, callee)
+        pending: Dict[str, int] = {}              # donation_prep'd, unconsumed
+        yield from self._scan_body(ctx, fn.body, donating, donated, pending)
+
+    def _scan_body(self, ctx, body, donating, donated, pending
+                   ) -> Iterator[Finding]:
+        for stmt in body:
+            yield from self._scan_stmt(ctx, stmt, donating, donated, pending)
+
+    def _scan_stmt(self, ctx, stmt, donating, donated, pending
+                   ) -> Iterator[Finding]:
+        # a nested def's body runs when *called*, not here — scan it as
+        # its own scope, inheriting the enclosing donating names (closure)
+        if isinstance(stmt, _FUNCS):
+            yield from self._scan_function(ctx, stmt, extra=donating)
+            return
+
+        def clear(key):
+            donated.pop(key, None)
+            pending.pop(key, None)
+
+        sub_bodies: List[list] = []
+        exprs: List[ast.AST] = []
+        if isinstance(stmt, (ast.If, ast.While)):
+            exprs.append(stmt.test)
+            sub_bodies += [stmt.body, stmt.orelse]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            exprs.append(stmt.iter)
+            for key in _assigned_keys(stmt.target):
+                clear(key)
+            sub_bodies += [stmt.body, stmt.orelse]
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                exprs.append(item.context_expr)
+                if item.optional_vars is not None:
+                    for key in _assigned_keys(item.optional_vars):
+                        clear(key)
+            sub_bodies.append(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            sub_bodies += [stmt.body, stmt.orelse, stmt.finalbody]
+            sub_bodies += [h.body for h in stmt.handlers]
+        else:
+            exprs.append(stmt)
+
+        for expr in exprs:
+            yield from self._scan_expr(ctx, expr, donating, donated, pending)
+
+        # statement-level effects AFTER its expressions were evaluated
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                for key in _assigned_keys(tgt):
+                    clear(key)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            for key in _assigned_keys(stmt.target):
+                clear(key)
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                clear(_name_of(tgt))
+
+        for body in sub_bodies:
+            yield from self._scan_body(ctx, body, donating, donated, pending)
+
+    def _scan_expr(self, ctx, expr, donating, donated, pending
+                   ) -> Iterator[Finding]:
+        """Reads first (a read and a donation in one statement is the
+        donation call itself), then new donations/preps/clears."""
+        donation_calls: List[Tuple[ast.Call, List[str]]] = []
+        prep_calls: List[ast.Call] = []
+        arg_nodes: Set[ast.AST] = set()
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+            full = _name_of(node.func)
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            keys = [k for k in (_name_of(a) for a in args) if k]
+            if tail in _DONATING_TAILS or tail in donating or full in donating:
+                donation_calls.append((node, keys))
+                arg_nodes.update(a for a in args if _name_of(a))
+            elif tail in _PREP_TAILS:
+                prep_calls.append(node)
+            elif tail in _CLEARING_TAILS:
+                # discipline call: the stale window is closed for every
+                # tracked handle (args are trees/containers of them)
+                donated.clear()
+                pending.clear()
+            elif tail not in _NON_CONSUMING_TAILS \
+                    and any(k in pending for k in keys):
+                # the consumer of a donation_prep'd buffer: the donation
+                # window opens HERE (args of this very call are the
+                # sanctioned last read); introspection/logging calls
+                # touching the name first do not consume it
+                consumed = [k for k in keys if k in pending]
+                donation_calls.append((node, consumed))
+                arg_nodes.update(a for a in args if _name_of(a) in consumed)
+
+        # same-statement donations: a read lexically AFTER the donating
+        # call (`fused_apply(..., w) + w[0]`) evaluates after the buffer
+        # is gone — positional order approximates evaluation order
+        stmt_donated: Dict[str, ast.Call] = {}
+        for call, keys in donation_calls:
+            for k in keys:
+                stmt_donated.setdefault(k, call)
+
+        for node in ast.walk(expr):
+            key = None
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                key = node.id
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and isinstance(node.value, ast.Name):
+                key = "%s.%s" % (node.value.id, node.attr)
+            if key is None or node in arg_nodes:
+                continue
+            # `self.x.attr` reads route through the Attribute node whose
+            # .value is the donated self.x — those hit the key above;
+            # a bare donated Name inside its own donation call is exempt
+            if any(node is a or _contains(a, node) for a in arg_nodes):
+                continue
+            if key in donated:
+                line, callee = donated.pop(key)
+            elif key in stmt_donated:
+                call = stmt_donated[key]
+                if _contains(call, node):
+                    continue  # part of the donating call itself
+                if (node.lineno, node.col_offset) \
+                        <= (call.lineno, call.col_offset):
+                    continue  # evaluated before the donation
+                line = call.lineno
+                callee = (dotted_name(call.func) or "").rsplit(".", 1)[-1] \
+                    or "donating call"
+            else:
+                continue
+            yield ctx.finding(
+                node, self.name,
+                "`%s` is read after being donated to `%s()` (line %d has "
+                "no rebind/invalidate_consumed between) — the buffer may "
+                "be freed or reused on TPU" % (key, callee, line))
+
+        for call, keys in donation_calls:
+            tail = (dotted_name(call.func) or "").rsplit(".", 1)[-1]
+            callee = tail if tail else "donating call"
+            for key in keys:
+                pending.pop(key, None)
+                donated[key] = (call.lineno, callee)
+        for call in prep_calls:
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                key = _name_of(arg)
+                if key:
+                    pending[key] = call.lineno
+
+
+def _contains(parent: ast.AST, node: ast.AST) -> bool:
+    return any(sub is node for sub in ast.walk(parent))

@@ -21,6 +21,26 @@ def render_text(new: Sequence[Finding], total: int, baselined: int,
     return "\n".join(lines)
 
 
+def render_stats(stats: Dict) -> str:
+    """`--stats`: per-pass timing, parse/graph cost, cache hit rate."""
+    lines: List[str] = ["", "tpulint --stats:"]
+    lines.append("  files linted: %d" % stats.get("files", 0))
+    for key in ("parse_ms", "graph_ms"):
+        if key in stats:
+            lines.append("  %-18s %8.1f ms" % (key[:-3], stats[key]))
+    for name, ms in sorted(stats.get("pass_ms", {}).items(),
+                           key=lambda kv: -kv[1]):
+        lines.append("  pass %-22s %8.1f ms" % (name, ms))
+    hits = stats.get("cache_hits", 0)
+    misses = stats.get("cache_misses", 0)
+    if hits or misses:
+        lines.append("  cache: %d hit(s), %d miss(es) (%.1f%% hit rate)"
+                     % (hits, misses, 100.0 * hits / (hits + misses)))
+    if "total_ms" in stats:
+        lines.append("  total: %.1f ms" % stats["total_ms"])
+    return "\n".join(lines)
+
+
 def render_json(new: Sequence[Finding], total: int, baselined: int,
                 stale_keys: Sequence[str] = ()) -> str:
     payload: Dict = {
