@@ -57,12 +57,52 @@ TRAIN_BASELINE = 298.51   # V100 ResNet-50 train bs=32 fp32, perf.md:214
 INFER_BASELINE = 1076.81  # V100 ResNet-50 infer bs=32 fp32, perf.md:156
 
 
+_LINT_STAMP = None
+
+
+def _lint_stamp():
+    """``lint_clean``/``lint_findings`` for every emitted JSON line: was
+    the source tree the bench ran on statically clean (tpulint, all
+    passes — incl. the v3 recompile-risk/pallas/sharding gates), and how
+    many non-baselined findings were open if not. A perf number from a
+    tree with a predicted recompile storm reads very differently from
+    one off a clean tree, so the evidence rides the line. Memoized (one
+    lint per process; warm-cache runs cost ~20ms) and BENCH_LINT=0
+    skips it entirely.
+
+    The linter runs on the MAIN thread only (which also makes the
+    memoization single-writer — no lock needed): the stall watchdogs
+    emit through ``_attach_telemetry`` right before ``os._exit``, and
+    their one job is getting the stall evidence out — a cold
+    whole-program lint (~9s) must never sit between a deadline and the
+    emit. A watchdog that fires before the main thread computed the
+    stamp emits without it."""
+    global _LINT_STAMP
+    if _LINT_STAMP is not None:
+        return _LINT_STAMP
+    if threading.current_thread() is not threading.main_thread():
+        return {}  # never run (or wait on) the linter off-main
+    stamp = {}
+    if os.environ.get("BENCH_LINT", "1") not in ("", "0"):
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from tools.tpulint import lint_paths
+
+            new, _all = lint_paths(["mxnet_tpu", "tools"])
+            stamp = {"lint_clean": not new, "lint_findings": len(new)}
+        except Exception:  # noqa: BLE001 - emit must survive a bad lint
+            stamp = {}
+    _LINT_STAMP = stamp
+    return _LINT_STAMP
+
+
 def _attach_telemetry(out):
     """Attach a telemetry snapshot to a result line (success OR error):
     a stall like r05 ("deadline hit during phase 'infer-fp32'") then
     carries its recompile/transfer counts as evidence instead of a bare
     message. Must never break the emit path — the snapshot rides along
     only when the framework got far enough to import."""
+    out.update(_lint_stamp())
     try:
         from mxnet_tpu import telemetry
 

@@ -1,0 +1,67 @@
+"""Seeded synthetic bugs for the tpulint v3 shape/sharding interpreter —
+one per new pass, each invisible to every other pass.
+
+``tests/test_tpulint.py::test_shape_seeded_bug_*`` lints this file under
+a ``mxnet_tpu/`` pseudo-path and asserts each pass catches EXACTLY its
+seeded bug (and nothing else fires): the regression gate proving the
+abstract interpreter still derives ⊤ through host-data flow, the pallas
+checker still folds block constants, and the sharding checker still
+cross-references the project's mesh axes. Not imported at runtime —
+pure fixture source.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# -- bug 1: ⊤-shaped operand into a jit dispatch -----------------------------
+# `rows` accumulates host data in a python loop; np.stack gives the batch
+# a data-dependent leading dim, and `_STEP(batch)` compiles one executable
+# per distinct row count — a steady-state recompile storm the runtime
+# gauge would only see on a chip.
+
+def _step_impl(x):
+    return x * 2
+
+
+_STEP = jax.jit(_step_impl)
+
+
+def collate_and_step(host_batches):
+    rows = []
+    for b in host_batches:
+        rows.append(np.asarray(b, np.float32))
+    batch = np.stack(rows)
+    return _STEP(batch)  # BUG: ⊤ leading dim — recompile per batch size
+
+
+# -- bug 2: off-tile Pallas block --------------------------------------------
+# the (8, 100) input block violates the (8, 128) float32 lane tile; it
+# runs fine in interpret mode (the CPU tier-1 path) and only Mosaic on
+# real hardware rejects — or silently relayouts — it.
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def off_tile_copy(x):
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 100), lambda i: (i, 0))],  # BUG: 100 lanes
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+    )(x)
+
+
+# -- bug 3: undefined mesh axis ----------------------------------------------
+# the project defines only the "dp" axis; constraining over "tp" raises
+# on the real mesh (or silently replicates under a permissive lowering).
+
+def shard_hidden(devices, x):
+    mesh = Mesh(np.asarray(devices), ("dp",))
+    with mesh:
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("tp")))  # BUG: no mesh defines "tp"

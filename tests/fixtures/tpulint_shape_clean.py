@@ -1,0 +1,112 @@
+"""Sanctioned shape/sharding idioms — the tpulint v3 false-positive suite.
+
+Every pattern here is the framework's *blessed* way of keeping shapes
+static: knob-sized pools, padded bucket ladders, warmup pre-compilation
+over the rungs, tile-aligned Pallas blocks with scalar prefetch, and
+PartitionSpecs over axes a Mesh actually defines. The tests assert the
+three new passes (recompile-risk, pallas-kernel-check, sharding-flow)
+report ZERO findings on this file: the abstract domain must classify
+knob reads and ladder rungs as bounded — clean by construction — or the
+static gate would drown the real hazards in noise. Not imported at
+runtime — pure fixture source.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import get_env
+from ..serving.buckets import select_bucket
+
+LANES = 128
+_SUBLANES = 8
+
+
+# -- the serving/prefill bucket-ladder idiom ---------------------------------
+# a prompt of any length pads up to a rung; one compile per rung, all
+# pre-compiled by warmup() — recompile-risk must stay silent.
+
+class CleanEngine:
+    def __init__(self, model_fn, prefill_buckets=None):
+        self.num_slots = get_env("MXNET_DECODE_SLOTS", 8, int, cache=False)
+        self.max_seq_len = get_env("MXNET_DECODE_MAX_SEQ_LEN", 256, int,
+                                   cache=False)
+        self._ladder = self._prefill_ladder(prefill_buckets)
+        self._step = jax.jit(model_fn, donate_argnums=(1,))
+        self._prefill_jit = jax.jit(model_fn)
+
+    def _prefill_ladder(self, buckets):
+        if buckets is None:
+            raw = get_env("MXNET_DECODE_PREFILL_BUCKETS", "16,64", str,
+                          cache=False)
+            buckets = [int(t) for t in str(raw).split(",") if t.strip()]
+        ladder = sorted({int(b) for b in buckets if int(b) > 0})
+        ladder = [b for b in ladder if b < self.max_seq_len]
+        ladder.append(self.max_seq_len)
+        return tuple(ladder)
+
+    def warmup(self):
+        # the warmed decode step: knob-shaped packed operands
+        s = self.num_slots
+        packed = np.zeros((5, s), np.int32)
+        self._step(jnp.asarray(packed), None)
+        # one pre-compile per rung: bounded, never ⊤
+        for rung in self._ladder:
+            pre = np.zeros((3, rung), np.int32)
+            self._prefill_jit(jnp.asarray(pre), None)
+
+    def prefill(self, prompt):
+        p = int(np.asarray(prompt, np.int32).size)
+        rung = select_bucket(p, self._ladder)
+        pre = np.zeros((3, rung), np.int32)  # padded to the rung
+        return self._prefill_jit(jnp.asarray(pre),
+                                 jnp.asarray(p, jnp.int32))
+
+
+# -- a tile-aligned Pallas kernel with scalar prefetch -----------------------
+# (8, 128) float32 blocks, grid↔index_map arity consistent with one
+# scalar-prefetch ref, VMEM footprint far under the ceiling.
+
+def _scale_kernel(tbl_ref, x_ref, o_ref, acc_ref):
+    o_ref[...] = x_ref[...] * 2.0
+    acc_ref[...] = x_ref[...]
+
+
+def clean_pallas(x, table):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(4, 2),
+        in_specs=[
+            pl.BlockSpec((_SUBLANES, LANES),
+                         lambda i, j, tbl: (tbl[i], j)),
+        ],
+        out_specs=pl.BlockSpec((_SUBLANES, LANES),
+                               lambda i, j, tbl: (i, j)),
+        scratch_shapes=[pltpu.VMEM((_SUBLANES, LANES), jnp.float32)],
+    )
+    return pl.pallas_call(
+        _scale_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((32, 256), jnp.float32),
+    )(table, x)
+
+
+# -- sharding over axes the mesh defines -------------------------------------
+
+def make_mesh(devices):
+    return Mesh(np.asarray(devices), ("dp", "mp"))
+
+
+def shard_batch(devices, batch, params):
+    mesh = make_mesh(devices)
+    sharded = NamedSharding(mesh, P("dp"))
+    repl = NamedSharding(mesh, P())
+    step = jax.jit(lambda b, p: (b, p),
+                   in_shardings=(sharded, repl),
+                   out_shardings=(sharded, repl),
+                   donate_argnums=(0,))  # donated layout matches an output
+    with mesh:
+        return step(jax.device_put(batch, sharded),
+                    jax.device_put(params, repl))

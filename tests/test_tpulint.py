@@ -1660,7 +1660,8 @@ def test_cache_prunes_entries_for_deleted_files(tmp_path):
     b.unlink()
     lint_files([a], root=tmp_path, cache=LintCache(path))
     import json as _json
-    entries = _json.loads(path.read_text())["files"]
+    # default extra_sig "" section of the per-baseline-signature layout
+    entries = _json.loads(path.read_text())["sections"][""]["files"]
     assert "a.py" in entries and "b.py" not in entries
 
 
@@ -1734,3 +1735,1315 @@ def test_cli_stats_emitted_with_write_baseline(tmp_path, capsys):
                  "--baseline", str(tmp_path / "bl.json"),
                  "--cache", str(tmp_path / "c.json")]) == 0
     assert "tpulint --stats:" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# v3: abstract shape/sharding interpreter (tools/tpulint/shapes.py) and the
+# recompile-risk / pallas-kernel-check / sharding-flow passes
+# ---------------------------------------------------------------------------
+
+from tools.tpulint import shapes  # noqa: E402
+from tools.tpulint.shapes import Dim, derived, join_dims  # noqa: E402
+
+
+def test_dim_lattice_joins():
+    c8, c16 = Dim.const(8), Dim.const(16)
+    knob = Dim.knob("MXNET_DECODE_SLOTS")
+    top = Dim.top("len() of host data")
+    unk = Dim.unknown()
+    # unknown is the join identity (ignorance is not evidence)
+    assert join_dims(unk, c8).kind == "const"
+    assert join_dims(c8, unk).value == 8
+    # equal consts stay const; distinct sizes join to a bounded set
+    assert join_dims(c8, Dim.const(8)).value == 8
+    assert join_dims(c8, c16).kind == "bounded"
+    assert join_dims(c8, knob).kind == "bounded"
+    # top absorbs everything and keeps its origin for the message
+    assert join_dims(top, c8).kind == "top"
+    assert join_dims(knob, top).origin == "len() of host data"
+    # derived arithmetic: top taints, unknown stays unknown
+    assert derived(c8, top).kind == "top"
+    assert derived(c8, unk).kind == "unknown"
+    assert derived(c8, knob).kind == "knob"
+
+
+def test_recompile_risk_loop_accumulator_into_jit():
+    found = lint("""
+        import jax
+        import numpy as np
+
+        def _impl(x):
+            return x * 2
+
+        _STEP = jax.jit(_impl)
+
+        def collate(batches):
+            rows = []
+            for b in batches:
+                rows.append(np.asarray(b))
+            return _STEP(np.stack(rows))
+    """, "recompile-risk")
+    assert len(found) == 1
+    assert "⊤" in found[0].message and "_STEP" in found[0].message
+
+
+def test_recompile_risk_len_of_host_data_into_jit():
+    found = lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def handle(prompt):
+            arr = np.zeros((3, len(prompt)), np.int32)
+            return step(arr)
+    """, "recompile-risk")
+    assert len(found) == 1 and "len()" in found[0].message
+
+
+def test_recompile_risk_interprocedural_top_flow():
+    # the ⊤ array is built in one function, dispatched in another: only
+    # the interprocedural parameter summary can see it
+    found = lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def inner(arr):
+            return step(arr)
+
+        def outer(data):
+            return inner(np.zeros((len(data), 4)))
+    """, "recompile-risk")
+    assert len(found) == 1 and "step" in found[0].message
+
+
+def test_recompile_risk_jit_attr_and_wrapper_dispatch():
+    # the decode idiom: jit installed as an instance attribute in
+    # __init__, dispatched through telemetry.jit_call on a retry closure
+    found = lint("""
+        import jax
+        import numpy as np
+
+        class Engine:
+            def __init__(self, fn):
+                self._step = jax.jit(fn)
+
+            def tick(self, host_rows):
+                from . import telemetry
+                x = np.zeros((len(host_rows),))
+
+                def attempt():
+                    return telemetry.jit_call("site", self._step, x)
+                return attempt()
+    """, "recompile-risk")
+    assert len(found) == 1 and "self._step" in found[0].message
+
+
+def test_recompile_risk_bucket_ladder_and_knob_clean():
+    # the sanctioned shapes: select_bucket rungs and get_env knobs are
+    # bounded — one compile per rung / per process, warmup covers them
+    found = lint("""
+        import jax
+        import numpy as np
+        from .base import get_env
+        from .serving.buckets import select_bucket
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def prefill(prompt, ladder):
+            rung = select_bucket(len(prompt), ladder)
+            return step(np.zeros((3, rung), np.int32))
+
+        def tick():
+            s = get_env("MXNET_DECODE_SLOTS", 8, int, cache=False)
+            return step(np.zeros((5, s), np.int32))
+    """, "recompile-risk")
+    assert found == []
+
+
+def test_recompile_risk_warmup_rung_loop_clean():
+    # one compile per rung of a knob-parsed ladder is the warmup
+    # CONTRACT, not a hazard — bounded by construction
+    found = lint("""
+        import jax
+        import numpy as np
+        from .base import get_env
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def warmup():
+            raw = get_env("MXNET_DECODE_PREFILL_BUCKETS", "16,64", str,
+                          cache=False)
+            ladder = [int(t) for t in str(raw).split(",") if t.strip()]
+            for rung in ladder:
+                step(np.zeros((3, rung), np.int32))
+    """, "recompile-risk")
+    assert found == []
+
+
+def test_recompile_risk_unknown_never_reported():
+    # a jit over shapes the interpreter cannot derive must stay silent:
+    # the pass reports positively-derived ⊤ only
+    found = lint("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def run(batch):
+            return step(batch)
+    """, "recompile-risk")
+    assert found == []
+
+
+def test_recompile_risk_scoped_to_mxnet_tpu():
+    src = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def run(data):
+            return step(np.zeros((len(data),)))
+    """
+    assert lint(src, "recompile-risk", relpath="tools/helper.py") == []
+    assert len(lint(src, "recompile-risk")) == 1
+
+
+def test_pallas_check_off_tile_block_and_sublane():
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def run(x, kern):
+            return pl.pallas_call(
+                kern,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 100), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((5, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((20, 128), jnp.float32),
+            )(x)
+    """, "pallas-kernel-check")
+    msgs = " | ".join(f.message for f in found)
+    assert len(found) == 2
+    assert "last dim 100" in msgs and "second-to-last dim 5" in msgs
+
+
+def test_pallas_check_module_const_folding():
+    # LANES/_SUBLANES-style module constants fold into the block check
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        LANES = 128
+        HALF = LANES // 2
+
+        def run(x, kern):
+            return pl.pallas_call(
+                kern,
+                grid=(2,),
+                in_specs=[pl.BlockSpec((8, HALF), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, LANES), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((16, 128), jnp.float32),
+            )(x)
+    """, "pallas-kernel-check")
+    assert len(found) == 1 and "last dim 64" in found[0].message
+
+
+def test_pallas_check_grid_index_map_arity():
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def run(x, kern):
+            return pl.pallas_call(
+                kern,
+                grid=(4, 2),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+                out_shape=jax.ShapeDtypeStruct((32, 256), jnp.float32),
+            )(x)
+    """, "pallas-kernel-check")
+    assert len(found) == 1 and "arity mismatch" in found[0].message
+
+
+def test_pallas_check_scalar_prefetch_arity():
+    # PrefetchScalarGridSpec appends N scalar refs to every index_map:
+    # a lambda that ignores them is an on-device TypeError
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def run(x, tbl, kern):
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(4, 2),
+                in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, j))],
+                out_specs=pl.BlockSpec((8, 128),
+                                       lambda i, j, t: (i, j)),
+            )
+            return pl.pallas_call(
+                kern,
+                grid_spec=grid_spec,
+                out_shape=jax.ShapeDtypeStruct((32, 256), jnp.float32),
+            )(tbl, x)
+    """, "pallas-kernel-check")
+    assert len(found) == 1
+    assert "scalar-prefetch" in found[0].message \
+        and "takes 2 argument(s)" in found[0].message
+
+
+def test_pallas_check_vmem_budget():
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def run(x, kern):
+            return pl.pallas_call(
+                kern,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((1024, 2048), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((1024, 2048), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((4096, 2048), jnp.float32),
+                scratch_shapes=[pltpu.VMEM((1024, 2048), jnp.float32)],
+            )(x)
+    """, "pallas-kernel-check")
+    assert len(found) == 1
+    assert "VMEM" in found[0].message and "16 MB" in found[0].message
+
+
+def test_pallas_check_clean_kernel_negative():
+    # tile-aligned blocks, consistent arity, modest VMEM: silent —
+    # including symbolic dims the const folder cannot (and must not)
+    # guess at
+    found = lint("""
+        import functools
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        LANES = 128
+
+        def flash(q, k, v, kern, bq, bk, d, n_q, n_kv, b, h, sp):
+            return pl.pallas_call(
+                kern,
+                grid=(b * h, n_q, n_kv),
+                in_specs=[
+                    pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+                    pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+                ],
+                out_specs=pl.BlockSpec((1, bq, d),
+                                       lambda bh, qi, ki: (bh, qi, 0)),
+                out_shape=jax.ShapeDtypeStruct((8, 128, 128), jnp.float32),
+                scratch_shapes=[pltpu.VMEM((8, LANES), jnp.float32)],
+            )(q, k, v)
+    """, "pallas-kernel-check")
+    assert found == []
+
+
+def test_pallas_check_scoped_to_mxnet_tpu():
+    src = """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def run(x, kern):
+            return pl.pallas_call(
+                kern, grid=(4,),
+                in_specs=[pl.BlockSpec((8, 100), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+            )(x)
+    """
+    assert lint(src, "pallas-kernel-check", relpath="example/k.py") == []
+    assert len(lint(src, "pallas-kernel-check")) == 1
+
+
+def test_sharding_flow_undefined_axis():
+    found = lint("""
+        import numpy as np
+        import jax
+        from jax import lax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        def shard(devs, x):
+            mesh = Mesh(np.asarray(devs), ("dp",))
+            y = jax.device_put(x, NamedSharding(mesh, P("tp")))
+            return lax.psum(y, "model")
+    """, "sharding-flow")
+    assert len(found) == 2
+    msgs = " | ".join(f.message for f in found)
+    assert "'tp'" in msgs and "'model'" in msgs
+
+
+def test_sharding_flow_cross_file_axis_definition():
+    # "dp" is defined by a Mesh in another file of the same lint scope:
+    # the whole-program axis set must see it
+    meshes = """
+        import numpy as np
+        from jax.sharding import Mesh
+
+        def device_mesh(devs, axis_names=("dp",)):
+            return Mesh(np.asarray(devs), tuple(axis_names))
+    """
+    user = """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def spec(mesh):
+            return NamedSharding(mesh, P("dp"))
+    """
+    found = core.lint_sources(
+        [("mxnet_tpu/parallel2.py", textwrap.dedent(meshes)),
+         ("mxnet_tpu/user2.py", textwrap.dedent(user))],
+        passes=["sharding-flow"])
+    assert found == []
+    # without the defining file the same use IS a finding
+    alone = core.lint_sources([("mxnet_tpu/user2.py", textwrap.dedent(user))],
+                              passes=["sharding-flow"])
+    assert len(alone) == 1 and "'dp'" in alone[0].message
+
+
+def test_sharding_flow_bare_p_requires_partitionspec_import():
+    # a helper that HAPPENS to be called P must not alias into the check
+    found = lint("""
+        def P(name):
+            return name
+
+        def run():
+            return P("whatever")
+    """, "sharding-flow")
+    assert found == []
+
+
+def test_sharding_flow_donated_layout_mismatch():
+    found = lint("""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        def build(mesh, fn, devs):
+            m = Mesh(devs, ("dp",))
+            return jax.jit(fn,
+                           in_shardings=(P("dp"), P()),
+                           out_shardings=(P(), P()),
+                           donate_argnums=(0,))
+    """, "sharding-flow")
+    assert len(found) == 1 and "silent copy" in found[0].message
+
+
+def test_sharding_flow_donation_clean_cases():
+    # matching layouts, and the common out_shardings-only state threading
+    found = lint("""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        def build(mesh, fn, devs):
+            m = Mesh(devs, ("dp",))
+            a = jax.jit(fn,
+                        in_shardings=(P("dp"), P()),
+                        out_shardings=(P("dp"), P()),
+                        donate_argnums=(0,))
+            b = jax.jit(fn, out_shardings=(P(), P()),
+                        donate_argnums=(0, 1))
+            return a, b
+    """, "sharding-flow")
+    assert found == []
+
+
+def test_sharding_flow_scoped_to_mxnet_tpu():
+    src = """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def spec(mesh):
+            return NamedSharding(mesh, P("nowhere"))
+    """
+    assert lint(src, "sharding-flow", relpath="tools/helper.py") == []
+    assert len(lint(src, "sharding-flow")) == 1
+
+
+# -- seeded shape bugs (fixture): each new pass catches exactly its bug -----
+
+SHAPE_SEEDED = (REPO / "tests" / "fixtures"
+                / "tpulint_shape_bugs.py").read_text()
+SHAPE_CLEAN = (REPO / "tests" / "fixtures"
+               / "tpulint_shape_clean.py").read_text()
+
+
+def _lint_shape_fixture(src, rule=None):
+    return lint_source("mxnet_tpu/_shape_fixture.py", src,
+                       passes=[rule] if rule else None)
+
+
+def test_shape_seeded_bug_recompile_risk():
+    f = _lint_shape_fixture(SHAPE_SEEDED, "recompile-risk")
+    assert len(f) == 1
+    assert "_STEP" in f[0].message and "⊤" in f[0].message
+
+
+def test_shape_seeded_bug_pallas_kernel_check():
+    f = _lint_shape_fixture(SHAPE_SEEDED, "pallas-kernel-check")
+    assert len(f) == 1 and "last dim 100" in f[0].message
+
+
+def test_shape_seeded_bug_sharding_flow():
+    f = _lint_shape_fixture(SHAPE_SEEDED, "sharding-flow")
+    assert len(f) == 1 and "'tp'" in f[0].message
+
+
+def test_shape_seeded_bugs_exactly_three_across_all_passes():
+    f = _lint_shape_fixture(SHAPE_SEEDED)
+    assert sorted(x.rule for x in f) == \
+        ["pallas-kernel-check", "recompile-risk", "sharding-flow"]
+
+
+def test_shape_clean_fixture_zero_findings_all_passes():
+    """The false-positive suite: the sanctioned bucket-ladder, warmup,
+    knob-shape, scalar-prefetch-pallas and defined-axis idioms produce
+    ZERO findings — across the three new passes AND every other pass."""
+    assert _lint_shape_fixture(SHAPE_CLEAN) == []
+
+
+def test_recompile_risk_zero_findings_on_real_serving_plane():
+    """Acceptance: the REAL decode engine (bucket ladders, warmed step,
+    knob-sized slots) is clean by construction under the abstract
+    interpreter — the PR-3 runtime recompile gauge's zero is now a
+    statically proven property."""
+    serving = [REPO / "mxnet_tpu" / "serving" / p
+               for p in ("decode.py", "engine.py", "buckets.py",
+                         "batcher.py", "kvcache.py")]
+    found = lint_files(serving, passes=["recompile-risk"])
+    assert found == [], "\n".join(map(str, found))
+
+
+# -- cache invalidation on baseline edit (the PR-12 regression) --------------
+
+def test_cache_invalidated_by_baseline_edit(tmp_path, capsys):
+    """Editing the baseline must invalidate cached pass results: a warm
+    run after dropping a baseline entry re-RUNS the passes and
+    re-reports from fresh findings. (Reported findings were already
+    correct — cached results are stored pre-baseline — but cache entries
+    could outlive the baseline they were computed under; keying the
+    cache by baseline content makes the invariant hold at the cache
+    layer, and keeps any future baseline-consulting pass correct by
+    construction.)"""
+    from tools.tpulint.cache import LintCache, baseline_sig
+
+    bad = tmp_path / "v.py"
+    bad.write_text("def f(xs):\n    return [x.asnumpy() for x in xs]\n")
+    bl = tmp_path / "bl.json"
+    cache = tmp_path / "c.json"
+    assert main([str(bad), "--baseline", str(bl), "--write-baseline",
+                 "--cache", str(cache)]) == 0
+    # warm + baselined: clean
+    assert main([str(bad), "--baseline", str(bl), "--cache",
+                 str(cache)]) == 0
+    capsys.readouterr()
+    # drop the baseline entry: the SAME warm cache must re-report
+    bl.write_text('{"version": 1, "counts": {}}\n')
+    assert main([str(bad), "--baseline", str(bl), "--cache",
+                 str(cache)]) == 1
+    assert "host-sync" in capsys.readouterr().out
+    # and the invalidation is at the CACHE layer, not a lucky re-report:
+    # a cache opened under the new baseline signature starts cold
+    stale = LintCache(cache, extra_sig="different-baseline")
+    assert stale.get_local("v.py", "whatever", "host-sync") is None
+    assert baseline_sig(bl) != "" and baseline_sig(None) == ""
+    assert baseline_sig(tmp_path / "missing.json") == ""
+
+
+def test_lint_gate_script_syntax_and_exec_bit():
+    gate = REPO / "tools" / "lint_gate.sh"
+    assert gate.exists()
+    import os
+    assert os.access(str(gate), os.X_OK), "tools/lint_gate.sh must be +x"
+    check = subprocess.run(["bash", "-n", str(gate)], capture_output=True,
+                           text=True)
+    assert check.returncode == 0, check.stderr
+
+
+def test_bench_lint_stamp_fields():
+    """bench.py stamps lint_clean/lint_findings on every JSON line."""
+    import importlib
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_for_lint",
+                                                  str(REPO / "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    stamp = bench._lint_stamp()
+    assert stamp.get("lint_clean") is True, stamp
+    assert stamp.get("lint_findings") == 0, stamp
+    # memoized: the second call must not re-run the linter
+    assert bench._lint_stamp() is stamp
+
+
+# -- review hardening: pinned fixes -----------------------------------------
+
+def test_sharding_flow_axis_name_kwarg_does_not_self_define():
+    # an `axis_name=` kwarg on a COLLECTIVE is a use, not a definition —
+    # it must not legitimize its own typo'd axis
+    found = lint("""
+        import numpy as np
+        from jax import lax
+        from jax.sharding import Mesh
+
+        def collect(devs, x):
+            mesh = Mesh(np.asarray(devs), ("dp",))
+            return lax.psum(x, axis_name="bogus")
+    """, "sharding-flow")
+    assert len(found) == 1 and "'bogus'" in found[0].message
+    # ...while the same kwarg on a mesh CONSTRUCTOR does define the axis
+    clean = lint("""
+        import numpy as np
+        from jax import lax
+        from jax.sharding import Mesh
+
+        def sequence_mesh(devices, axis_name="sp"):
+            return Mesh(np.asarray(devices), (axis_name,))
+
+        def run(devs, x):
+            mesh = sequence_mesh(devs, axis_name="sp")
+            return lax.psum(x, axis_name="sp")
+    """, "sharding-flow")
+    assert clean == []
+
+
+def test_pallas_check_smem_scratch_exempt():
+    # SMEM is scalar memory: no (sublane, lane) tiling, not in the VMEM
+    # pool — the standard (1, 1) scalar scratch must not be flagged or
+    # counted into the budget
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def run(x, kern):
+            return pl.pallas_call(
+                kern,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+                scratch_shapes=[pltpu.SMEM((1, 1), jnp.int32)],
+            )(x)
+    """, "pallas-kernel-check")
+    assert found == []
+
+
+def test_write_baseline_rekeys_cache_to_new_baseline(tmp_path):
+    # --write-baseline changes the baseline content: the cache must be
+    # re-keyed to the NEW baseline so the next run starts warm (not a
+    # silently cold "warm" lap that trips the lint_gate time gate)
+    from tools.tpulint.cache import LintCache, baseline_sig
+
+    bad = tmp_path / "v.py"
+    bad.write_text("def f(xs):\n    return [x.asnumpy() for x in xs]\n")
+    bl = tmp_path / "bl.json"
+    cache = tmp_path / "c.json"
+    assert main([str(bad), "--baseline", str(bl), "--write-baseline",
+                 "--cache", str(cache)]) == 0
+    warm = LintCache(cache, extra_sig=baseline_sig(bl))
+    # entries survived the re-key: a hit under the NEW baseline signature
+    (rel,) = [k for k in warm._entries if k.endswith("v.py")]
+    assert warm.get_local(rel, warm._entries[rel]["sha"],
+                          "host-sync") is not None
+
+
+def test_lint_gate_broken_environment_exits_2(tmp_path):
+    # a crashing linter (rc >= 2) must exit the GATE with 2 — not be
+    # misread as "new findings" via an empty JSON file
+    fake = tmp_path / "fakepy"
+    fake.write_text("#!/bin/sh\nexit 3\n")
+    fake.chmod(0o755)
+    proc = subprocess.run([str(REPO / "tools" / "lint_gate.sh")],
+                          env={"PATH": "/usr/bin:/bin",
+                               "PYTHON": str(fake)},
+                          capture_output=True, text=True)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "failed (rc=3)" in proc.stderr
+
+
+def test_recompile_risk_loop_counter_widens_to_top():
+    # a loop-carried scalar counter over unbounded data is a ⊤ dim —
+    # folding it once would claim a positively-WRONG constant shape
+    found = lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def run(batches):
+            n = 0
+            for b in batches:
+                n += 1
+            return step(np.zeros((n,)))
+    """, "recompile-risk")
+    assert len(found) == 1 and "python-loop counter" in found[0].message
+    # ...but a counter over a BOUNDED iterable inherits the bound
+    clean = lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def run():
+            n = 0
+            for b in (16, 64, 256):
+                n += 1
+            return step(np.zeros((n,)))
+    """, "recompile-risk")
+    assert clean == []
+
+
+def test_pallas_check_vmem_budget_uses_kernel_dtype():
+    # a bf16 kernel's blocks are bf16: ~8 MB true footprint must NOT be
+    # counted at f32 width into a fake over-ceiling finding
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def run(x, kern):
+            return pl.pallas_call(
+                kern,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((1024, 2048), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((16, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((64, 128), jnp.bfloat16),
+            )(x)
+    """, "pallas-kernel-check")
+    assert found == []
+
+
+def test_recompile_risk_bounded_loop_append_is_clean():
+    # fixed-shape accumulate over a literal tuple: the accumulator's
+    # length is the (bounded) trip count, not ⊤
+    found = lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def run():
+            rows = []
+            for r in (16, 64):
+                rows.append(np.zeros((8, 128)))
+            return step(np.stack(rows))
+    """, "recompile-risk")
+    assert found == []
+
+
+def test_recompile_risk_keyword_operand_flagged():
+    # a ⊤-shaped operand passed BY KEYWORD traces exactly like a
+    # positional one
+    found = lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x=None):
+            return x + 1
+
+        def run(data):
+            return step(x=np.zeros((len(data),)))
+    """, "recompile-risk")
+    assert len(found) == 1 and "`x`" in found[0].message
+
+
+def test_pallas_check_defaulted_index_map_params_ok():
+    # lambda i, j=0: legally callable with 1 arg — not an arity mismatch
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def run(x, kern):
+            return pl.pallas_call(
+                kern, grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i, j=0: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+            )(x)
+    """, "pallas-kernel-check")
+    assert found == []
+
+
+def test_sharding_flow_posonly_defaults_alignment():
+    # positional-only params with defaults must not shift the
+    # axis_names default out of (or a non-axis string into) the
+    # definition set
+    found = lint("""
+        import numpy as np
+        from jax import lax
+        from jax.sharding import Mesh
+
+        def make(devices="cpu", /, axis_names=("dp",)):
+            return Mesh(np.asarray(devices), tuple(axis_names))
+
+        def run(x):
+            return lax.psum(x, "dp")
+    """, "sharding-flow")
+    assert found == []
+    bogus = lint("""
+        import numpy as np
+        from jax import lax
+        from jax.sharding import Mesh
+
+        def make(devices="cpu", /, axis_names=("dp",)):
+            return Mesh(np.asarray(devices), tuple(axis_names))
+
+        def run(x):
+            return lax.psum(x, "cpu")
+    """, "sharding-flow")
+    assert len(bogus) == 1 and "'cpu'" in bogus[0].message
+
+
+def test_recompile_risk_min_clamp_is_bounded():
+    # min(len(data), CAP) takes finitely many values: the cap idiom is
+    # warmup-precompilable, not a storm
+    found = lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def run(data):
+            n = min(len(data), 128)
+            return step(np.zeros((n,)))
+    """, "recompile-risk")
+    assert found == []
+    # ...but max() over ⊤ is genuinely unbounded
+    storm = lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def run(data):
+            n = max(len(data), 128)
+            return step(np.zeros((n,)))
+    """, "recompile-risk")
+    assert len(storm) == 1
+
+
+def test_pallas_check_vmem_budget_multi_output_dtype():
+    # out_shape as a LIST of ShapeDtypeStructs (multi-output kernel)
+    # must still feed the bf16 element size into the budget
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def run(x, kern):
+            return pl.pallas_call(
+                kern,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((1024, 2048), lambda i: (i, 0))],
+                out_specs=[pl.BlockSpec((16, 128), lambda i: (i, 0)),
+                           pl.BlockSpec((16, 128), lambda i: (i, 0))],
+                out_shape=[jax.ShapeDtypeStruct((64, 128), jnp.bfloat16),
+                           jax.ShapeDtypeStruct((64, 128), jnp.bfloat16)],
+            )(x)
+    """, "pallas-kernel-check")
+    assert found == []
+
+
+def test_pallas_check_bf16_sublane_applies_to_in_specs():
+    # the kernel dtype (from out_shape) governs EVERY block: an (8, 128)
+    # input block in a bf16 kernel is off the (16, 128) min tile
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def run(x, kern):
+            return pl.pallas_call(
+                kern, grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((16, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((64, 128), jnp.bfloat16),
+            )(x)
+    """, "pallas-kernel-check")
+    assert len(found) == 1
+    assert "second-to-last dim 8" in found[0].message \
+        and "bfloat16" in found[0].message
+
+
+def test_pallas_check_reassigned_local_not_folded():
+    # a name assigned twice has no trustworthy value: the (8, 128)
+    # runtime block must not be flagged with the STALE first value
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def run(x, kern):
+            bs = 100
+            bs = 128
+            return pl.pallas_call(
+                kern, grid=(4,),
+                in_specs=[pl.BlockSpec((8, bs), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+            )(x)
+    """, "pallas-kernel-check")
+    assert found == []
+
+
+def test_recompile_risk_nested_comprehension_binds_own_iter():
+    # the inner generator's target binds from ITS iterator: y is a
+    # bounded ladder rung, not the outer ⊤ loop index
+    found = lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def run(data, ladder=(16, 64)):
+            return [step(np.zeros((y, 4)))
+                    for x in range(len(data)) for y in ladder]
+    """, "recompile-risk")
+    assert found == []
+    # inverse: a ⊤ INNER iterator behind a bounded first generator
+    storm = lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def run(data, ladder=(16, 64)):
+            return [step(np.zeros((n, 4)))
+                    for b in ladder for n in range(len(data))]
+    """, "recompile-risk")
+    assert len(storm) == 1
+
+
+def test_lint_gate_unparseable_output_exits_2(tmp_path):
+    # a linter that exits 0 but emits garbage stdout is a broken tool
+    # (rc 2), not "new findings" (rc 1)
+    fake = tmp_path / "fakepy"
+    fake.write_text("#!/bin/sh\n"
+                    "case \"$1\" in\n"
+                    "  -m) echo 'not json'; exit 0 ;;\n"
+                    # the heredoc check runs under the same $PY: delegate
+                    # to the real python so json parsing actually runs
+                    "  *) exec python3 \"$@\" ;;\n"
+                    "esac\n")
+    fake.chmod(0o755)
+    proc = subprocess.run([str(REPO / "tools" / "lint_gate.sh")],
+                          env={"PATH": "/usr/bin:/bin",
+                               "PYTHON": str(fake)},
+                          capture_output=True, text=True)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "unparseable" in proc.stderr
+
+
+def test_pallas_check_dtype_keyword_argument():
+    # ShapeDtypeStruct((...), dtype=jnp.bfloat16): the keyword spelling
+    # must feed the tile tables exactly like the positional one
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def run(x, kern):
+            return pl.pallas_call(
+                kern, grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((16, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((32, 128),
+                                               dtype=jnp.bfloat16),
+            )(x)
+    """, "pallas-kernel-check")
+    assert len(found) == 1 and "bfloat16" in found[0].message
+
+
+def test_sharding_flow_donation_resolves_named_specs():
+    # an out_shardings referenced through a variable must compare equal
+    # to the literal it was assigned from — no manufactured mismatch
+    found = lint("""
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def build(mesh, fn, devs):
+            m = Mesh(devs, ("dp",))
+            out_spec = P("dp")
+            return jax.jit(fn,
+                           in_shardings=(P("dp"),),
+                           out_shardings=(out_spec,),
+                           donate_argnums=(0,))
+    """, "sharding-flow")
+    assert found == []
+
+
+def test_recompile_risk_posonly_nested_param_shadows_closure():
+    # a positional-only param of a nested def shadows the ⊤ closure
+    # variable: callers decide its shape, the closure value is stale
+    found = lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def outer(items):
+            acc = []
+            for i in items:
+                acc.append(np.asarray(i))
+            batch = np.stack(acc)
+
+            def attempt(batch, /):
+                return step(batch)
+            return attempt
+    """, "recompile-risk")
+    assert found == []
+
+
+def test_join_values_elts_monotone_across_call_sites():
+    # two sites passing the same literal shape keep the tuple; a ⊤
+    # element survives a join against a const one (summary can't mask a
+    # storm-passing site)
+    from tools.tpulint.shapes import AbsValue, Dim, join_values
+
+    t1 = AbsValue(elts=(AbsValue(dim=Dim.const(8)),
+                        AbsValue(dim=Dim.const(16))))
+    t2 = AbsValue(elts=(AbsValue(dim=Dim.const(8)),
+                        AbsValue(dim=Dim.const(16))))
+    same = join_values(t1, t2)
+    assert same.elts is not None and same.elts[1].dim.value == 16
+    t3 = AbsValue(elts=(AbsValue(dim=Dim.const(8)),
+                        AbsValue(dim=Dim.top("len() of host data"))))
+    mixed = join_values(join_values(t1, t3), t2)
+    assert mixed.elts is not None and mixed.elts[1].dim.kind == "top"
+
+
+def test_sharding_flow_donation_name_bound_tuple():
+    # out_shardings referenced as a Name-bound TUPLE must expand to its
+    # elements, not compare as one opaque spec
+    found = lint("""
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def build(mesh, fn, devs):
+            m = Mesh(devs, ("dp",))
+            specs = (P("dp"),)
+            return jax.jit(fn,
+                           in_shardings=(P("dp"),),
+                           out_shardings=specs,
+                           donate_argnums=(0,))
+    """, "sharding-flow")
+    assert found == []
+
+
+def test_pallas_check_positional_out_shape_dtype():
+    # out_shape passed POSITIONALLY (pallas_call's 2nd parameter) must
+    # feed the dtype tables like the keyword spelling
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def run(x, kern):
+            return pl.pallas_call(
+                kern,
+                jax.ShapeDtypeStruct((64, 128), jnp.bfloat16),
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((16, 128), lambda i: (i, 0)),
+            )(x)
+    """, "pallas-kernel-check")
+    assert len(found) == 1 and "bfloat16" in found[0].message
+
+
+def test_cache_sections_alternating_modes_both_warm(tmp_path):
+    # a --no-baseline run between gate runs must not evict the default
+    # section: each baseline signature owns its own entries
+    a = tmp_path / "a.py"
+    a.write_text("def f(xs):\n    return [x.asnumpy() for x in xs]\n")
+    path = tmp_path / "c.json"
+    lint_files([a], root=tmp_path, cache=LintCache(path, extra_sig="bl1"))
+    lint_files([a], root=tmp_path, cache=LintCache(path, extra_sig=""))
+    warm1 = LintCache(path, extra_sig="bl1")
+    lint_files([a], root=tmp_path, cache=warm1)
+    assert warm1.misses == 0 and warm1.hits > 0
+    warm2 = LintCache(path, extra_sig="")
+    lint_files([a], root=tmp_path, cache=warm2)
+    assert warm2.misses == 0 and warm2.hits > 0
+
+
+def test_lint_gate_works_through_symlink(tmp_path):
+    # the documented pre-commit wiring is a SYMLINK into .git/hooks —
+    # the gate must resolve it before deriving the repo root
+    link = tmp_path / "pre-commit"
+    link.symlink_to(REPO / "tools" / "lint_gate.sh")
+    proc = subprocess.run([str(link)], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "lint_gate: clean" in proc.stdout
+
+
+def test_sharding_flow_donation_conditional_reassignment_bails():
+    # a spec reassigned across branches has no single provable value:
+    # picking either branch would report a mismatch no execution path
+    # contains — the check must bail
+    found = lint("""
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def build(mesh, fn, devs, compat):
+            m = Mesh(devs, ("dp", "mp"))
+            in_spec = P("mp")
+            out_spec = P("dp")
+            if compat:
+                in_spec = P("dp")
+            else:
+                out_spec = P("mp")
+            return jax.jit(fn,
+                           in_shardings=(in_spec,),
+                           out_shardings=(out_spec,),
+                           donate_argnums=(0,))
+    """, "sharding-flow")
+    assert found == []
+
+
+def test_sharding_flow_donation_spelling_variants_compare_equal():
+    # P("dp") vs PartitionSpec("dp") vs NamedSharding(mesh, P("dp")) are
+    # the SAME layout — spelling must not manufacture a mismatch
+    found = lint("""
+        import jax
+        from jax.sharding import (Mesh, NamedSharding, PartitionSpec,
+                                  PartitionSpec as P)
+
+        def build(mesh, fn, devs):
+            m = Mesh(devs, ("dp",))
+            return jax.jit(fn,
+                           in_shardings=(P("dp"), NamedSharding(m, P())),
+                           out_shardings=(PartitionSpec("dp"), P()),
+                           donate_argnums=(0, 1))
+    """, "sharding-flow")
+    assert found == []
+
+
+def test_cache_sections_capped_lru(tmp_path):
+    # superseded baseline signatures are pruned LRU on save — the file
+    # cannot grow one orphaned full-scope section per baseline edit
+    from tools.tpulint.cache import MAX_SECTIONS
+
+    a = tmp_path / "a.py"
+    a.write_text("def f(xs):\n    return [x.asnumpy() for x in xs]\n")
+    path = tmp_path / "c.json"
+    for i in range(MAX_SECTIONS + 3):
+        lint_files([a], root=tmp_path,
+                   cache=LintCache(path, extra_sig="bl%d" % i))
+    data = json.loads(path.read_text())
+    assert len(data["sections"]) <= MAX_SECTIONS
+    assert "bl%d" % (MAX_SECTIONS + 2) in data["sections"]  # newest kept
+
+
+def test_recompile_risk_chained_knob_parse_clean():
+    # the chained spelling `get_env(..., typ=str).split(",")` carries
+    # the same knob-str provenance as the assigned-name spelling
+    found = lint("""
+        import jax
+        import numpy as np
+        from .base import get_env
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def warmup():
+            rungs = [int(s) for s in
+                     get_env("MXNET_BUCKETS", "1,4", typ=str).split(",")]
+            for r in rungs:
+                out = []
+                for _ in range(4):
+                    out.append(np.zeros((r, 8)))
+                step(np.stack(out))
+    """, "recompile-risk")
+    assert found == []
+
+
+def test_cache_warm_runs_persist_lru_stamp(tmp_path):
+    # fully-warm laps must persist their recency, or eviction retires
+    # the most-actively-used section while keeping dead ones
+    from tools.tpulint.cache import MAX_SECTIONS
+
+    a = tmp_path / "a.py"
+    a.write_text("def f(xs):\n    return [x.asnumpy() for x in xs]\n")
+    path = tmp_path / "c.json"
+    lint_files([a], root=tmp_path, cache=LintCache(path, extra_sig="hot"))
+    for sig in ("cold1", "cold2"):
+        lint_files([a], root=tmp_path, cache=LintCache(path, extra_sig=sig))
+    # warm re-use of "hot" (no pass runs) must still refresh its stamp
+    warm = LintCache(path, extra_sig="hot")
+    lint_files([a], root=tmp_path, cache=warm)
+    assert warm.misses == 0
+    # push past the cap with fresh signatures: "hot" survives, the
+    # stalest cold section is evicted
+    for i in range(MAX_SECTIONS - 1):
+        lint_files([a], root=tmp_path,
+                   cache=LintCache(path, extra_sig="new%d" % i))
+    data = json.loads(path.read_text())
+    assert "hot" in data["sections"]
+    assert "cold1" not in data["sections"]
+
+
+def test_sharding_flow_donation_trailing_none_padding():
+    # P("dp") == P("dp", None): PartitionSpec pads trailing dims
+    found = lint("""
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def build(mesh, fn, devs):
+            m = Mesh(devs, ("dp",))
+            return jax.jit(fn,
+                           in_shardings=(P("dp", None),),
+                           out_shardings=(P("dp"),),
+                           donate_argnums=(0,))
+    """, "sharding-flow")
+    assert found == []
+
+
+def test_sharding_flow_donation_bails_on_static_argnums():
+    # static args shift donate_argnums vs in_shardings: unprovable
+    found = lint("""
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def build(mesh, fn, devs):
+            m = Mesh(devs, ("dp",))
+            return jax.jit(fn, static_argnums=(0,),
+                           in_shardings=(P("dp"), P(None)),
+                           out_shardings=(P("dp"),),
+                           donate_argnums=(1,))
+    """, "sharding-flow")
+    assert found == []
+
+
+def test_pallas_check_unfoldable_local_shadows_module_const():
+    # a runtime-chosen local TILE shadows the module-level TILE = 100:
+    # the stale module value must not manufacture a tile finding
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        TILE = 100
+
+        def run(x, kern, pick_tile):
+            TILE = pick_tile(x)
+            return pl.pallas_call(
+                kern, grid=(4,),
+                in_specs=[pl.BlockSpec((8, TILE), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+            )(x)
+    """, "pallas-kernel-check")
+    assert found == []
+
+
+def test_pallas_check_positional_prefetch_grid_spec():
+    # PrefetchScalarGridSpec(3, grid=(4, 2), ...) — positional
+    # num_scalar_prefetch must feed the arity check
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def run(x, tbl, kern):
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                1,
+                grid=(4, 2),
+                in_specs=[pl.BlockSpec((8, 128),
+                                       lambda i, j, t: (t[i], j))],
+                out_specs=pl.BlockSpec((8, 128),
+                                       lambda i, j, t: (i, j)),
+            )
+            return pl.pallas_call(
+                kern, grid_spec=grid_spec,
+                out_shape=jax.ShapeDtypeStruct((32, 256), jnp.float32),
+            )(tbl, x)
+    """, "pallas-kernel-check")
+    assert found == []
+
+
+def test_pallas_check_loop_target_shadows_module_const():
+    # a for-loop target shadowing a module const must drop the name
+    # from the folder — no finding about a value no path holds
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        W = 100
+
+        def run(x, kern):
+            outs = []
+            for W in (128, 256):
+                outs.append(pl.pallas_call(
+                    kern, grid=(4,),
+                    in_specs=[pl.BlockSpec((8, W), lambda i: (i, 0))],
+                    out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct((32, 128),
+                                                   jnp.float32),
+                )(x))
+            return outs
+    """, "pallas-kernel-check")
+    assert found == []
+
+
+def test_pallas_check_posonly_lambda_params_counted():
+    # lambda i, /, j: two positional params — not an arity mismatch
+    # against a 2-dim grid
+    found = lint("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def run(x, kern):
+            return pl.pallas_call(
+                kern, grid=(4, 4),
+                in_specs=[pl.BlockSpec((8, 128),
+                                       index_map=lambda i, /, j: (i, j))],
+                out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+                out_shape=jax.ShapeDtypeStruct((32, 512), jnp.float32),
+            )(x)
+    """, "pallas-kernel-check")
+    assert found == []

@@ -14,7 +14,19 @@ negligible:
   graph is never built (the warm run does hashing + JSON only);
 - the whole cache is versioned by a hash of the linter's own sources
   (:data:`LINT_SOURCE_VERSION`), so editing a pass invalidates stale
-  results without a manual version bump.
+  results without a manual version bump;
+- the cache is additionally keyed by the **baseline content**
+  (``extra_sig`` — every entry point hashes the active baseline file):
+  editing ``baseline.json`` invalidates cached pass results, so no
+  cached result can outlive the baseline it was computed under — the
+  warm run after a baseline edit re-RUNS the passes and re-reports from
+  fresh findings (the PR-12 contract; it also keeps any future
+  baseline-consulting pass correct by construction). Each baseline
+  signature owns its own *section* of entries: a ``--no-baseline`` run
+  between gate runs doesn't evict the default section, so alternating
+  modes each stay warm. ``--write-baseline`` moves the active section
+  to the just-written baseline (:meth:`LintCache.rekey`) so the next
+  run stays warm.
 
 Cached findings are stored *post-suppression* (suppression comments live
 in the hashed file content, so a hit is exact). Writes are atomic
@@ -33,6 +45,11 @@ from .core import Finding
 
 DEFAULT_CACHE_PATH = Path(__file__).resolve().parent.parent.parent \
     / ".tpulint-cache.json"
+
+#: Most-recently-used baseline-signature sections kept on save: enough
+#: for the default baseline, a ``--no-baseline`` section and one
+#: in-flight edit, without letting superseded baselines accumulate.
+MAX_SECTIONS = 3
 
 
 def _source_version() -> str:
@@ -54,6 +71,17 @@ LINT_SOURCE_VERSION = _source_version()
 
 def file_sha(data: bytes) -> str:
     return hashlib.sha256(data).hexdigest()[:16]
+
+
+def baseline_sig(path: Optional[Path]) -> str:
+    """Content hash of a baseline file (empty-string for a missing or
+    unset baseline) — the ``extra_sig`` the CLI keys the cache by."""
+    if path is None:
+        return ""
+    try:
+        return file_sha(Path(path).read_bytes())
+    except OSError:
+        return ""
 
 
 def scope_signature(shas: Sequence[Tuple[str, str]]) -> str:
@@ -78,18 +106,36 @@ def _finding_from_dict(d: dict) -> Finding:
 class LintCache:
     """On-disk cache of per-(file, pass) findings."""
 
-    def __init__(self, path: Path = DEFAULT_CACHE_PATH):
+    def __init__(self, path: Path = DEFAULT_CACHE_PATH, extra_sig: str = ""):
         self.path = Path(path)
         self.hits = 0
         self.misses = 0
         self._dirty = False
-        self._entries: Dict[str, dict] = {}
+        self._sig = extra_sig
+        # one SECTION of entries per baseline signature: results under a
+        # different baseline are invisible (the invalidation contract)
+        # but not destroyed — alternating `--no-baseline`/default runs
+        # each keep their own warm section instead of ping-ponging the
+        # whole file cold. Sections carry an activation stamp; save()
+        # keeps the MAX_SECTIONS most recently used, so superseded
+        # baselines can't accumulate orphans forever.
+        self._sections: Dict[str, dict] = {}
         try:
             data = json.loads(self.path.read_text(encoding="utf-8"))
             if data.get("version") == LINT_SOURCE_VERSION:
-                self._entries = data.get("files", {})
+                self._sections = data.get("sections", {})
         except (OSError, ValueError):
             pass
+        top = max((s.get("stamp", 0) for s in self._sections.values()),
+                  default=0)
+        section = self._sections.setdefault(self._sig, {"files": {}})
+        if section.get("stamp", 0) != top or top == 0:
+            # mark the bump dirty so fully-warm runs PERSIST their
+            # recency — otherwise the LRU eviction would retire the
+            # most-actively-used section on the next baseline edit
+            section["stamp"] = top + 1
+            self._dirty = True
+        self._entries: Dict[str, dict] = section.setdefault("files", {})
 
     # -- local passes -------------------------------------------------------
 
@@ -138,20 +184,45 @@ class LintCache:
             self._entries[relpath] = ent
         return ent
 
+    def rekey(self, extra_sig: str = "") -> None:
+        """Move the active section under a new extra signature (the
+        just-written baseline's hash) — without this, a
+        ``--write-baseline`` run would leave its fresh results keyed by
+        the OLD baseline that the very next run cannot use (a silently
+        cold 'warm' lap)."""
+        if extra_sig == self._sig:
+            return
+        section = self._sections.pop(self._sig)
+        self._sections[extra_sig] = section
+        self._sig = extra_sig
+        self._dirty = True
+
     def save(self, root: Optional[Path] = None) -> None:
         # prune entries whose file no longer exists under the lint root
         # (deleted/renamed — keeps the cache from growing monotonically
         # across refactors); out-of-scope but LIVE files are deliberately
         # kept, so a narrowed run never evicts the full-scope cache
         if root is not None:
-            for rel in list(self._entries):
-                p = Path(rel) if os.path.isabs(rel) else Path(root) / rel
-                if not p.exists():
-                    del self._entries[rel]
-                    self._dirty = True
+            for section in self._sections.values():
+                files = section.get("files", {})
+                for rel in list(files):
+                    p = Path(rel) if os.path.isabs(rel) else Path(root) / rel
+                    if not p.exists():
+                        del files[rel]
+                        self._dirty = True
+        # superseded baseline signatures would otherwise accumulate one
+        # orphaned full-scope section per baseline edit: keep only the
+        # most recently used few (the active one holds the top stamp)
+        if len(self._sections) > MAX_SECTIONS:
+            by_age = sorted(self._sections,
+                            key=lambda s: self._sections[s].get("stamp", 0))
+            for sig in by_age[:len(self._sections) - MAX_SECTIONS]:
+                del self._sections[sig]
+                self._dirty = True
         if not self._dirty:
             return
-        payload = {"version": LINT_SOURCE_VERSION, "files": self._entries}
+        payload = {"version": LINT_SOURCE_VERSION,
+                   "sections": self._sections}
         tmp = "%s.tmp.%d" % (self.path, os.getpid())
         try:
             with open(tmp, "w", encoding="utf-8") as fh:

@@ -10,7 +10,7 @@ import sys
 from pathlib import Path
 from typing import List, Optional, Sequence, Tuple
 
-from .cache import DEFAULT_CACHE_PATH, LintCache
+from .cache import DEFAULT_CACHE_PATH, LintCache, baseline_sig
 from .core import (DEFAULT_BASELINE, DEFAULT_ROOTS, REPO_ROOT, Finding,
                    all_passes, apply_baseline, baseline_counts, collect_files,
                    key_scope, lint_files, load_baseline, load_justifications,
@@ -42,13 +42,19 @@ def filter_to_scope(changed: Sequence[str], scope: Sequence[Path],
 
 
 def lint_paths(paths: Sequence[str], baseline_path: Optional[Path] = DEFAULT_BASELINE,
-               passes: Optional[Sequence[str]] = None,
+               passes: Optional[Sequence[str]] = None, cache: bool = True,
                ) -> Tuple[List[Finding], List[Finding]]:
     """Lint `paths`; returns ``(new_findings, all_findings)`` where *new*
     means not covered by the baseline (all of them when ``baseline_path``
-    is None)."""
+    is None). ``cache=True`` (default) shares the CLI's incremental
+    cache — keyed by the baseline content like every other entry point —
+    so programmatic callers (the tier-1 gate test, bench.py's per-line
+    ``lint_clean`` stamp) pay ~20ms warm instead of a cold whole-program
+    run."""
     files = collect_files(paths)
-    findings = lint_files(files, passes=passes)
+    lc = LintCache(DEFAULT_CACHE_PATH,
+                   extra_sig=baseline_sig(baseline_path)) if cache else None
+    findings = lint_files(files, passes=passes, cache=lc)
     baseline = load_baseline(baseline_path) if baseline_path else {}
     return apply_baseline(findings, baseline), findings
 
@@ -126,7 +132,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     import time
 
     t0 = time.perf_counter()
-    cache = None if args.no_cache else LintCache(args.cache)
+    # the cache is keyed by the baseline CONTENT: editing the baseline
+    # invalidates cached pass results, so a warm run re-runs and
+    # re-reports instead of serving results computed in the old world
+    cache = None if args.no_cache else LintCache(
+        args.cache, extra_sig=baseline_sig(
+            None if args.no_baseline else args.baseline))
     stats: dict = {}
     findings = lint_files(files, passes=passes, cache=cache, stats=stats,
                           project_scope=project_scope)
@@ -155,6 +166,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # keep each surviving entry's one-line justification
         write_baseline_counts(merged, args.baseline,
                               justifications=load_justifications(args.baseline))
+        if cache is not None:
+            # the cache on disk is keyed by the PRE-write baseline: re-key
+            # to the baseline just written so the next run starts warm
+            cache.rekey(baseline_sig(args.baseline))
+            cache.save(root=REPO_ROOT)
         print("tpulint: wrote %d finding(s) to %s (%d kept from outside this "
               "run's scope)" % (sum(merged.values()), args.baseline,
                                sum(merged.values()) - len(findings)))

@@ -1,0 +1,394 @@
+"""pallas-kernel-check: static verification of ``pl.pallas_call`` sites.
+
+A Pallas kernel that violates the TPU layout contract fails *only on
+real hardware* — interpret mode (the CPU tier-1 path) checks the math,
+not the tiling, so a misaligned block or an oversized VMEM footprint
+ships green and dies (or silently degrades) in the next chip window.
+This pass checks, at every ``pl.pallas_call`` whose parameters resolve
+statically (module consts like ``LANES = 128`` and local const algebra
+are folded; symbolic dims are skipped, never guessed):
+
+- **block tile alignment**: a BlockSpec/scratch block's last dim must be
+  a multiple of the 128-lane tile and its second-to-last a multiple of
+  the dtype's sublane count ((8, 128) f32, (16, 128) bf16, (32, 128)
+  int8 — /opt/skills/guides/pallas_guide.md), unless the dim is 1 (an
+  untiled leading axis);
+- **grid ↔ index_map arity**: each ``index_map`` lambda must take
+  exactly ``len(grid)`` arguments plus one per scalar-prefetch operand
+  (``PrefetchScalarGridSpec(num_scalar_prefetch=N)`` appends the N
+  scalar refs) — an arity mismatch is a TypeError at first trace on
+  device, after the CPU suite passed;
+- **scalar-prefetch consistency**: ``num_scalar_prefetch`` must be a
+  non-negative constant and the grid must be present when it is used;
+- **VMEM budget**: the summed footprint of all const-shaped blocks
+  (×2 for the in/out pipeline's double buffering) plus scratch must fit
+  the ~16 MB/core VMEM ceiling; an overflow is an OOM (or a silent
+  spill) the first time the kernel runs on silicon.
+
+File-local and deliberately under-approximate: a shape the const folder
+cannot resolve contributes nothing — the pass proves violations, it
+does not prove kernels correct.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..core import (FileContext, Finding, Pass, dotted_name,
+                    enclosing_function, register)
+from ..shapes import (AbsValue, Dim, const_int, module_const_env,
+                      resolve_name as _resolve_name)
+
+LANES = 128
+VMEM_BYTES = 16 * 1024 * 1024
+
+#: dtype-name tail -> (sublane tile, bytes per element)
+_DTYPES = {
+    "float32": (8, 4), "f32": (8, 4), "int32": (8, 4), "uint32": (8, 4),
+    "bfloat16": (16, 2), "float16": (16, 2), "int16": (16, 2),
+    "int8": (32, 1), "uint8": (32, 1), "float8_e4m3fn": (32, 1),
+    "float8_e5m2": (32, 1), "bool_": (32, 1),
+    "float64": (8, 8), "int64": (8, 8),
+}
+
+
+def _dtype_of(expr: Optional[ast.AST]) -> Optional[str]:
+    """``jnp.float32`` / ``"bfloat16"`` -> dtype-name tail."""
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    d = dotted_name(expr)
+    if d:
+        tail = d.rsplit(".", 1)[-1]
+        if tail in _DTYPES:
+            return tail
+    return None
+
+
+def _local_const_env(fn: Optional[ast.AST],
+                     mod_env: Dict[str, AbsValue]) -> Dict[str, AbsValue]:
+    """Module consts plus simple ``name = <const expr>`` bindings in the
+    enclosing function (resolved recursively through const_int). A name
+    the function assigns MORE than once is dropped entirely — folding
+    either value could name a constant the code no longer holds at the
+    call site (a wrong-value finding is worse than a skipped check), and
+    a single local assignment shadows any same-named module const."""
+    env = dict(mod_env)
+    if fn is None:
+        return env
+    def bound_names(target):
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                yield n.id
+
+    assigns: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            assigns.setdefault(node.targets[0].id, []).append(node.value)
+        elif isinstance(node, ast.Assign):
+            for name in bound_names(node.targets[0] if len(node.targets)
+                                    == 1 else ast.Tuple(elts=node.targets)):
+                assigns.setdefault(name, []).append(None)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) \
+                and isinstance(node.target, ast.Name):
+            assigns.setdefault(node.target.id, []).append(None)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            # any other binding form (loop targets, with-as, tuple
+            # unpack, comprehension targets) shadows without a foldable
+            # value — the name must drop out of the env, not leak the
+            # stale module const
+            for name in bound_names(node.target):
+                assigns.setdefault(name, []).append(None)
+        elif isinstance(node, ast.comprehension):
+            for name in bound_names(node.target):
+                assigns.setdefault(name, []).append(None)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    for name in bound_names(item.optional_vars):
+                        assigns.setdefault(name, []).append(None)
+    once = {name: values[0] for name, values in assigns.items()
+            if len(values) == 1 and values[0] is not None}
+    for name in assigns:
+        if name not in once:
+            env.pop(name, None)  # reassigned: no trustworthy value
+    folded: set = set()
+    for _ in range(3):  # chase simple chains (a = 8; b = a * 2)
+        changed = False
+        for name, value in once.items():
+            v = const_int(value, env)
+            if v is not None and (name not in env
+                                  or env[name].dim is None
+                                  or env[name].dim.value != v):
+                env[name] = AbsValue(dim=Dim.const(v))
+                changed = True
+            if v is not None:
+                folded.add(name)
+        if not changed:
+            break
+    for name in once:
+        if name not in folded:
+            # `TILE = pick_tile(x)` shadows a module-level TILE even
+            # when unfoldable — the stale module value must not leak
+            # into the checks
+            env.pop(name, None)
+    return env
+
+
+class _SpecInfo:
+    __slots__ = ("node", "block", "index_map", "role")
+
+    def __init__(self, node, block, index_map, role):
+        self.node = node          # the BlockSpec call
+        self.block = block        # Optional[List[Optional[int]]] const dims
+        self.index_map = index_map  # Optional[ast.Lambda]
+        self.role = role          # "in" | "out"
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _block_dims(shape_expr: ast.AST,
+                env: Dict[str, AbsValue]) -> Optional[List[Optional[int]]]:
+    if not isinstance(shape_expr, (ast.Tuple, ast.List)):
+        return None
+    return [const_int(e, env) for e in shape_expr.elts]
+
+
+def _collect_specs(expr: Optional[ast.AST], role: str, fn,
+                   env: Dict[str, AbsValue]) -> List[_SpecInfo]:
+    """BlockSpec calls out of an in_specs/out_specs expression (a single
+    spec, or a list/tuple of them)."""
+    if expr is None:
+        return []
+    expr = _resolve_name(expr, fn)
+    items = expr.elts if isinstance(expr, (ast.Tuple, ast.List)) else [expr]
+    out: List[_SpecInfo] = []
+    for item in items:
+        if not (isinstance(item, ast.Call)
+                and (dotted_name(item.func) or "").rsplit(".", 1)[-1]
+                == "BlockSpec"):
+            continue
+        block = None
+        index_map = None
+        if item.args:
+            block = _block_dims(_resolve_name(item.args[0], fn), env)
+        if len(item.args) >= 2 and isinstance(item.args[1], ast.Lambda):
+            index_map = item.args[1]
+        km = _kwarg(item, "index_map")
+        if isinstance(km, ast.Lambda):
+            index_map = km
+        kb = _kwarg(item, "block_shape")
+        if kb is not None:
+            block = _block_dims(_resolve_name(kb, fn), env)
+        out.append(_SpecInfo(item, block, index_map, role))
+    return out
+
+
+def _scratch_shapes(expr: Optional[ast.AST], fn,
+                    env: Dict[str, AbsValue]
+                    ) -> List[Tuple[ast.AST, Optional[List[Optional[int]]],
+                                    Optional[str]]]:
+    """``scratch_shapes=[pltpu.VMEM((a, b), jnp.float32), ...]`` ->
+    (node, const dims, dtype). SMEM scratch (scalar memory, not subject
+    to (sublane, lane) tiling and not drawn from the VMEM pool) is
+    deliberately excluded."""
+    if expr is None:
+        return []
+    expr = _resolve_name(expr, fn)
+    if not isinstance(expr, (ast.Tuple, ast.List)):
+        return []
+    out = []
+    for item in expr.elts:
+        if not (isinstance(item, ast.Call)
+                and (dotted_name(item.func) or "").rsplit(".", 1)[-1]
+                == "VMEM"):
+            continue
+        dims = _block_dims(item.args[0], env) if item.args else None
+        dtype = _dtype_of(item.args[1]) if len(item.args) >= 2 \
+            else _dtype_of(_kwarg(item, "dtype"))
+        out.append((item, dims, dtype))
+    return out
+
+
+@register
+class PallasKernelCheckPass(Pass):
+    name = "pallas-kernel-check"
+    description = ("pl.pallas_call static verification: (8,128)/dtype "
+                   "sublane block tiles, grid<->index_map arity, "
+                   "scalar-prefetch consistency, ~16MB VMEM budget")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        mod_env = module_const_env(ctx.tree)
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if (dotted_name(call.func) or "").rsplit(".", 1)[-1] \
+                    != "pallas_call":
+                continue
+            fn = enclosing_function(call)
+            env = _local_const_env(fn, mod_env)
+            yield from self._check_call(ctx, call, fn, env)
+
+    # ------------------------------------------------------------------
+    def _check_call(self, ctx: FileContext, call: ast.Call, fn,
+                    env: Dict[str, AbsValue]) -> Iterator[Finding]:
+        grid_expr = _kwarg(call, "grid")
+        num_prefetch = 0
+        gs_call: Optional[ast.Call] = None
+        gs_expr = _kwarg(call, "grid_spec")
+        if gs_expr is not None:
+            resolved = _resolve_name(gs_expr, fn)
+            if isinstance(resolved, ast.Call) and (
+                    dotted_name(resolved.func) or "").rsplit(".", 1)[-1] \
+                    in ("PrefetchScalarGridSpec", "GridSpec"):
+                gs_call = resolved
+        src = gs_call if gs_call is not None else call
+        if gs_call is not None:
+            # PrefetchScalarGridSpec(num_scalar_prefetch, grid=...) and
+            # GridSpec(grid, ...) both allow the positional spelling
+            gs_tail = (dotted_name(gs_call.func) or "").rsplit(".", 1)[-1]
+            pos = list(gs_call.args)
+            if gs_tail == "GridSpec":
+                pos.insert(0, None)  # GridSpec has no prefetch slot
+            grid_expr = _kwarg(gs_call, "grid") \
+                or (pos[1] if len(pos) >= 2 else None) or grid_expr
+            np_expr = _kwarg(gs_call, "num_scalar_prefetch") \
+                or (pos[0] if pos else None)
+            if np_expr is not None:
+                npv = const_int(np_expr, env)
+                if npv is None or npv < 0:
+                    yield ctx.finding(
+                        np_expr if npv is not None else gs_call, self.name,
+                        "num_scalar_prefetch must be a non-negative "
+                        "constant — a traced/negative value breaks the "
+                        "scalar-prefetch ref layout at device trace time")
+                else:
+                    num_prefetch = npv
+
+        grid_len: Optional[int] = None
+        if grid_expr is not None:
+            g = _resolve_name(grid_expr, fn)
+            if isinstance(g, (ast.Tuple, ast.List)):
+                grid_len = len(g.elts)
+            else:
+                gv = const_int(g, env)
+                if gv is not None:
+                    grid_len = 1
+
+        specs = _collect_specs(_kwarg(src, "in_specs"), "in", fn, env) \
+            + _collect_specs(_kwarg(src, "out_specs"), "out", fn, env)
+        out_dtype = None
+        # out_shape is pallas_call's SECOND positional parameter — both
+        # spellings must feed the dtype tables or the f32 fallback
+        # silently blesses off-tile bf16 blocks
+        out_shape = _kwarg(call, "out_shape")
+        if out_shape is None and len(call.args) >= 2:
+            out_shape = call.args[1]
+        # single ShapeDtypeStruct, or a list/tuple of them (multi-output
+        # kernels): one unambiguous dtype feeds the tile/budget checks
+        out_items = out_shape.elts if isinstance(
+            out_shape, (ast.Tuple, ast.List)) else [out_shape]
+        dtypes = {_dtype_of(o.args[1]) if len(o.args) >= 2
+                  else _dtype_of(_kwarg(o, "dtype"))
+                  for o in out_items if isinstance(o, ast.Call)}
+        dtypes.discard(None)
+        if len(dtypes) == 1:
+            out_dtype = dtypes.pop()
+
+        # 1. grid <-> index_map arity (+ scalar-prefetch refs)
+        if grid_len is not None:
+            expected = grid_len + num_prefetch
+            for spec in specs:
+                lam = spec.index_map
+                if lam is None:
+                    continue
+                n_params = len(getattr(lam.args, "posonlyargs", [])) \
+                    + len(lam.args.args)
+                # defaulted trailing params are legally omittable: the
+                # lambda accepts anything in [required, total]
+                required = n_params - len(lam.args.defaults)
+                if lam.args.vararg is not None \
+                        or required <= expected <= n_params:
+                    continue
+                yield ctx.finding(
+                    lam, self.name,
+                    "index_map takes %d argument(s) but the grid has %d "
+                    "dim(s)%s — arity mismatch, a trace-time TypeError on "
+                    "device" % (
+                        n_params, grid_len,
+                        " plus %d scalar-prefetch ref(s)" % num_prefetch
+                        if num_prefetch else ""))
+
+        # 2. block tile alignment — the out_shape dtype speaks for every
+        # block (a bf16 kernel's inputs are bf16 too, and its (16, 128)
+        # min tile catches what the f32 (8, 128) fallback would bless)
+        for spec in specs:
+            yield from self._check_tiles(ctx, spec.node, spec.block,
+                                         out_dtype, "BlockSpec")
+        scratch = _scratch_shapes(_kwarg(src, "scratch_shapes")
+                                  or _kwarg(call, "scratch_shapes"), fn, env)
+        for node, dims, dtype in scratch:
+            yield from self._check_tiles(ctx, node, dims, dtype,
+                                         "VMEM scratch")
+
+        # 3. VMEM budget: const-resolvable blocks only (under-approximate)
+        total = 0
+        for spec in specs:
+            if spec.block and all(d is not None for d in spec.block):
+                size = 1
+                for d in spec.block:
+                    size *= d
+                # the kernel's element size: the out_shape dtype is the
+                # best single estimate for EVERY block (a bf16 kernel's
+                # inputs are bf16 too — counting them as f32 would
+                # manufacture over-ceiling findings); f32 only when the
+                # call declares no dtype at all
+                _, esize = _DTYPES.get(out_dtype or "float32", (8, 4))
+                total += size * esize * 2  # pipeline double buffering
+        for _node, dims, dtype in scratch:
+            if dims and all(d is not None for d in dims):
+                size = 1
+                for d in dims:
+                    size *= d
+                total += size * _DTYPES.get(dtype or "float32", (8, 4))[1]
+        if total > VMEM_BYTES:
+            yield ctx.finding(
+                call, self.name,
+                "summed BlockSpec+scratch VMEM estimate %.1f MB exceeds "
+                "the ~16 MB/core ceiling (block buffers are double-"
+                "buffered by the pipeline) — shrink the block shapes or "
+                "split the kernel" % (total / (1024.0 * 1024.0)))
+
+    def _check_tiles(self, ctx: FileContext, node: ast.AST,
+                     dims: Optional[List[Optional[int]]],
+                     dtype: Optional[str], what: str) -> Iterator[Finding]:
+        if not dims or len(dims) < 2:
+            return
+        sublane, _ = _DTYPES.get(dtype or "float32", (8, 4))
+        last, second = dims[-1], dims[-2]
+        if last is not None and last % LANES != 0:
+            yield ctx.finding(
+                node, self.name,
+                "%s last dim %d is not a multiple of the %d-lane tile "
+                "(dtype %s wants (%d, %d) tiles) — Mosaic pads or rejects "
+                "the layout on device" % (what, last, LANES,
+                                          dtype or "float32/unknown",
+                                          sublane, LANES))
+        if second is not None and second != 1 and second % sublane != 0:
+            yield ctx.finding(
+                node, self.name,
+                "%s second-to-last dim %d is not a multiple of the "
+                "%d-sublane tile for dtype %s ((%d, %d) min tile) — "
+                "misaligned sublanes force a relayout on every DMA"
+                % (what, second, sublane, dtype or "float32/unknown",
+                   sublane, LANES))

@@ -1,0 +1,53 @@
+"""recompile-risk: jit sites statically reachable with ⊤-shaped operands.
+
+The zero-steady-state-recompile invariant is the serving/training
+planes' hottest property — and until now it was only *measured*: the
+PR-3 jit-cache-growth gauge catches a recompile storm after a warm lap
+on real hardware, a full bench round after the PR that caused it. This
+pass makes it *provable* before execution: the abstract shape
+interpreter (:mod:`tools.tpulint.shapes`) propagates a symbolic
+dimension domain — constants, ``MXNET_*`` knob reads, bounded
+bucket-ladder sets, ⊤ for data-dependent sizes — interprocedurally
+through the PR-10 project graph into every jit/pallas dispatch site
+(direct calls of ``jax.jit`` values, ``@jit``-decorated functions,
+jit-valued ``self._step``-style attributes, and the
+``telemetry.jit_call``/``resilience.call`` wrappers).
+
+Flagged: a dispatch whose operand shape contains ⊤ — a dimension
+positively derived from ``len()`` of host data, ``.shape`` of queue
+payloads, or a python-loop accumulator. Every distinct runtime value of
+that dimension compiles a new executable; in steady state that is a
+recompile storm no warmup can cover.
+
+Clean **by construction** (never flagged): const dims (one compile),
+knob-derived dims (one compile per process), bounded bucket-ladder
+rungs and ``select_bucket`` results (one compile per rung — exactly
+what ``warmup()`` pre-compiles), and unknown dims (ignorance is not
+evidence; the pass only reports positively-derived unboundedness).
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..core import FileContext, Finding, Pass, register
+from .. import shapes
+
+
+@register
+class RecompileRiskPass(Pass):
+    name = "recompile-risk"
+    description = ("jit/pallas dispatch sites reachable with ⊤-shaped "
+                   "(data-dependent) operands — statically predicted "
+                   "steady-state recompiles")
+    project = True
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        graph = ctx.project
+        if graph is None:
+            return
+        ana = shapes.analyze(graph)
+        for risk in ana.jit_risks.get(ctx.relpath, ()):
+            yield ctx.finding(risk.node, self.name, risk.message())

@@ -1,0 +1,252 @@
+"""sharding-flow: mesh-axis and donation-layout consistency checks.
+
+GSPMD sharding annotations are stringly-typed: a ``PartitionSpec`` axis
+name is only checked against the enclosing mesh *at run time, on the
+mesh that happens to be live* — the CPU tier-1 suite runs 1-2 device
+meshes whose axis set ("dp", "dev") silently tolerates a typo that the
+production slice rejects (or worse, replicates over). Donation has the
+same failure shape: a donated operand whose declared layout matches no
+declared output layout cannot have its buffer reused, so XLA inserts a
+silent copy and the donation saves nothing — the 2x-HBM spike returns
+with no error anywhere.
+
+Whole-program checks (the axis-definition set is collected over the
+entire lint scope — ``parallel.device_mesh`` defines "dp" for
+``trainplane.py`` to use):
+
+- **undefined mesh axis**: a string axis name used in ``PartitionSpec``
+  / ``P(...)``, ``psum``/``pmean``/``all_gather``/``ppermute``/
+  ``axis_index``/``all_to_all`` collectives, or ``axis_name=`` /
+  ``dp_axis=`` keyword arguments, that no ``Mesh(...)``,
+  ``axis_names=...`` argument or axis-parameter default anywhere in the
+  lint scope defines;
+- **donated layout mismatch**: a ``jax.jit`` call carrying
+  ``donate_argnums`` *and* literal ``in_shardings``/``out_shardings``
+  where a donated operand's declared sharding matches no declared
+  output sharding — the silent-copy hazard above (the common
+  state-threading jits that declare only ``out_shardings`` are skipped:
+  no declared input layout, nothing to contradict).
+
+``P`` is treated as ``PartitionSpec`` only in files that import it as
+such, so a stray single-letter helper cannot alias into the check.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from ..core import (FileContext, Finding, Pass, dotted_name,
+                    enclosing_function, register)
+from ..shapes import resolve_name
+
+_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather", "ppermute",
+                "axis_index", "all_to_all", "psum_scatter"}
+_AXIS_KWARGS = {"axis_name", "dp_axis"}
+_AXIS_DEF_PARAMS = {"axis_name", "axis_names", "dp_axis"}
+
+
+def _str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _axis_strings(node: ast.AST) -> List[str]:
+    """All string constants in an axis-names expression (str, tuple or
+    list of str)."""
+    s = _str_const(node)
+    if s is not None:
+        return [s]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [v for e in node.elts for v in _axis_strings(e)]
+    return []
+
+
+def _p_is_partitionspec(tree: ast.AST) -> bool:
+    """Whether this module binds the name ``P`` to PartitionSpec."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "PartitionSpec" and alias.asname == "P":
+                    return True
+    return False
+
+
+def collect_defined_axes(graph) -> Set[str]:
+    """Mesh axis names defined anywhere in the lint scope: ``Mesh(devs,
+    ("dp",))`` positional/keyword tuples, ``axis_names=``/``axis_name=``
+    call arguments, and axis-parameter defaults (``def device_mesh(...,
+    axis_names=("dp",))`` — the framework's own constructors). Memoized
+    per project graph."""
+    cached = getattr(graph, "_tpulint_defined_axes", None)
+    if cached is not None:
+        return cached
+    axes: Set[str] = set()
+    for minfo in graph.modules.values():
+        for node in ast.walk(minfo.tree):
+            if isinstance(node, ast.Call):
+                tail = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+                # only mesh CONSTRUCTORS define axes — an `axis_name=`
+                # kwarg on a collective is a USE and must not legitimize
+                # its own (possibly typo'd) axis
+                if "mesh" not in tail.lower():
+                    continue
+                if tail in ("Mesh", "make_mesh") and len(node.args) >= 2:
+                    axes.update(_axis_strings(node.args[1]))
+                for kw in node.keywords:
+                    if kw.arg in ("axis_names", "axis_name"):
+                        axes.update(_axis_strings(kw.value))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                # defaults align to the TAIL of posonly+positional params
+                pos = args.posonlyargs + args.args
+                pos_defaults = [None] * (len(pos) - len(args.defaults)) \
+                    + list(args.defaults)
+                for a, d in zip(pos + args.kwonlyargs,
+                                pos_defaults + list(args.kw_defaults)):
+                    if d is not None and a.arg in _AXIS_DEF_PARAMS:
+                        axes.update(_axis_strings(d))
+    graph._tpulint_defined_axes = axes
+    return axes
+
+
+def _spec_repr(node: ast.AST) -> Optional[str]:
+    """Canonical layout of a sharding expression for comparison:
+    ``NamedSharding(mesh, spec)`` unwraps to its spec, and
+    ``P(...)``/``PartitionSpec(...)`` normalize to their axis-argument
+    tuple — so spelling variants of the same layout compare equal. An
+    expression with no recognizable spec shape returns None: the caller
+    bails rather than text-compare apples to oranges."""
+    if isinstance(node, ast.Call):
+        tail = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+        if tail == "NamedSharding" and len(node.args) >= 2:
+            return _spec_repr(node.args[1])
+        if tail in ("P", "PartitionSpec"):
+            # PartitionSpec pads unmentioned trailing dims with None:
+            # P("dp") == P("dp", None) — strip the padding first
+            args = list(node.args)
+            while args and isinstance(args[-1], ast.Constant) \
+                    and args[-1].value is None:
+                args.pop()
+            return "spec(%s)" % ", ".join(ast.dump(a) for a in args)
+    return None
+
+
+@register
+class ShardingFlowPass(Pass):
+    name = "sharding-flow"
+    description = ("mesh-axis names no enclosing mesh defines, and "
+                   "donated operands whose declared in/out layouts "
+                   "differ (silent-copy hazard)")
+    project = True
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        graph = ctx.project
+        if graph is None:
+            return
+        defined = collect_defined_axes(graph)
+        p_is_spec = _p_is_partitionspec(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func) or ""
+            tail = fname.rsplit(".", 1)[-1]
+            for axis, where in self._axis_uses(node, tail, p_is_spec):
+                if axis not in defined:
+                    yield ctx.finding(
+                        node, self.name,
+                        "mesh axis '%s' used in %s but no Mesh/axis_names "
+                        "definition in the lint scope declares it — on the "
+                        "real mesh this raises (or silently replicates) "
+                        "instead of sharding" % (axis, where))
+            if tail in ("jit", "pjit"):
+                yield from self._check_donation(ctx, node)
+
+    # ------------------------------------------------------------------
+    def _axis_uses(self, node: ast.Call, tail: str,
+                   p_is_spec: bool) -> Iterator[Tuple[str, str]]:
+        if tail == "PartitionSpec" or (tail == "P" and p_is_spec):
+            for a in node.args:
+                s = _str_const(a)
+                if s is not None:
+                    yield s, "`PartitionSpec`"
+                elif isinstance(a, (ast.Tuple, ast.List)):
+                    for s in _axis_strings(a):
+                        yield s, "`PartitionSpec`"
+        elif tail in _COLLECTIVES:
+            if len(node.args) >= 2:
+                s = _str_const(node.args[1])
+                if s is not None:
+                    yield s, "`%s` collective" % tail
+            elif len(node.args) == 1 and tail == "axis_index":
+                s = _str_const(node.args[0])
+                if s is not None:
+                    yield s, "`%s` collective" % tail
+        for kw in node.keywords:
+            if kw.arg in _AXIS_KWARGS:
+                s = _str_const(kw.value)
+                if s is not None:
+                    yield s, "`%s=` argument" % kw.arg
+
+    def _check_donation(self, ctx: FileContext,
+                        node: ast.Call) -> Iterator[Finding]:
+        donate = in_sh = out_sh = None
+        for kw in node.keywords:
+            if kw.arg == "donate_argnums":
+                donate = kw.value
+            elif kw.arg == "in_shardings":
+                in_sh = kw.value
+            elif kw.arg == "out_shardings":
+                out_sh = kw.value
+            elif kw.arg in ("static_argnums", "static_argnames"):
+                # static args shift donate_argnums relative to the
+                # in_shardings (which cover dynamic args only): the
+                # index mapping is unprovable here — bail
+                return
+        if donate is None or in_sh is None or out_sh is None:
+            return
+        donated: List[int] = []
+        if isinstance(donate, (ast.Tuple, ast.List)):
+            for e in donate.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    donated.append(e.value)
+        elif isinstance(donate, ast.Constant) \
+                and isinstance(donate.value, int):
+            donated.append(donate.value)
+        if not donated:
+            return
+        # resolve Name references to their local assignment before the
+        # textual comparison (out_spec = P("dp") — or a Name-bound whole
+        # TUPLE of specs — must compare like its literal); anything still
+        # unresolved makes the check unprovable — bail rather than
+        # manufacture a mismatch
+        fn = enclosing_function(node)
+
+        def layout_of(e):
+            return _spec_repr(resolve_name(e, fn))
+
+        in_sh = resolve_name(in_sh, fn)
+        out_sh = resolve_name(out_sh, fn)
+        if not isinstance(in_sh, (ast.Tuple, ast.List)):
+            return
+        outs = out_sh.elts if isinstance(out_sh, (ast.Tuple, ast.List)) \
+            else [out_sh]
+        out_reprs = {layout_of(o) for o in outs}
+        if None in out_reprs:  # an out layout we can't normalize: bail
+            return
+        for i in donated:
+            if not (0 <= i < len(in_sh.elts)):
+                continue
+            spec_i = layout_of(in_sh.elts[i])
+            if spec_i is None:
+                continue
+            if spec_i not in out_reprs:
+                yield ctx.finding(
+                    in_sh.elts[i], self.name,
+                    "donated operand %d's declared in_sharding matches no "
+                    "declared out_sharding — XLA cannot reuse the donated "
+                    "buffer and inserts a silent copy (the donation saves "
+                    "nothing; align the layouts or drop the donation)" % i)

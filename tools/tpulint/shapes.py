@@ -1,0 +1,1260 @@
+r"""tpulint abstract shape/sharding interpreter — the static model of the
+system's hottest runtime invariant: *shapes decide compiles*.
+
+Every jit/pallas call site compiles one executable per distinct operand
+shape tuple. The framework's whole serving/training discipline (fixed
+decode slots, padded bucket ladders, knob-sized pools) exists to make
+that set finite and warmup-precompilable; a single data-dependent
+dimension reaching a jit operand turns the steady state into a
+recompile storm that the bench's runtime gauge (PR 3) only catches a
+full round later — and only with a chip. This module makes the property
+*statically checkable* by abstract interpretation over the PR-10
+project graph (TVM/Relay's lesson, PAPERS.md: carry an abstract shape
+domain through the program, decide layout/compile questions before
+execution).
+
+The dimension domain (a finite-height lattice, ⊥ below, ⊤ on top)::
+
+        ⊤  (top)        unbounded / data-dependent: len() of host data,
+         |               .shape of queue contents, python-loop accumulators
+      bounded           a finite-but-unlisted set: bucket-ladder rungs
+       /    \            (select_bucket, *_ladder constructors), joins of
+    const   knob         distinct constants, loop indices over a knob range
+       \    /
+        ⊥  (unknown)    no information — NEVER reported (the pass flags
+                         only positively-derived ⊤, not ignorance)
+
+``const`` is one compile; ``knob`` (``MXNET_DECODE_SLOTS``-style
+``get_env`` reads) is one compile per process; ``bounded`` is one
+compile per rung — all warmup-precompilable, all clean by construction.
+Only ``⊤`` predicts a steady-state recompile.
+
+Abstract values carry a dim (int-like scalars used as dimensions), a
+shape (tuple of dims), tuple/list element values, a symbolic sequence
+length, and a tag (``jit`` callables, ``bounded-seq`` ladders,
+``host-seq`` accumulators, ``knob-str`` raw knob reads, ``host`` queue
+payloads). The interpreter evaluates each function body in source
+order, propagates values interprocedurally (parameter/return/attribute
+summaries joined over call sites, iterated to a bounded fixpoint over
+the call graph) and records every jit dispatch site together with the
+abstract shapes of its operands. Nested functions are evaluated inline
+with their closure environment (the decode plane's ``attempt()``
+retry-closure idiom), and ``telemetry.jit_call(site, fn, *args)`` /
+``resilience.call(site, fn, *args)`` wrappers are unwrapped to the real
+operands.
+
+Pure stdlib ``ast`` — no JAX import, no device work. Deliberately
+conservative: an ⊥-shaped operand never spreads into a finding,
+sequential branch evaluation under-approximates joins, and resolution
+failures degrade to ⊥ — with ONE deliberate escalation: ``len()`` of a
+value the interpreter cannot classify is ⊤ (the "len() of host data"
+rule). A python ``len()`` feeding a *dimension* is the exact storm
+shape this analysis exists for, and host lists are indistinguishable
+from arrays without provenance; route such sizes through
+``select_bucket`` or suppress per-line where the value is provably an
+array of pre-warmed shape. The analysis is memoized per :class:`ProjectGraph`, so
+the three passes riding it (recompile-risk, pallas-kernel-check,
+sharding-flow) share one interpretation per lint scope.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import dotted_name
+
+#: Fixpoint bound: interprocedural summaries are iterated at most this
+#: many sweeps (the lattice is finite-height, so this is a cost cap,
+#: not a correctness requirement).
+MAX_ROUNDS = 4
+
+# dim kinds, in lattice order
+UNKNOWN_K, CONST_K, KNOB_K, BOUNDED_K, TOP_K = \
+    "unknown", "const", "knob", "bounded", "top"
+
+_RANK = {UNKNOWN_K: 0, CONST_K: 1, KNOB_K: 1, BOUNDED_K: 2, TOP_K: 3}
+
+
+class Dim:
+    """One abstract dimension. Immutable; ``origin`` is a short human
+    phrase naming where a non-const value came from (rides into finding
+    messages — keep it line-number-free so baseline keys are stable)."""
+
+    __slots__ = ("kind", "value", "origin")
+
+    def __init__(self, kind: str, value: Optional[int] = None,
+                 origin: str = ""):
+        self.kind = kind
+        self.value = value
+        self.origin = origin
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def const(n: int) -> "Dim":
+        return Dim(CONST_K, int(n))
+
+    @staticmethod
+    def knob(name: str) -> "Dim":
+        return Dim(KNOB_K, None, name)
+
+    @staticmethod
+    def bounded(origin: str) -> "Dim":
+        return Dim(BOUNDED_K, None, origin)
+
+    @staticmethod
+    def top(origin: str) -> "Dim":
+        return Dim(TOP_K, None, origin)
+
+    @staticmethod
+    def unknown() -> "Dim":
+        return _UNKNOWN_DIM
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        if self.kind == CONST_K:
+            return "Dim(%d)" % self.value
+        return "Dim(%s%s)" % (self.kind,
+                              ", %s" % self.origin if self.origin else "")
+
+
+_UNKNOWN_DIM = Dim(UNKNOWN_K)
+
+
+def join_dims(a: Optional[Dim], b: Optional[Dim]) -> Dim:
+    """Least upper bound. ``unknown`` is ⊥ (join-identity); distinct
+    constants/knobs join to ``bounded`` (a finite set of sizes — the
+    bucket-ladder shape), anything with ⊤ is ⊤."""
+    a = a or _UNKNOWN_DIM
+    b = b or _UNKNOWN_DIM
+    if a.kind == TOP_K:
+        return a
+    if b.kind == TOP_K:
+        return b
+    if a.kind == UNKNOWN_K:
+        return b
+    if b.kind == UNKNOWN_K:
+        return a
+    if a.kind == b.kind and a.value == b.value and a.origin == b.origin:
+        return a
+    origin = a.origin or b.origin or "joined sizes"
+    return Dim.bounded(origin)
+
+
+def derived(*dims: Optional[Dim]) -> Dim:
+    """Result kind of arithmetic over dims (``pad_up``, ``rung - p``,
+    ``n * 2``): ⊤ taints, ``unknown`` stays unknown (ignorance does not
+    become evidence), else the strongest bounded-ness survives."""
+    dims = tuple(d or _UNKNOWN_DIM for d in dims)
+    for d in dims:
+        if d.kind == TOP_K:
+            return d
+    if any(d.kind == UNKNOWN_K for d in dims):
+        return _UNKNOWN_DIM
+    for kind in (BOUNDED_K, KNOB_K):
+        for d in dims:
+            if d.kind == kind:
+                return Dim(kind, None, d.origin)
+    return Dim(BOUNDED_K, None, "derived size")  # mixed consts w/o folding
+
+
+def fold_binop(op: ast.AST, a: Dim, b: Dim) -> Dim:
+    """Constant-fold ``a op b`` when both are consts, else :func:`derived`."""
+    if a.kind == CONST_K and b.kind == CONST_K:
+        try:
+            if isinstance(op, ast.Add):
+                return Dim.const(a.value + b.value)
+            if isinstance(op, ast.Sub):
+                return Dim.const(a.value - b.value)
+            if isinstance(op, ast.Mult):
+                return Dim.const(a.value * b.value)
+            if isinstance(op, ast.FloorDiv):
+                return Dim.const(a.value // b.value)
+            if isinstance(op, ast.Mod):
+                return Dim.const(a.value % b.value)
+            if isinstance(op, ast.Pow):
+                return Dim.const(a.value ** b.value)
+        except (ZeroDivisionError, OverflowError, ValueError):
+            return _UNKNOWN_DIM
+    return derived(a, b)
+
+
+class AbsValue:
+    """One abstract runtime value.
+
+    ``dim``    — the value used as an int-like dimension;
+    ``shape``  — tuple of :class:`Dim` when the value is array-like;
+    ``elts``   — element values of a tuple/list literal;
+    ``length`` — symbolic sequence length (``[None] * knob``);
+    ``tag``    — provenance marker: ``jit`` (compiled callable),
+    ``bounded-seq`` (ladder), ``host-seq`` (loop accumulator),
+    ``knob-str`` (raw string knob), ``host`` (queue payload — its
+    ``.shape`` is data-dependent ⊤).
+    """
+
+    __slots__ = ("dim", "shape", "elts", "length", "tag")
+
+    def __init__(self, dim: Optional[Dim] = None,
+                 shape: Optional[Tuple[Dim, ...]] = None,
+                 elts: Optional[Tuple["AbsValue", ...]] = None,
+                 length: Optional[Dim] = None, tag: Optional[str] = None):
+        self.dim = dim
+        self.shape = shape
+        self.elts = elts
+        self.length = length
+        self.tag = tag
+
+    def top_dim(self) -> Optional[Dim]:
+        """The first ⊤ dim of this value's shape, if any."""
+        for d in self.shape or ():
+            if d.kind == TOP_K:
+                return d
+        return None
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        bits = []
+        if self.dim is not None:
+            bits.append("dim=%r" % self.dim)
+        if self.shape is not None:
+            bits.append("shape=%r" % (self.shape,))
+        if self.tag:
+            bits.append("tag=%s" % self.tag)
+        return "AbsValue(%s)" % ", ".join(bits)
+
+
+UNKNOWN = AbsValue()
+
+
+def join_values(a: AbsValue, b: AbsValue) -> AbsValue:
+    """Join two abstract values (parameter summaries over call sites).
+    Structure that disagrees degrades to the weaker side; ⊤ provenance
+    survives."""
+    if a is UNKNOWN:
+        return b
+    if b is UNKNOWN:
+        return a
+    dim = join_dims(a.dim, b.dim) if (a.dim or b.dim) else None
+    if dim is not None and dim.kind == UNKNOWN_K:
+        dim = None
+    shape = None
+    if a.shape is not None and b.shape is not None:
+        if len(a.shape) == len(b.shape):
+            shape = tuple(join_dims(x, y) for x, y in zip(a.shape, b.shape))
+        else:
+            # rank disagreement: keep any ⊤ evidence, drop the rest
+            td = next((d for d in a.shape + b.shape if d.kind == TOP_K), None)
+            shape = (td,) if td is not None else None
+    elif a.shape is not None or b.shape is not None:
+        shape = a.shape if a.shape is not None else b.shape
+    # element-wise join keeps the lattice monotone: identical tuples stay
+    # intact and a ⊤-carrying element survives being joined against a
+    # const one (AbsValue has no __eq__, so `!=` would be identity and
+    # degrade EVERY multi-call-site summary)
+    if a.elts is not None and b.elts is not None:
+        elts = tuple(join_values(x, y) for x, y in zip(a.elts, b.elts)) \
+            if len(a.elts) == len(b.elts) else None
+    else:
+        elts = a.elts if a.elts is not None else b.elts
+    tag = a.tag if a.tag == b.tag else (a.tag or b.tag)
+    length = join_dims(a.length, b.length) if (a.length or b.length) else None
+    return AbsValue(dim=dim, shape=shape, elts=elts, length=length, tag=tag)
+
+
+def shape_str(shape: Sequence[Dim]) -> str:
+    """``(5, S=MXNET_DECODE_SLOTS, ⊤)`` — the message rendering."""
+    out = []
+    for d in shape:
+        if d.kind == CONST_K:
+            out.append(str(d.value))
+        elif d.kind == KNOB_K:
+            out.append(d.origin or "knob")
+        elif d.kind == BOUNDED_K:
+            out.append("{rungs}")
+        elif d.kind == TOP_K:
+            out.append("⊤")
+        else:
+            out.append("?")
+    return "(%s)" % ", ".join(out)
+
+
+class JitRisk:
+    """One ⊤-shaped operand reaching a jit/pallas dispatch site."""
+
+    __slots__ = ("node", "relpath", "fn_label", "operand", "shape", "origin")
+
+    def __init__(self, node: ast.AST, relpath: str, fn_label: str,
+                 operand: str, shape: Tuple[Dim, ...], origin: str):
+        self.node = node
+        self.relpath = relpath
+        self.fn_label = fn_label
+        self.operand = operand
+        self.shape = shape
+        self.origin = origin
+
+    def message(self) -> str:
+        return ("jit-compiled call `%s` takes operand `%s` with statically "
+                "unbounded shape %s (⊤ from %s) — every distinct runtime "
+                "size compiles a new executable: a predicted steady-state "
+                "recompile storm. Route the size through a bucket ladder "
+                "(`select_bucket`) or a MXNET_* knob so warmup can "
+                "pre-compile every rung"
+                % (self.fn_label, self.operand, shape_str(self.shape),
+                   self.origin or "a data-dependent size"))
+
+
+# ---------------------------------------------------------------------------
+# const-expression helpers shared with the pallas pass
+# ---------------------------------------------------------------------------
+
+def module_const_env(tree: ast.AST) -> Dict[str, AbsValue]:
+    """Top-level ``NAME = <int | tuple-of-int | jax.jit(...)>`` bindings of
+    a module — the ``LANES = 128`` / module-level-jit idiom."""
+    env: Dict[str, AbsValue] = {}
+    for node in tree.body if hasattr(tree, "body") else ():
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        v = node.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int) \
+                and not isinstance(v.value, bool):
+            env[tgt.id] = AbsValue(dim=Dim.const(v.value))
+        elif isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in v.elts):
+            env[tgt.id] = AbsValue(elts=tuple(
+                AbsValue(dim=Dim.const(e.value)) for e in v.elts),
+                length=Dim.const(len(v.elts)))
+        elif isinstance(v, ast.Call) and _is_jit_wrap(v):
+            env[tgt.id] = AbsValue(tag="jit")
+    # fold simple const chains (`HALF = LANES // 2`) over a few rounds
+    for _ in range(3):
+        changed = False
+        for node in tree.body if hasattr(tree, "body") else ():
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name) or tgt.id in env:
+                continue
+            val = const_int(node.value, env)
+            if val is not None:
+                env[tgt.id] = AbsValue(dim=Dim.const(val))
+                changed = True
+        if not changed:
+            break
+    return env
+
+
+def resolve_name(expr: ast.AST, fn: Optional[ast.AST]) -> ast.AST:
+    """Follow a Name to its assignment inside the enclosing function —
+    the ``grid_spec = pltpu.PrefetchScalarGridSpec(...)`` /
+    ``out_spec = P("dp")`` idiom shared by the pallas and sharding
+    passes. A name assigned MORE than once (conditional reassignment)
+    stays unresolved: picking either branch's value could manufacture a
+    finding about code no execution path contains — callers treat the
+    returned Name as unprovable and bail."""
+    if not isinstance(expr, ast.Name) or fn is None:
+        return expr
+    hits: List[ast.AST] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == expr.id \
+                and getattr(node, "lineno", 0) <= getattr(expr, "lineno",
+                                                          1 << 30):
+            hits.append(node.value)
+    return hits[0] if len(hits) == 1 else expr
+
+
+def const_int(node: ast.AST, env: Dict[str, AbsValue],
+              _depth: int = 0) -> Optional[int]:
+    """Resolve an expression to a python int using ``env`` (module/local
+    consts) — the pallas pass's block-shape evaluator. None = not const."""
+    if _depth > 8:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.Name):
+        v = env.get(node.id)
+        if v is not None and v.dim is not None and v.dim.kind == CONST_K:
+            return v.dim.value
+        return None
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = const_int(node.operand, env, _depth + 1)
+        return -inner if inner is not None else None
+    if isinstance(node, ast.BinOp):
+        lo = const_int(node.left, env, _depth + 1)
+        ro = const_int(node.right, env, _depth + 1)
+        if lo is None or ro is None:
+            return None
+        d = fold_binop(node.op, Dim.const(lo), Dim.const(ro))
+        return d.value if d.kind == CONST_K else None
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func) or ""
+        tail = fname.rsplit(".", 1)[-1]
+        vals = [const_int(a, env, _depth + 1) for a in node.args]
+        if tail == "len" and len(node.args) == 1:
+            if isinstance(node.args[0], (ast.Tuple, ast.List)):
+                return len(node.args[0].elts)
+            v = env.get(node.args[0].id) \
+                if isinstance(node.args[0], ast.Name) else None
+            if v is not None and v.length is not None \
+                    and v.length.kind == CONST_K:
+                return v.length.value
+            return None
+        if tail in ("min", "max") and vals and all(v is not None
+                                                   for v in vals):
+            return min(vals) if tail == "min" else max(vals)
+    return None
+
+
+# -- jit wrap detection (value level, complements core.jit_functions) -------
+
+_JIT_WRAP_TAILS = {"jit", "pjit", "filter_jit", "pallas_call"}
+_JIT_CALL_WRAPPERS = {"jit_call"}          # telemetry.jit_call(site, fn, *a)
+_RESILIENCE_CALL = {"call"}                # resilience.call(site, fn, *a)
+
+_NP_FACTORY = {"zeros", "ones", "empty", "full"}
+_NP_LIKE = {"zeros_like", "ones_like", "empty_like", "full_like"}
+_NP_PASSTHRU = {"asarray", "ascontiguousarray", "copy", "astype",
+                "asanyarray"}
+_NP_COLLECT = {"stack", "array", "vstack", "column_stack"}
+_SEQ_PASSTHRU = {"sorted", "tuple", "list", "reversed", "set"}
+_LADDER_CALLS = {"select_bucket"}
+_SEQ_APPEND = {"append", "extend", "insert", "add", "appendleft"}
+_QUEUE_GET = {"get", "get_nowait", "popleft", "pop"}
+_DIM_FOLD = {"min", "max", "abs", "int", "round"}
+
+
+def _is_jit_wrap(call: ast.Call) -> bool:
+    fname = dotted_name(call.func) or ""
+    tail = fname.rsplit(".", 1)[-1]
+    if tail in _JIT_WRAP_TAILS:
+        return True
+    if tail in ("partial",) and call.args:
+        inner = dotted_name(call.args[0]) or ""
+        return inner.rsplit(".", 1)[-1] in _JIT_WRAP_TAILS
+    return False
+
+
+def _queueish(name: str) -> bool:
+    low = name.lower()
+    return any(t in low for t in ("queue", "_q", "deque", "inbox", "pending"))
+
+
+# ---------------------------------------------------------------------------
+# the interpreter
+# ---------------------------------------------------------------------------
+
+class ShapeAnalysis:
+    """Whole-program result: jit-dispatch risks per file, plus the
+    per-module const environments the interpreter seeds each function
+    with. (The file-local pallas pass computes its own per-file const
+    env via :func:`module_const_env` — it must work without a project
+    graph, e.g. under ``--select pallas-kernel-check``.)"""
+
+    def __init__(self, graph):
+        self.graph = graph
+        self.jit_risks: Dict[str, List[JitRisk]] = {}
+        self.module_envs: Dict[str, Dict[str, AbsValue]] = {}
+        self._param_summaries: Dict[object, Dict[str, AbsValue]] = {}
+        self._return_summaries: Dict[object, AbsValue] = {}
+        self._attr_tables: Dict[Tuple[str, str], Dict[str, AbsValue]] = {}
+        self._jitted_defs: Set[ast.AST] = set()
+        self._risks_by_fn: Dict[object, List[JitRisk]] = {}
+        self._run()
+
+    # -- summaries ----------------------------------------------------------
+
+    def _join_param(self, info, name: str, value: AbsValue) -> bool:
+        summ = self._param_summaries.setdefault(info, {})
+        old = summ.get(name, UNKNOWN)
+        new = join_values(old, value)
+        if _widened(old, new):
+            summ[name] = new
+            return True
+        return False
+
+    def _join_return(self, info, value: AbsValue) -> bool:
+        old = self._return_summaries.get(info, UNKNOWN)
+        new = join_values(old, value)
+        if _widened(old, new):
+            self._return_summaries[info] = new
+            return True
+        return False
+
+    def _attr_table(self, module: str, cls: Optional[str]
+                    ) -> Dict[str, AbsValue]:
+        return self._attr_tables.setdefault((module, cls or ""), {})
+
+    def _join_attr(self, module: str, cls: Optional[str], name: str,
+                   value: AbsValue) -> bool:
+        table = self._attr_table(module, cls)
+        old = table.get(name, UNKNOWN)
+        new = join_values(old, value)
+        if _widened(old, new):
+            table[name] = new
+            return True
+        return False
+
+    def _attr_get(self, module: str, cls: Optional[str],
+                  name: str) -> AbsValue:
+        v = self._attr_table(module, cls).get(name)
+        if v is not None:
+            return v
+        # by-name base-class chain (same bounded walk as graph._method_of)
+        graph = self.graph
+        seen: Set[str] = set()
+        frontier = [cls] if cls else []
+        for _ in range(6):
+            nxt: List[str] = []
+            for cname in frontier:
+                if not cname or cname in seen:
+                    continue
+                seen.add(cname)
+                for cinfo in graph.classes_by_name.get(cname, ()):
+                    hit = self._attr_tables.get(
+                        (cinfo.module, cinfo.name), {}).get(name)
+                    if hit is not None:
+                        return hit
+                    nxt.extend(cinfo.base_names)
+            frontier = nxt
+            if not frontier:
+                break
+        return UNKNOWN
+
+    # -- driver -------------------------------------------------------------
+
+    def _run(self) -> None:
+        graph = self.graph
+        for module, minfo in graph.modules.items():
+            self.module_envs[module] = module_const_env(minfo.tree)
+            for node in ast.walk(minfo.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and any(_is_jit_wrap(d) if isinstance(d, ast.Call)
+                                else (dotted_name(d) or "").rsplit(".", 1)[-1]
+                                in _JIT_WRAP_TAILS
+                                for d in node.decorator_list):
+                    self._jitted_defs.add(node)
+
+        # top-level functions/methods only: nested defs are evaluated
+        # inline with their closure environment
+        nested = set()
+        for info in graph.funcs.values():
+            stack = graph._enclosing_stack(info.node)
+            if len(stack) > 1:
+                nested.add(info.node)
+        order = sorted((i for i in graph.funcs.values()
+                        if i.node not in nested),
+                       key=lambda i: (i.relpath,
+                                      0 if i.name == "__init__" else 1,
+                                      i.qname))
+        for _round in range(MAX_ROUNDS):
+            changed = False
+            for info in order:
+                try:
+                    changed |= self._eval_function(info)
+                except RecursionError:  # adversarial nesting: skip the fn
+                    continue
+            if not changed:
+                break
+
+        risks: Dict[str, List[JitRisk]] = {}
+        for info, items in self._risks_by_fn.items():
+            for r in items:
+                risks.setdefault(r.relpath, []).append(r)
+        for rel in risks:
+            risks[rel].sort(key=lambda r: (getattr(r.node, "lineno", 0),
+                                           getattr(r.node, "col_offset", 0)))
+        self.jit_risks = risks
+
+    def _eval_function(self, info) -> bool:
+        graph = self.graph
+        env: Dict[str, AbsValue] = dict(self.module_envs.get(info.module, {}))
+        node = info.node
+        args = node.args
+        params = [a.arg for a in getattr(args, "posonlyargs", []) +
+                  args.args + args.kwonlyargs]
+        summ = self._param_summaries.get(info, {})
+        for p in params:
+            env[p] = summ.get(p, UNKNOWN)
+        ev = _FuncEval(self, info, env)
+        body = node.body if isinstance(node.body, list) else [node.body]
+        ev.exec_body(body)
+        self._risks_by_fn[info] = ev.risks
+        return ev.changed
+
+
+def _widened(old: AbsValue, new: AbsValue) -> bool:
+    """Whether `new` carries information `old` did not (drives the
+    fixpoint). Compares the rendered structure — cheap and total."""
+    return _sig(new) != _sig(old)
+
+
+def _sig(v: AbsValue):
+    def dsig(d):
+        return (d.kind, d.value, d.origin) if d is not None else None
+    return (dsig(v.dim),
+            tuple(dsig(d) for d in v.shape) if v.shape is not None else None,
+            tuple(_sig(e) for e in v.elts) if v.elts is not None else None,
+            dsig(v.length), v.tag)
+
+
+class _FuncEval:
+    """Evaluate one function body (statements in source order)."""
+
+    def __init__(self, ana: ShapeAnalysis, info, env: Dict[str, AbsValue]):
+        self.ana = ana
+        self.info = info
+        self.env = env
+        self.graph = ana.graph
+        self.minfo = ana.graph.modules.get(info.module)
+        self.risks: List[JitRisk] = []
+        self.changed = False
+        #: one entry per enclosing loop: True when its trip count is
+        #: bounded (iter over a literal/ladder/knob-range), False for
+        #: while-loops and iteration over data of unknown extent
+        self._loop_stack: List[bool] = []
+        self._fstack = ana.graph._enclosing_stack(info.node)
+
+    @property
+    def _loop_depth(self) -> int:
+        return len(self._loop_stack)
+
+    # -- statements ---------------------------------------------------------
+
+    def exec_body(self, body: Sequence[ast.AST]) -> None:
+        for stmt in body:
+            self.exec_stmt(stmt)
+
+    def exec_stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, ast.Assign):
+            val = self.eval(stmt.value)
+            for tgt in stmt.targets:
+                self.bind(tgt, val)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self.bind(stmt.target, self.eval(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            self._aug_assign(stmt)
+        elif isinstance(stmt, ast.Expr):
+            self.eval(stmt.value)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.changed |= self.ana._join_return(
+                    self.info, self.eval(stmt.value))
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            it = self.eval(stmt.iter)
+            self.bind(stmt.target, self._element_of(it))
+            self._loop_stack.append(self._iter_bounded(it))
+            try:
+                self.exec_body(stmt.body)
+            finally:
+                self._loop_stack.pop()
+            self.exec_body(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self.eval(stmt.test)
+            self._loop_stack.append(False)  # trip count unknowable
+            try:
+                self.exec_body(stmt.body)
+            finally:
+                self._loop_stack.pop()
+            self.exec_body(stmt.orelse)
+        elif isinstance(stmt, ast.If):
+            self.eval(stmt.test)
+            # sequential branch evaluation: the else-branch binding wins.
+            # Under-approximate by design — a mis-join would manufacture
+            # findings, a missed one only hides them.
+            self.exec_body(stmt.body)
+            self.exec_body(stmt.orelse)
+        elif isinstance(stmt, ast.Try):
+            self.exec_body(stmt.body)
+            for h in stmt.handlers:
+                self.exec_body(h.body)
+            self.exec_body(stmt.orelse)
+            self.exec_body(stmt.finalbody)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.eval(item.context_expr)
+                if item.optional_vars is not None:
+                    self.bind(item.optional_vars, UNKNOWN)
+            self.exec_body(stmt.body)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: evaluate inline with the CLOSURE environment —
+            # the decode plane's retry-closure (`def attempt(): ...
+            # jit_call(...)`) is where the real jit sites live
+            self.env[stmt.name] = AbsValue(tag="localfn")
+            saved = dict(self.env)
+            for a in (getattr(stmt.args, "posonlyargs", [])
+                      + stmt.args.args + stmt.args.kwonlyargs):
+                self.env[a.arg] = UNKNOWN
+            for va in (stmt.args.vararg, stmt.args.kwarg):
+                if va is not None:
+                    self.env[va.arg] = UNKNOWN
+            try:
+                self.exec_body(stmt.body)
+            finally:
+                self.env = saved
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    self.env.pop(tgt.id, None)
+
+    def _aug_assign(self, stmt: ast.AugAssign) -> None:
+        tgt = stmt.target
+        val = self.eval(stmt.value)
+        if isinstance(tgt, ast.Name):
+            cur = self.env.get(tgt.id, UNKNOWN)
+            if self._loop_depth and (cur.elts is not None
+                                     or cur.tag in ("host-seq", "bounded-seq")
+                                     or cur.length is not None) \
+                    and isinstance(stmt.op, ast.Add):
+                # `out += [row]` inside a loop: a python accumulator —
+                # its length inherits the loop's bound
+                self.env[tgt.id] = self._accumulator()
+            elif self._loop_depth and cur.dim is not None:
+                # a loop-carried scalar (`n += 1`): folding it once would
+                # claim a positively-WRONG constant — the value depends
+                # on the trip count, so it inherits the loop's bound
+                if all(self._loop_stack):
+                    self.env[tgt.id] = AbsValue(
+                        dim=Dim.bounded("a bounded loop counter"))
+                else:
+                    self.env[tgt.id] = AbsValue(
+                        dim=Dim.top("a python-loop counter"))
+            elif cur.dim is not None or val.dim is not None:
+                self.env[tgt.id] = AbsValue(
+                    dim=fold_binop(stmt.op, cur.dim or _UNKNOWN_DIM,
+                                   val.dim or _UNKNOWN_DIM))
+            else:
+                self.env[tgt.id] = UNKNOWN
+
+    def bind(self, tgt: ast.AST, val: AbsValue) -> None:
+        if isinstance(tgt, ast.Name):
+            self.env[tgt.id] = val
+        elif isinstance(tgt, ast.Starred):
+            self.bind(tgt.value, UNKNOWN)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            if val.elts is not None and len(val.elts) == len(tgt.elts):
+                for t, v in zip(tgt.elts, val.elts):
+                    self.bind(t, v)
+            else:
+                for t in tgt.elts:
+                    self.bind(t, UNKNOWN)
+        elif isinstance(tgt, ast.Attribute):
+            base = dotted_name(tgt.value)
+            if base in ("self", "cls") and self.info.cls is not None:
+                self.changed |= self.ana._join_attr(
+                    self.info.module, self.info.cls, tgt.attr, val)
+        # Subscript stores mutate in place — shape unchanged, ignore.
+
+    def _accumulator(self) -> AbsValue:
+        """A sequence grown inside the current loop nest: its length is
+        the trip count — bounded when every enclosing loop is (the
+        per-rung warmup accumulate), ⊤ otherwise (the host-batch
+        collate)."""
+        if self._loop_stack and all(self._loop_stack):
+            return AbsValue(tag="bounded-seq",
+                            length=Dim.bounded("a bounded-loop accumulator"))
+        return AbsValue(tag="host-seq",
+                        length=Dim.top("a python-loop accumulator"))
+
+    def _iter_bounded(self, it: AbsValue) -> bool:
+        """Whether a for-loop over `it` has a bounded trip count (a
+        literal, a ladder, a knob-sized range) — loop-carried counters
+        inside inherit this instead of widening straight to ⊤."""
+        if it.elts is not None or it.tag == "bounded-seq":
+            return True
+        if it.length is not None and it.length.kind in (CONST_K, KNOB_K,
+                                                        BOUNDED_K):
+            return True
+        if it.shape is not None and it.shape \
+                and it.shape[0].kind in (CONST_K, KNOB_K, BOUNDED_K):
+            return True
+        return False
+
+    def _element_of(self, it: AbsValue) -> AbsValue:
+        if it.elts is not None:
+            out = UNKNOWN
+            for e in it.elts:
+                out = join_values(out, e)
+            return out
+        if it.tag == "bounded-seq":
+            return AbsValue(dim=Dim.bounded("a bucket-ladder rung"))
+        if it.tag == "host-seq":
+            return UNKNOWN  # the items are data, not sizes
+        if it.shape is not None and len(it.shape) >= 1:
+            return AbsValue(shape=it.shape[1:]) if len(it.shape) > 1 \
+                else AbsValue(dim=_UNKNOWN_DIM)
+        if it.length is not None:
+            # range(n)-like: the loop index is one of finitely many values
+            # per process when n is const/knob/bounded — warmup covers it
+            if it.length.kind == TOP_K:
+                return AbsValue(dim=Dim.top(it.length.origin))
+            if it.length.kind in (CONST_K, KNOB_K, BOUNDED_K):
+                return AbsValue(dim=Dim.bounded("a bounded loop index"))
+        return UNKNOWN
+
+    # -- expressions --------------------------------------------------------
+
+    def eval(self, node: ast.AST) -> AbsValue:
+        try:
+            return self._eval(node)
+        except RecursionError:
+            raise
+        except Exception:  # noqa: BLE001 - a lint must not crash on odd code
+            return UNKNOWN
+
+    def _eval(self, node: ast.AST) -> AbsValue:
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool) or node.value is None:
+                return UNKNOWN
+            if isinstance(node.value, int):
+                return AbsValue(dim=Dim.const(node.value))
+            return UNKNOWN
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, UNKNOWN)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            if any(isinstance(e, ast.Starred) for e in node.elts):
+                return AbsValue(tag="seq")
+            elts = tuple(self.eval(e) for e in node.elts)
+            return AbsValue(elts=elts, length=Dim.const(len(elts)))
+        if isinstance(node, ast.Attribute):
+            return self._eval_attribute(node)
+        if isinstance(node, ast.Subscript):
+            return self._eval_subscript(node)
+        if isinstance(node, ast.BinOp):
+            return self._eval_binop(node)
+        if isinstance(node, ast.UnaryOp):
+            v = self.eval(node.operand)
+            if isinstance(node.op, ast.USub) and v.dim is not None \
+                    and v.dim.kind == CONST_K:
+                return AbsValue(dim=Dim.const(-v.dim.value))
+            return v if isinstance(node.op, ast.UAdd) else UNKNOWN
+        if isinstance(node, ast.Call):
+            return self._eval_call(node)
+        if isinstance(node, ast.IfExp):
+            self.eval(node.test)
+            return join_values(self.eval(node.body), self.eval(node.orelse))
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return self._eval_comp(node)
+        if isinstance(node, ast.Lambda):
+            return AbsValue(tag="localfn")
+        if isinstance(node, (ast.Compare, ast.BoolOp)):
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, (ast.cmpop, ast.boolop)):
+                    self.eval(child)
+            return UNKNOWN
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value)
+        return UNKNOWN
+
+    def _eval_attribute(self, node: ast.Attribute) -> AbsValue:
+        base_name = dotted_name(node.value)
+        if base_name in ("self", "cls") and self.info.cls is not None:
+            return self.ana._attr_get(self.info.module, self.info.cls,
+                                      node.attr)
+        base = self.eval(node.value)
+        if node.attr == "shape":
+            if base.shape is not None:
+                return AbsValue(
+                    elts=tuple(AbsValue(dim=d) for d in base.shape),
+                    length=Dim.const(len(base.shape)))
+            if base.tag == "host":
+                return AbsValue(tag="host-shape")
+            return UNKNOWN
+        if node.attr == "size":
+            if base.shape is not None:
+                return AbsValue(dim=_product(base.shape))
+            if base.tag == "host":
+                return AbsValue(dim=Dim.top(".size of host/queue data"))
+            return UNKNOWN
+        if node.attr == "T" and base.shape is not None:
+            return AbsValue(shape=tuple(reversed(base.shape)))
+        return UNKNOWN
+
+    def _eval_subscript(self, node: ast.Subscript) -> AbsValue:
+        base = self.eval(node.value)
+        idx = node.slice
+        const_idx = None
+        if isinstance(idx, ast.Constant) and isinstance(idx.value, int) \
+                and not isinstance(idx.value, bool):
+            const_idx = idx.value
+        if base.tag == "host-shape":
+            return AbsValue(dim=Dim.top(".shape of host/queue data"))
+        if base.elts is not None and const_idx is not None \
+                and -len(base.elts) <= const_idx < len(base.elts):
+            return base.elts[const_idx]
+        if base.shape is not None and not isinstance(idx, ast.Slice) \
+                and not isinstance(idx, ast.Tuple):
+            if len(base.shape) > 1:
+                return AbsValue(shape=base.shape[1:])
+            return AbsValue(dim=_UNKNOWN_DIM)
+        return UNKNOWN
+
+    def _eval_binop(self, node: ast.BinOp) -> AbsValue:
+        a = self.eval(node.left)
+        b = self.eval(node.right)
+        # tuple concat / repeat: the shape-building idiom
+        if isinstance(node.op, ast.Add) and a.elts is not None \
+                and b.elts is not None:
+            elts = a.elts + b.elts
+            return AbsValue(elts=elts, length=Dim.const(len(elts)))
+        if isinstance(node.op, ast.Mult):
+            for seq, n in ((a, b), (b, a)):
+                if (seq.elts is not None or seq.tag == "seq") \
+                        and n.dim is not None:
+                    if seq.elts is not None and n.dim.kind == CONST_K \
+                            and 0 <= n.dim.value <= 64:
+                        elts = seq.elts * n.dim.value
+                        return AbsValue(elts=elts,
+                                        length=Dim.const(len(elts)))
+                    base_len = seq.length or (
+                        Dim.const(len(seq.elts))
+                        if seq.elts is not None else _UNKNOWN_DIM)
+                    return AbsValue(tag="seq",
+                                    length=derived(base_len, n.dim))
+        if a.dim is not None and b.dim is not None:
+            return AbsValue(dim=fold_binop(node.op, a.dim, b.dim))
+        if a.dim is not None or b.dim is not None:
+            d = a.dim or b.dim
+            other = b if a.dim is not None else a
+            if other.shape is not None:
+                return AbsValue(shape=other.shape)  # array op scalar
+            return AbsValue(dim=derived(d, _UNKNOWN_DIM))
+        # elementwise array arithmetic preserves (the known) shape
+        if a.shape is not None:
+            return AbsValue(shape=a.shape)
+        if b.shape is not None:
+            return AbsValue(shape=b.shape)
+        return UNKNOWN
+
+    def _eval_comp(self, node) -> AbsValue:
+        # each generator binds ITS OWN iterator's element — the first
+        # iterator only classifies the comprehension's resulting length
+        it = None
+        for g in node.generators:
+            g_it = self.eval(g.iter)
+            if it is None:
+                it = g_it
+            self.bind(g.target, self._element_of(g_it))
+        self.eval(node.elt)
+        if it.tag in ("bounded-seq", "knob-str"):
+            return AbsValue(tag="bounded-seq")
+        if it.tag == "host-seq":
+            return AbsValue(tag="host-seq",
+                            length=Dim.top("a python-loop accumulator"))
+        if it.elts is not None and not any(g.ifs for g in node.generators):
+            return AbsValue(tag="seq", length=Dim.const(len(it.elts)))
+        if it.length is not None:
+            return AbsValue(tag="seq", length=it.length)
+        return AbsValue(tag="seq")
+
+    # -- calls --------------------------------------------------------------
+
+    def _eval_call(self, node: ast.Call) -> AbsValue:
+        fname = dotted_name(node.func) or ""
+        tail = fname.rsplit(".", 1)[-1]
+        if not tail and isinstance(node.func, ast.Attribute):
+            # chained receiver (`get_env(...).split(",")`): dotted_name
+            # can't render the base Call, but the method name still
+            # classifies — without this the ladder-parse idiom loses its
+            # knob-str provenance and manufactures a ⊤
+            tail = node.func.attr
+
+        # mutation-style sequence growth: `out.append(x)` in a loop makes
+        # `out` a host accumulator whose length is data-dependent
+        if isinstance(node.func, ast.Attribute) and tail in _SEQ_APPEND:
+            recv = node.func.value
+            for a in node.args:
+                self.eval(a)
+            if self._loop_depth and isinstance(recv, ast.Name):
+                cur = self.env.get(recv.id)
+                if cur is not None and (cur.elts is not None
+                                        or cur.tag in ("seq", "host-seq")):
+                    self.env[recv.id] = self._accumulator()
+            return UNKNOWN
+
+        # queue payloads: data (and shapes) of unknowable provenance
+        if isinstance(node.func, ast.Attribute) and tail in _QUEUE_GET \
+                and _queueish(dotted_name(node.func.value) or ""):
+            return AbsValue(tag="host")
+
+        # jit wrapping produces a compiled callable VALUE
+        if _is_jit_wrap(node):
+            for a in node.args[1:]:
+                self.eval(a)
+            for kw in node.keywords:
+                self.eval(kw.value)
+            return AbsValue(tag="jit")
+
+        args = [self.eval(a) for a in node.args]
+
+        # dispatch *through* the telemetry/resilience wrappers:
+        # jit_call("site", fn, *operands) / resilience.call("site", fn, *a)
+        if (tail in _JIT_CALL_WRAPPERS
+                or (tail in _RESILIENCE_CALL and "policy" not in fname)) \
+                and len(node.args) >= 2:
+            fn_val = args[1]
+            if fn_val.tag == "jit" or self._is_jitted_ref(node.args[1]):
+                self._record_jit_site(node, node.args[1], node.args[2:],
+                                      args[2:], node.keywords)
+            return UNKNOWN
+
+        # direct call of a compiled callable: `self._step(...)`,
+        # `fn(...)` with fn = jax.jit(...), `pl.pallas_call(...)(args)`
+        fn_val = self.eval(node.func)
+        if fn_val.tag == "jit":
+            self._record_jit_site(node, node.func, node.args, args,
+                                  node.keywords)
+            return UNKNOWN
+
+        # numpy/jnp shape algebra
+        v = self._eval_numpy_call(node, tail, args)
+        if v is not None:
+            return v
+
+        # knob reads: the int-typed read is a per-process-constant dim;
+        # the raw string read feeds the ladder parse
+        if tail == "get_env" and node.args:
+            name = node.args[0]
+            knob = name.value if isinstance(name, ast.Constant) \
+                and isinstance(name.value, str) else "MXNET_*"
+            is_str = any(dotted_name(a) == "str" for a in
+                         list(node.args) + [kw.value for kw in
+                                            node.keywords])
+            if is_str:
+                return AbsValue(tag="knob-str")
+            return AbsValue(dim=Dim.knob(knob))
+        if tail in ("split", "rsplit") and isinstance(node.func,
+                                                     ast.Attribute):
+            recv = self.eval(node.func.value)
+            if recv.tag in ("knob-str", "bounded-seq"):
+                return AbsValue(tag="bounded-seq")
+            return AbsValue(tag="seq")
+        if tail == "str" and len(node.args) == 1:
+            return args[0]
+        if tail in _LADDER_CALLS:
+            return AbsValue(dim=Dim.bounded("a bucket-ladder rung"))
+        if tail == "pad_to_bucket" and len(node.args) >= 2 \
+                and args[1].dim is not None:
+            return AbsValue(shape=(args[1].dim,))
+        if ("ladder" in tail or "bucket_ladder" in tail) \
+                and tail not in _LADDER_CALLS:
+            return AbsValue(tag="bounded-seq")
+        if tail in _SEQ_PASSTHRU and len(node.args) == 1:
+            return args[0]
+        if tail == "len" and len(node.args) == 1:
+            return AbsValue(dim=self._len_of(args[0]))
+        if tail == "range":
+            if args and args[-1 if len(args) < 3 else 1].dim is not None:
+                d = args[1].dim if len(args) >= 2 else args[0].dim
+                return AbsValue(tag="seq", length=d)
+            return AbsValue(tag="seq")
+        if tail in _DIM_FOLD:
+            dims = [a.dim for a in args if a.dim is not None]
+            if len(dims) == len(args) and dims:
+                if len(dims) == 1:
+                    return AbsValue(dim=dims[0])
+                if tail == "min" and any(
+                        d.kind in (CONST_K, KNOB_K, BOUNDED_K)
+                        for d in dims):
+                    # min(len(data), CAP) CLAMPS: a finitely-capped dim
+                    # takes finitely many values — the bucket-cap idiom,
+                    # warmup-precompilable, never a storm
+                    return AbsValue(dim=Dim.bounded("a min()-clamped size"))
+                return AbsValue(dim=derived(*dims))
+            return UNKNOWN
+
+        # project-function calls: propagate arguments into the callee's
+        # parameter summary, use its return summary
+        return self._eval_project_call(node, args)
+
+    def _len_of(self, v: AbsValue) -> Dim:
+        if v.length is not None:
+            return v.length
+        if v.elts is not None:
+            return Dim.const(len(v.elts))
+        if v.tag == "bounded-seq":
+            return Dim.bounded("a bucket ladder")
+        if v.tag == "host-seq":
+            return Dim.top("len() of a python-loop accumulator")
+        if v.tag == "host":
+            return Dim.top("len() of host/queue data")
+        if v.shape is not None and v.shape:
+            return v.shape[0]
+        if v.tag in ("jit", "localfn", "knob-str"):
+            return _UNKNOWN_DIM
+        return Dim.top("len() of data of statically unknown size")
+
+    def _eval_numpy_call(self, node: ast.Call, tail: str,
+                         args: List[AbsValue]) -> Optional[AbsValue]:
+        if tail in _NP_FACTORY and args:
+            return AbsValue(shape=self._shape_from(args[0]))
+        if tail in _NP_LIKE and args:
+            return args[0]
+        if tail in _NP_PASSTHRU and args:
+            src = args[0]
+            if src.elts is not None:
+                return AbsValue(shape=(Dim.const(len(src.elts)),))
+            if src.tag == "host-seq":
+                return AbsValue(shape=(
+                    Dim.top("an array stacked from a python-loop "
+                            "accumulator"),))
+            if src.shape is not None or src.dim is not None:
+                return src
+            return UNKNOWN
+        if tail in _NP_COLLECT and args:
+            src = args[0]
+            if src.tag == "host-seq":
+                return AbsValue(shape=(
+                    Dim.top("an array stacked from a python-loop "
+                            "accumulator"),))
+            if src.elts is not None:
+                first = src.elts[0] if src.elts else UNKNOWN
+                rest = first.shape if first.shape is not None else ()
+                if tail == "stack":
+                    return AbsValue(shape=(Dim.const(len(src.elts)),) + rest)
+                return UNKNOWN
+            if src.tag == "host":
+                return AbsValue(shape=(Dim.top("host/queue data"),))
+            return UNKNOWN
+        if tail == "concatenate" and args:
+            src = args[0]
+            if src.tag == "host-seq":
+                return AbsValue(shape=(
+                    Dim.top("an array concatenated from a python-loop "
+                            "accumulator"),))
+            return UNKNOWN
+        if tail == "arange":
+            if args and args[0].dim is not None and len(args) == 1:
+                return AbsValue(shape=(args[0].dim,))
+            return UNKNOWN
+        if tail == "reshape":
+            # x.reshape(a, b) | x.reshape((a, b)) | jnp.reshape(x, shape)
+            if isinstance(node.func, ast.Attribute) and \
+                    dotted_name(node.func.value) not in ("np", "jnp",
+                                                         "numpy", "onp"):
+                vals = args
+            else:
+                vals = args[1:]
+            if len(vals) == 1 and vals[0].elts is not None:
+                return AbsValue(shape=self._shape_from(vals[0]))
+            if vals and all(v.dim is not None for v in vals):
+                return AbsValue(shape=tuple(v.dim for v in vals))
+            return UNKNOWN
+        if tail == "ravel" and isinstance(node.func, ast.Attribute):
+            recv = self.eval(node.func.value)
+            if recv.shape is not None:
+                return AbsValue(shape=(_product(recv.shape),))
+            return UNKNOWN
+        if tail == "fetch_host" and args:
+            return args[0]
+        return None
+
+    def _shape_from(self, v: AbsValue) -> Tuple[Dim, ...]:
+        if v.elts is not None:
+            return tuple(e.dim or _UNKNOWN_DIM for e in v.elts)
+        if v.dim is not None:
+            return (v.dim,)
+        return (_UNKNOWN_DIM,)
+
+    def _is_jitted_ref(self, expr: ast.AST) -> bool:
+        """Whether `expr` names a @jax.jit-decorated project function."""
+        if not isinstance(expr, (ast.Name, ast.Attribute)):
+            return False
+        for target in self.graph._resolve_ref(self.minfo, self.info.cls,
+                                              self._fstack, expr,
+                                              as_call=False):
+            if target.node in self.ana._jitted_defs:
+                return True
+        return False
+
+    def _eval_project_call(self, node: ast.Call,
+                           args: List[AbsValue]) -> AbsValue:
+        if self.minfo is None:
+            return UNKNOWN
+        targets = self.graph._resolve_ref(self.minfo, self.info.cls,
+                                          self._fstack, node.func,
+                                          as_call=True)
+        if not targets:
+            # decorated-jitted function called by name: a dispatch site
+            if self._is_jitted_ref(node.func):
+                self._record_jit_site(node, node.func, node.args, args,
+                                      node.keywords)
+            return UNKNOWN
+        result = UNKNOWN
+        for target in targets:
+            if target.node in self.ana._jitted_defs:
+                self._record_jit_site(node, node.func, node.args, args,
+                                      node.keywords)
+            t_args = target.node.args if hasattr(target.node, "args") \
+                else None
+            if t_args is not None:
+                params = [a.arg for a in
+                          getattr(t_args, "posonlyargs", []) + t_args.args]
+                offset = 1 if params and params[0] in ("self", "cls") \
+                    and target.cls is not None else 0
+                for i, v in enumerate(args):
+                    pi = i + offset
+                    if pi < len(params) and v is not UNKNOWN:
+                        self.changed |= self.ana._join_param(
+                            target, params[pi], v)
+                for kw in node.keywords:
+                    if kw.arg and kw.arg in params:
+                        v = self.eval(kw.value)
+                        if v is not UNKNOWN:
+                            self.changed |= self.ana._join_param(
+                                target, kw.arg, v)
+            ret = self.ana._return_summaries.get(target)
+            if ret is not None:
+                result = join_values(result, ret)
+        return result
+
+    def _record_jit_site(self, call: ast.Call, fn_expr: ast.AST,
+                         operand_nodes: Sequence[ast.AST],
+                         operand_vals: Sequence[AbsValue],
+                         keywords: Sequence[ast.keyword] = ()) -> None:
+        label = dotted_name(fn_expr) or "jit(...)"
+        pairs: List[Tuple[object, ast.AST, AbsValue]] = [
+            (i, onode, oval) for i, (onode, oval)
+            in enumerate(zip(operand_nodes, operand_vals))]
+        # keyword operands trace exactly like positional ones — a
+        # ⊤-shaped `step(x=...)` storms the same as `step(...)`
+        for kw in keywords:
+            if kw.arg is not None:
+                pairs.append((kw.arg, kw.value, self.eval(kw.value)))
+        for key, onode, oval in pairs:
+            td = oval.top_dim()
+            if td is None:
+                continue
+            name = dotted_name(onode)
+            if name is None and isinstance(onode, ast.Call):
+                inner = onode.args[0] if onode.args else None
+                name = dotted_name(inner) if inner is not None else None
+            if name is None:
+                name = key if isinstance(key, str) else "operand %d" % key
+            self.risks.append(JitRisk(
+                call, self.info.relpath, label,
+                name, oval.shape or (td,), td.origin))
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def analyze(graph) -> ShapeAnalysis:
+    """The (memoized) whole-program shape analysis of a project graph."""
+    ana = getattr(graph, "_tpulint_shape_analysis", None)
+    if ana is None:
+        ana = ShapeAnalysis(graph)
+        graph._tpulint_shape_analysis = ana
+    return ana
+
+
+def _product(shape: Sequence[Dim]) -> Dim:
+    out = Dim.const(1)
+    for d in shape:
+        out = fold_binop(ast.Mult(), out, d) if out.kind == CONST_K \
+            and d.kind == CONST_K else derived(out, d)
+    return out
