@@ -39,6 +39,13 @@ ZERO = os.environ.get("BENCH_ZERO", "") not in ("", "0")
 # sharded-save gates (exactly-once batches, zero all-gathers); rc 6 on
 # a gate failure
 ELASTIC = os.environ.get("BENCH_ELASTIC", "") not in ("", "0")
+# BENCH_TENANT=1: mixed-tenant decode soak — one hot tenant at 10x the
+# offered load of two background tenants through the weighted-fair
+# control plane, a live weight swap mid-soak, per-tenant TTFT/TPOT/shed
+# stamped on the line; rc 7 if a background tenant starves (a window
+# with zero completions), a page budget is exceeded, or the
+# steady-state-recompile gauge moves
+TENANT = os.environ.get("BENCH_TENANT", "") not in ("", "0")
 # p=0.2 because the fused-step protocol performs only ~a dozen accounted
 # transfers per run (one barrier fetch per timed phase): a mild rate would
 # usually inject nothing and "prove" resilience vacuously
@@ -668,6 +675,211 @@ def _decode_bench():
     return 1 if gate_err else 0
 
 
+def _tenant_bench():
+    """BENCH_TENANT=1 mode: the multi-tenant fairness/isolation soak.
+
+    Three tenants share one decode engine through the weighted-fair
+    control plane: ``hot`` offers load at 10x the rate of ``bg1`` and
+    ``bg2`` (equal weights — fairness must come from the scheduler, not
+    from matched demand), and ``hot`` carries a KV page budget of half
+    the pool. Mid-soak the engine's weights are hot-swapped
+    (``swap_params``) to prove a fleet rollout under load. Gates
+    (rc 7): every background tenant completes >= 1 request in every
+    measurement window (no starvation), per-tenant pages-in-use never
+    exceeds the budget, the swap drops nothing, and the steady-state
+    recompile gauge stays 0. Per-tenant TTFT/TPOT/shed/deferral counts
+    ride the JSON line."""
+    deadline = float(os.environ.get("MXNET_BENCH_DEADLINE_S",
+                                    "240" if QUICK else "1500"))
+    printed = threading.Event()
+    part = {"phase": "backend-init", "tokens_s": None, "windows": None,
+            "starved_windows": None, "steady_state_recompiles": None}
+
+    def line(value, error=None, extra=None):
+        out = {
+            "metric": "mixed-tenant decode tokens/s (hot 10x + 2 "
+                      "background, weighted-fair, TinyDecoder)",
+            "value": value, "unit": "tokens/s", "vs_baseline": None,
+            "extra": dict(part, **(extra or {})),
+        }
+        if error:
+            out["error"] = error
+        print(json.dumps(_attach_telemetry(out)))
+        sys.stdout.flush()
+
+    def watchdog():
+        time.sleep(deadline)
+        if not printed.is_set():
+            line(part["tokens_s"],
+                 error="deadline %.0fs hit during phase %r (accelerator "
+                       "tunnel stall suspected)" % (deadline, part["phase"]))
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    devices = _acquire_backend()
+    import numpy as np
+
+    from mxnet_tpu import serving
+
+    _maybe_enable_chaos()
+
+    if QUICK:
+        slots, max_seq, run_s, win_s = 4, 96, 6.0, 1.0
+        model = serving.TinyDecoder(vocab_size=64, num_layers=2,
+                                    num_heads=4, head_dim=8)
+        base_interval = 0.05  # bg offered rate: 20 req/s
+    else:
+        slots, max_seq, run_s, win_s = 16, 512, 60.0, 5.0
+        model = serving.TinyDecoder(vocab_size=1024, num_layers=4,
+                                    num_heads=8, head_dim=64)
+        base_interval = 0.02
+    params = model.init_params(0)
+    params_b = model.init_params(1)
+    pool_pages = None  # auto-sized; hot budget derived below
+    eng = serving.DecodeEngine(
+        model, params, num_slots=slots, max_seq_len=max_seq,
+        prefill_buckets=(8, 16), name="bench-tenant", timeout_ms=0,
+        num_pages=pool_pages)
+    hot_budget = (eng._cache.num_pages - 1) // 2
+    eng.tenants.register("hot", weight=1.0, page_budget=hot_budget)
+    eng.tenants.register("bg1", weight=1.0)
+    eng.tenants.register("bg2", weight=1.0)
+    eng.register_variant("rollout", params_b)
+    part["phase"] = "warmup"
+    eng.warmup()
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, model.vocab_size,
+                           int(rng.randint(2, 10))).astype(np.int32)
+               for _ in range(64)]
+    completions = {"hot": [], "bg1": [], "bg2": []}
+    sheds = {"hot": 0, "bg1": 0, "bg2": 0}
+    errors = []
+    t0 = time.perf_counter()
+    stop_at = t0 + run_s
+
+    def on_done(tid):
+        def cb(f):
+            if f.exception() is None:
+                completions[tid].append(time.perf_counter())
+            else:
+                errors.append("%s: %r" % (tid, f.exception()))
+        return cb
+
+    def client(tid, interval):
+        i = 0
+        while time.perf_counter() < stop_at:
+            try:
+                f = eng.submit(prompts[i % len(prompts)],
+                               8 if QUICK else 16, tenant=tid)
+                f.add_done_callback(on_done(tid))
+            except serving.QueueFullError:
+                sheds[tid] += 1
+            except serving.EngineUnavailableError:
+                sheds[tid] += 1
+            i += 1
+            time.sleep(interval)
+
+    part["phase"] = "soak"
+    threads = [
+        threading.Thread(target=client, args=("hot", base_interval / 10.0)),
+        threading.Thread(target=client, args=("bg1", base_interval)),
+        threading.Thread(target=client, args=("bg2", base_interval)),
+    ]
+    for t in threads:
+        t.start()
+    # live weight swap mid-soak: the rollout must drop nothing and
+    # recompile nothing while the hot tenant hammers the engine
+    time.sleep(run_s / 2.0)
+    part["phase"] = "live-swap"
+    eng.use_variant("rollout", timeout=120)
+    part["phase"] = "soak-post-swap"
+    for t in threads:
+        t.join()
+    part["phase"] = "drain"
+    eng.close(drain=True, timeout=300)
+    elapsed = time.perf_counter() - t0
+    stats = eng.stats()
+
+    # windowed starvation check: in every full window where the hot
+    # tenant completed work, each background tenant must complete >= 1.
+    # Windows cover ONLY the offered-load phase [t0, stop_at) — during
+    # the post-soak drain the hot backlog legitimately completes alone
+    # (bg has nothing queued), which is not starvation.
+    n_win = max(1, int((stop_at - t0) // win_s))
+    starved = []
+    for w in range(n_win):
+        lo, hi = t0 + w * win_s, t0 + (w + 1) * win_s
+        in_win = {tid: sum(1 for t in ts if lo <= t < hi)
+                  for tid, ts in completions.items()}
+        if in_win["hot"] > 0 and (in_win["bg1"] == 0
+                                  or in_win["bg2"] == 0):
+            starved.append(w)
+    recompiles = stats.get("steady_state_recompiles")
+    tokens_s = stats["tokens_generated"] / elapsed
+    part.update({
+        "phase": "done", "tokens_s": round(tokens_s, 2),
+        "windows": n_win, "starved_windows": starved,
+        "steady_state_recompiles": recompiles,
+    })
+
+    tenant_rows = {}
+    budget_violation = None
+    for tid, snap in stats["tenants"].items():
+        tenant_rows[tid] = {
+            "completed": snap["completed"],
+            # the engine's TenantStats already counted every shed the
+            # clients observed; sheds[] only cross-checks the two views
+            "shed": snap["shed"],
+            "shed_observed_by_clients": sheds.get(tid, 0),
+            "shed_breaker": snap["shed_breaker"],
+            "deferred_pages": snap["deferred_pages"],
+            "deferred_rate": snap["deferred_rate"],
+            "errors": snap["errors"],
+            "ttft_p50_ms": round(snap["ttft_p50_ms"], 3),
+            "ttft_p99_ms": round(snap["ttft_p99_ms"], 3),
+            "tpot_p50_ms": round(snap["tpot_p50_ms"], 3),
+            "tpot_p99_ms": round(snap["tpot_p99_ms"], 3),
+            "pages_in_use_max": snap["pages_in_use_max"],
+            "page_budget": snap["page_budget"],
+        }
+        if snap["page_budget"] is not None \
+                and snap["pages_in_use_max"] > snap["page_budget"]:
+            budget_violation = (
+                "tenant %r pages_in_use peaked at %d over budget %d"
+                % (tid, snap["pages_in_use_max"], snap["page_budget"]))
+
+    gate_err = None
+    if starved:
+        gate_err = ("background tenant starved: zero completions in "
+                    "window(s) %s while the hot tenant completed work "
+                    "(gate: weighted-fair admission)" % starved)
+    elif budget_violation:
+        gate_err = budget_violation + " (gate: page quotas hold at " \
+                                      "every tick)"
+    elif recompiles:
+        gate_err = ("decode plane recompiled %d time(s) in steady state "
+                    "across the live swap (gate: 0)" % recompiles)
+    elif errors:
+        gate_err = "; ".join(errors[:3])
+    extra = {
+        "tenants": tenant_rows,
+        "hot_page_budget": hot_budget,
+        "weight_swaps": stats["weight_swaps"],
+        "active_variant": stats["active_variant"],
+        "slots": slots, "run_s": round(elapsed, 2),
+        "window_s": win_s,
+        "offered_ratio": "hot 10x vs bg1/bg2",
+        "device": str(devices[0]),
+        "baseline": "no baseline: the gates (no starvation, budgets "
+                    "hold, zero recompiles across the swap) ARE the "
+                    "result",
+    }
+    printed.set()
+    line(round(tokens_s, 2), error=gate_err, extra=extra)
+    return 7 if gate_err else 0
+
+
 def _zero_bench():
     """BENCH_ZERO=1 mode: replicated vs ZeRO-1/2 at the same model/batch.
 
@@ -1017,6 +1229,8 @@ def main():
         return _elastic_bench()
     if ZERO:
         return _zero_bench()
+    if TENANT:
+        return _tenant_bench()
     if DECODE:
         return _decode_bench()
     if SERVING:

@@ -55,7 +55,11 @@ reproducible too.
 Registered sites (grep ``maybe_fail`` for ground truth):
 ``transfer.fetch_host``, ``transfer.asnumpy``, ``jit.compile``,
 ``kvstore.push``, ``kvstore.pull``, ``kvstore.pushpull``, ``io.prefetch``,
-``serving.engine``, ``ckpt.commit``, ``zoo.download``.
+``serving.engine``, ``serving.decode``, ``serving.decode.prefill``,
+``serving.decode.tenant.<id>`` (one site per tenant — scope a schedule to
+ONE tenant's requests with e.g. ``site=serving.decode.tenant.A`` to prove
+tenant isolation; see docs/resilience.md), ``ckpt.commit``,
+``zoo.download``.
 
 Injected faults raise :class:`FaultInjected` — a
 :class:`~mxnet_tpu.resilience.policies.TransientError` — so they exercise

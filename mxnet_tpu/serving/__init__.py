@@ -26,7 +26,13 @@ Pieces
 * :mod:`~mxnet_tpu.serving.decode`   — :class:`DecodeEngine`: token-level
   continuous batching over fixed decode slots, one jitted step per tick,
   prefill through a bucket ladder, ragged paged-attention reads
-  (:mod:`mxnet_tpu.ops.pallas_kernels`) — the LLM serving plane.
+  (:mod:`mxnet_tpu.ops.pallas_kernels`) — the LLM serving plane;
+* :mod:`~mxnet_tpu.serving.tenancy`  — the multi-tenant control plane
+  both servers thread through: tenant registry (``MXNET_TENANTS``),
+  weighted-fair queueing with priority classes, per-tenant circuit
+  breakers / KV page quotas / token-rate budgets, and the live weight
+  swap (:meth:`DecodeEngine.swap_params` /
+  :meth:`Server.refresh_params`).
 
 Typical use::
 
@@ -51,17 +57,21 @@ from .buckets import bucket_ladder, pad_to_bucket, select_bucket
 from .decode import DecodeEngine, PagedDecodeModel, TinyDecoder
 from .engine import BlockEngine, Engine, StableHLOEngine
 from .kvcache import OutOfPagesError, PagedKVCache
-from .stats import ServingStats
+from .stats import ServingStats, TenantStats
+from .tenancy import (Tenant, TenantBreaker, TenantRegistry,
+                      TenantUnavailableError, WeightedFairQueue)
 
 __all__ = [
     "Engine", "BlockEngine", "StableHLOEngine",
     "Server", "ServingError", "QueueFullError", "RequestTimeoutError",
     "ServerClosedError", "EngineUnavailableError",
-    "ServingStats",
+    "ServingStats", "TenantStats",
     "bucket_ladder", "select_bucket", "pad_to_bucket",
     "serve_block", "serve_stablehlo",
     "DecodeEngine", "PagedDecodeModel", "TinyDecoder",
     "PagedKVCache", "OutOfPagesError",
+    "Tenant", "TenantRegistry", "TenantBreaker",
+    "TenantUnavailableError", "WeightedFairQueue",
 ]
 
 

@@ -49,11 +49,10 @@ Robustness policy, in the order a request meets it:
 """
 from __future__ import annotations
 
-import collections
 import threading
 import time
 from concurrent.futures import Future
-from typing import Deque, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
@@ -95,13 +94,21 @@ class EngineUnavailableError(ServingError):
 
 
 class _Request:
-    __slots__ = ("data", "future", "t_submit", "deadline")
+    __slots__ = ("data", "future", "t_submit", "deadline", "tenant")
 
-    def __init__(self, data, deadline):
+    def __init__(self, data, deadline, tenant=None):
         self.data = data
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         self.deadline = deadline
+        self.tenant = tenant
+
+
+def _tenancy():
+    # deferred: tenancy imports this module for the error hierarchy, so
+    # the batcher reaches back lazily (first Server construction)
+    from . import tenancy
+    return tenancy
 
 
 class _EngineSlot:
@@ -146,7 +153,8 @@ class Server:
                  fallback_engine: Optional[Engine] = None,
                  retry_policy: Optional["resilience.RetryPolicy"] = None,
                  breaker_threshold: Optional[int] = None,
-                 breaker_reset_s: Optional[float] = None):
+                 breaker_reset_s: Optional[float] = None,
+                 tenants=None):
         self._engine = engine
         self._sample_shape = tuple(int(d) for d in sample_shape)
         self._dtype = np.dtype(np_dtype(dtype))
@@ -175,8 +183,19 @@ class Server:
                 failure_threshold=breaker_threshold,
                 reset_timeout_s=breaker_reset_s))
             for role, eng in engines]
+        # multi-tenant control plane (docs/serving.md §tenancy): the
+        # same weighted-fair sub-queue machinery as the decode engine,
+        # costed per REQUEST (batch rows are fungible — no page budgets
+        # here, weights apportion batch-slot share)
+        ten = _tenancy()
+        if isinstance(tenants, ten.TenantRegistry):
+            self._tenants = tenants
+        else:
+            self._tenants = ten.TenantRegistry(
+                server=name, spec=tenants, max_cost=1.0,
+                default_queue_depth=self._queue_depth)
+        self._wfq = ten.WeightedFairQueue(self._tenants)
         self._warm_compiles: Optional[int] = None
-        self._queue: Deque[_Request] = collections.deque()
         self._cv = threading.Condition()
         self._closed = False
         self._thread = threading.Thread(target=self._worker, daemon=True,
@@ -186,42 +205,73 @@ class Server:
     # ------------------------------------------------------------------
     # client side
     # ------------------------------------------------------------------
-    def submit(self, x, timeout_ms: Optional[float] = None) -> Future:
+    def submit(self, x, timeout_ms: Optional[float] = None,
+               tenant: Optional[str] = None) -> Future:
         """Enqueue one request; returns its Future. Thread-safe.
 
         ``timeout_ms`` overrides the server default for this request;
-        ``<= 0`` disables the deadline. Raises :class:`ServerClosedError` /
-        :class:`QueueFullError` synchronously — shed work costs the caller
-        one host array copy, never a device cycle.
+        ``<= 0`` disables the deadline. ``tenant`` names the submitting
+        tenant (:mod:`~mxnet_tpu.serving.tenancy`; untagged callers ride
+        ``default``). Raises :class:`ServerClosedError` /
+        :class:`QueueFullError` / :class:`TenantUnavailableError`
+        synchronously — shed work costs the caller one host array copy,
+        never a device cycle.
         """
         arr = np.asarray(x, dtype=self._dtype)
         if arr.shape != self._sample_shape:
             raise MXNetError(
                 "serving request shape %s != sample_shape %s"
                 % (arr.shape, self._sample_shape))
+        tobj = self._tenants.resolve(tenant)
+        state = tobj.breaker.state
+        if state == "open":
+            # per-tenant shed: this tenant's poisoned/failing traffic is
+            # refused at the door while every other tenant keeps serving
+            tobj.stats.on_shed(breaker=True)
+            raise _tenancy().TenantUnavailableError(tobj.tenant_id, state)
         timeout_s = (self._timeout_s if timeout_ms is None
                      else float(timeout_ms) / 1e3)
         deadline = (None if timeout_s <= 0
                     else time.perf_counter() + timeout_s)
-        req = _Request(arr, deadline)
-        shed = False
+        req = _Request(arr, deadline, tobj)
+        shed = None
         depth = 0
         with self._cv:
             if self._closed:
                 raise ServerClosedError("submit() on a closed Server")
-            if len(self._queue) >= self._queue_depth:
-                shed = True
+            if len(tobj.queue) >= tobj.queue_depth:
+                shed = "tenant %r queue full (depth %d): request shed " \
+                       "before the global queue" \
+                       % (tobj.tenant_id, tobj.queue_depth)
+            elif self._wfq.total_queued() >= self._queue_depth:
+                shed = "serving queue full (depth %d): request shed" \
+                       % self._queue_depth
             else:
-                self._queue.append(req)
-                depth = len(self._queue)
+                depth = self._wfq.push(tobj, req)
+                gdepth = self._wfq.total_queued()
                 self._cv.notify_all()
         if shed:
             self._stats.on_shed()
-            raise QueueFullError(
-                "serving queue full (depth %d): request shed"
-                % self._queue_depth)
-        self._stats.on_submit(depth)
+            tobj.stats.on_shed()
+            raise QueueFullError(shed)
+        self._stats.on_submit(gdepth)
+        tobj.stats.on_submit(depth)
         return req.future
+
+    def refresh_params(self) -> int:
+        """Live weight swap for the batch plane: re-snapshot the current
+        parameter values of every engine in the chain that supports it
+        (:meth:`BlockEngine.refresh_params`). The swap lands between
+        batch executions — in-flight batches finish on the old weights,
+        queued requests serve on the new ones, nothing is dropped.
+        Returns the number of engines refreshed."""
+        n = 0
+        for slot in self._slots:
+            fn = getattr(slot.engine, "refresh_params", None)
+            if fn is not None:
+                fn()
+                n += 1
+        return n
 
     def warmup(self) -> int:
         """Run one dummy batch per bucket so every rung's executable is
@@ -256,6 +306,7 @@ class Server:
         out["buckets"] = list(self._ladder)
         out["breakers"] = {slot.name: slot.breaker.state
                            for slot in self._slots}
+        out["tenants"] = self._tenants.snapshot()
         if self._warm_compiles is not None and count >= 0:
             steady = count - self._warm_compiles
             out["steady_state_recompiles"] = steady
@@ -274,8 +325,7 @@ class Server:
             self._closed = True
             dropped: List[_Request] = []
             if not drain:
-                dropped = list(self._queue)
-                self._queue.clear()
+                dropped = [req for _t, req in self._wfq.drain()]
             self._cv.notify_all()
         for req in dropped:
             self._fail(req, ServerClosedError("server closed before serve"))
@@ -292,41 +342,85 @@ class Server:
     def closed(self) -> bool:
         return self._closed
 
+    @property
+    def tenants(self):
+        """The server's tenant registry
+        (:class:`~mxnet_tpu.serving.tenancy.TenantRegistry`)."""
+        return self._tenants
+
     # ------------------------------------------------------------------
     # batcher thread
     # ------------------------------------------------------------------
+    def _tenant_guard(self, tenant, req) -> bool:
+        """Admission veto for the weighted-fair pick: a tenant whose
+        breaker refuses is deferred (its queued work sheds in
+        :meth:`_shed_tenant_breakers`), everyone else fills the batch.
+        The non-consuming state check runs first; ``allow()`` (which may
+        consume the half-open probe) only when the pop will happen."""
+        if tenant.breaker.state == "open":
+            return False
+        return tenant.breaker.allow()
+
+    def _shed_tenant_breakers(self):
+        """Queued work of tenants whose breaker is OPEN is answered now
+        with :class:`TenantUnavailableError` — that tenant alone."""
+        dropped = []
+        for tenant in self._tenants:
+            if tenant.queue and tenant.breaker.state == "open":
+                with self._cv:
+                    dropped.extend(self._wfq.drain(tenant))
+        exc_cls = _tenancy().TenantUnavailableError
+        for tenant, req in dropped:
+            tenant.stats.on_shed(breaker=True)
+            self._fail(req, exc_cls(tenant.tenant_id, "open"))
+
     def _worker(self):
         top = self._ladder[-1]
         while True:
+            self._shed_tenant_breakers()
             batch: List[_Request] = []
             expired: List[_Request] = []
             with self._cv:
-                while not self._queue and not self._closed:
+                while not self._wfq.total_queued() and not self._closed:
                     self._cv.wait()
-                if not self._queue:  # closed and drained
+                if not self._wfq.total_queued():  # closed and drained
                     return
-                # window anchored at the oldest request: no request waits
-                # on coalescing longer than max_delay, regardless of how
-                # traffic trickles in behind it
-                window_end = self._queue[0].t_submit + self._max_delay_s
-                while len(self._queue) < top and not self._closed:
+                # window anchored at the oldest queued request: no
+                # request waits on coalescing longer than max_delay,
+                # regardless of how traffic trickles in behind it
+                oldest = self._wfq.oldest_submit()
+                window_end = oldest + self._max_delay_s
+                while self._wfq.total_queued() < top and not self._closed:
                     remaining = window_end - time.perf_counter()
                     if remaining <= 0:
                         break
                     self._cv.wait(remaining)
                 now = time.perf_counter()
-                while self._queue and len(batch) < top:
-                    req = self._queue.popleft()
+                # weighted-fair batch fill: rows picked by priority class
+                # + deficit round robin, not arrival order — a hot
+                # tenant's backlog cannot monopolize the bucket
+                while len(batch) < top:
+                    picked = self._wfq.pop(self._tenant_guard)
+                    if picked is None:
+                        break
+                    tenant, req = picked
+                    tenant.stats.set_depth(len(tenant.queue))
                     if req.deadline is not None and now > req.deadline:
                         expired.append(req)
                     else:
                         batch.append(req)
-                depth = len(self._queue)
+                depth = self._wfq.total_queued()
             for req in expired:
                 self._stats.on_timeout()
+                if req.tenant is not None:
+                    req.tenant.stats.on_timeout()
                 self._fail(req, RequestTimeoutError(
                     "request spent > its deadline queued"))
             if not batch:
+                if not expired:
+                    # queued work exists but every tenant is deferred
+                    # (half-open probes in flight): yield, don't spin
+                    time.sleep(0.001)
                 continue
             try:
                 bucket = select_bucket(len(batch), self._ladder)
@@ -390,12 +484,21 @@ class Server:
             # open breakers again — answer every future explicitly now
             self._stats.on_unavailable(len(reqs))
             for req in reqs:
+                if req.tenant is not None:
+                    req.tenant.stats.on_shed()
                 self._fail(req, exc)
             return
         except Exception as exc:  # noqa: BLE001 - isolation boundary
             if len(reqs) == 1:
                 self._stats.on_error()
-                self._fail(reqs[0], exc)
+                req = reqs[0]
+                if req.tenant is not None:
+                    # a solo failure is THIS request's fault (the
+                    # isolation rerun already exonerated the batch):
+                    # feed the tenant's breaker, so a flood of one
+                    # tenant's poison sheds that tenant alone
+                    req.tenant.on_request_failure()
+                self._fail(req, exc)
                 return
             # error isolation: the batch is poisoned by (at least) one
             # member — rerun each alone in the bottom bucket so only the
@@ -418,7 +521,11 @@ class Server:
             result = tuple(o[i] for o in out) if multi else out[i]
             if req.future.set_running_or_notify_cancel():
                 req.future.set_result(result)
-                self._stats.on_complete((done - req.t_submit) * 1e3)
+                lat = (done - req.t_submit) * 1e3
+                self._stats.on_complete(lat)
+                if req.tenant is not None:
+                    req.tenant.stats.on_complete(lat)
+                    req.tenant.breaker.on_success()
 
     @staticmethod
     def _fail(req: _Request, exc: BaseException):

@@ -145,6 +145,11 @@ class PagedKVCache:
         """Pages a sequence of ``n_tokens`` occupies."""
         return -(-int(n_tokens) // self.page_size)
 
+    def pages_owned(self, slot: int) -> int:
+        """Pages currently reserved by ``slot`` (0 after :meth:`free`) —
+        the ground truth the per-tenant page accounting settles against."""
+        return self._owned[int(slot)]
+
     def can_admit(self, n_tokens: int) -> bool:
         """Whether a full reservation for ``n_tokens`` fits right now."""
         return self.pages_for(n_tokens) <= len(self._free)

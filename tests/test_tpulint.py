@@ -952,6 +952,99 @@ def test_non_atomic_write_repo_gate_clean():
 
 
 # ---------------------------------------------------------------------------
+# unbounded-queue
+# ---------------------------------------------------------------------------
+
+def test_unbounded_queue_flags_bare_queue():
+    f = lint("""
+        def start(ctx):
+            tasks = queue.Queue()
+            return tasks
+        """, rule="unbounded-queue")
+    assert len(f) == 1 and "queue.Queue" in f[0].message
+    # multiprocessing / context spellings and attribute targets count too
+    f = lint("""
+        class P:
+            def __init__(self, ctx):
+                self._task_q = ctx.Queue()
+        """, rule="unbounded-queue")
+    assert len(f) == 1
+
+
+def test_unbounded_queue_flags_queueish_deque():
+    f = lint("""
+        class Server:
+            def __init__(self):
+                self._queue = collections.deque()
+        """, rule="unbounded-queue")
+    assert len(f) == 1 and "maxlen" in f[0].message
+    # a literal maxlen=None is spelled-out unboundedness, not a bound
+    f = lint("""
+        def make():
+            req_queue = deque(maxlen=None)
+            return req_queue
+        """, rule="unbounded-queue")
+    assert len(f) == 1
+    # subscript target: per-tenant sub-queue dicts are still queues
+    f = lint("""
+        def add(self, tid):
+            self._queues[tid] = collections.deque()
+        """, rule="unbounded-queue")
+    assert len(f) == 1
+
+
+def test_unbounded_queue_negative_cases():
+    # bounded constructions are the fix, not a finding
+    assert lint("""
+        def start(self, depth):
+            self._queue = queue.Queue(maxsize=depth)
+            self._q2 = queue.Queue(depth)
+        """, rule="unbounded-queue") == []
+    assert lint("""
+        class T:
+            def __init__(self, depth):
+                self.queue = collections.deque(maxlen=depth)
+        """, rule="unbounded-queue") == []
+    # a deque that is NOT queue-named is a general container — out of
+    # scope (flagging every deque would bury the signal)
+    assert lint("""
+        def collect():
+            pending = collections.deque()
+            history = deque()
+            return pending, history
+        """, rule="unbounded-queue") == []
+
+
+def test_unbounded_queue_scope_is_mxnet_tpu():
+    src = """
+        def start():
+            tasks = queue.Queue()
+            return tasks
+    """
+    assert lint(src, rule="unbounded-queue",
+                relpath="tools/whatever.py") == []
+    assert lint(src, rule="unbounded-queue",
+                relpath="tests/test_x.py") == []
+    assert len(lint(src, rule="unbounded-queue")) == 1
+
+
+def test_unbounded_queue_repo_gate_clean_and_justified():
+    # the serving planes (batcher, decode, tenancy sub-queues) are
+    # bounded by construction — finding-free; the two multiprocessing
+    # image-pipeline queues ride the baseline WITH a justification
+    files = collect_files(["mxnet_tpu"], root=REPO)
+    findings = [f for f in lint_files(files, root=REPO,
+                                      passes=["unbounded-queue"])]
+    assert [f for f in findings if "serving" in f.path] == []
+    baseline = load_baseline(DEFAULT_BASELINE)
+    assert apply_baseline(findings, baseline) == []
+    justs = core.load_justifications(DEFAULT_BASELINE)
+    for f in findings:
+        assert f.baseline_key() in justs, \
+            "unbounded-queue baseline entries must carry a justification"
+
+
+# ---------------------------------------------------------------------------
 # whole-program graph engine (symbol table / call graph / lattices)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,125 @@
+"""unbounded-queue: consumer queues constructed without a bound.
+
+The PR-2/PR-7 serving discipline — carried into the PR-13 multi-tenant
+control plane — is that every producer/consumer queue inside
+``mxnet_tpu/`` is *bounded*, with an explicit shed
+(``QueueFullError``) when the bound is hit: under overload the system
+answers fewer requests fast instead of buffering all requests until
+memory or latency dies. An unbounded ``queue.Queue()`` or a
+``collections.deque()`` used as a queue silently re-introduces the
+failure mode (RAM-backed infinite backlog, tail latency unbounded).
+
+Flagged in ``mxnet_tpu/``:
+
+- any ``*Queue(...)`` construction (``queue.Queue``, ``ctx.Queue``,
+  ``multiprocessing.Queue``, ``LifoQueue``, ...) with neither a
+  positional size nor ``maxsize=`` — a Queue class IS a consumer queue,
+  whatever the target name;
+- ``deque()`` / ``collections.deque()`` without a ``maxlen`` (or with a
+  literal ``maxlen=None``) assigned to a queue-named target (the name
+  contains ``queue``, ends in ``_q``, or is ``q``) — deques are also
+  general containers, so only queue-shaped uses are in scope.
+
+A bound that is *enforced by a check before append* (the serving
+batcher idiom) still wants ``maxlen=`` as the structural backstop — the
+tenancy sub-queues do exactly that; sites where the bound genuinely
+lives elsewhere ride the baseline with a justification.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import FileContext, Finding, Pass, dotted_name, register
+
+_DEQUE_NAMES = {"deque", "collections.deque"}
+
+
+def _target_name(node: ast.AST) -> Optional[str]:
+    """The name a constructed value is bound to: plain name, attribute
+    tail (``self._task_q`` -> ``_task_q``), or the container's name for
+    a subscript (``self._queues[tid]`` -> ``_queues``)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Subscript):
+        return _target_name(node.value)
+    return None
+
+
+def _queue_ish(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    low = name.lower()
+    return "queue" in low or low.endswith("_q") or low == "q"
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.keyword]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw
+    return None
+
+
+def _queue_unbounded(call: ast.Call) -> bool:
+    """``Queue()`` with no positional size and no maxsize= (or a literal
+    maxsize=None/0 — stdlib treats <= 0 as infinite)."""
+    if call.args:
+        return False
+    kw = _kw(call, "maxsize")
+    if kw is None:
+        return True
+    return isinstance(kw.value, ast.Constant) and kw.value.value in (None, 0)
+
+
+def _deque_unbounded(call: ast.Call) -> bool:
+    """``deque()`` with no second positional (maxlen) and no maxlen= (or
+    a literal maxlen=None)."""
+    if len(call.args) >= 2:
+        return False
+    kw = _kw(call, "maxlen")
+    if kw is None:
+        return True
+    return isinstance(kw.value, ast.Constant) and kw.value.value is None
+
+
+@register
+class UnboundedQueuePass(Pass):
+    name = "unbounded-queue"
+    description = ("queue.Queue()/deque() consumer queues constructed "
+                   "without a bound in mxnet_tpu/ — unbounded backlog "
+                   "defers the overload failure from an explicit shed "
+                   "to an OOM/latency collapse")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            name = dotted_name(value.func) or ""
+            tail = name.rsplit(".", 1)[-1]
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            tnames = [_target_name(t) for t in targets]
+            if tail.endswith("Queue"):
+                if _queue_unbounded(value):
+                    yield ctx.finding(
+                        node, self.name,
+                        "unbounded `%s()` consumer queue — give it a "
+                        "bound (maxsize=) and shed explicitly when full "
+                        "(the bounded-queue serving discipline)" % name)
+            elif name in _DEQUE_NAMES:
+                if any(_queue_ish(t) for t in tnames) \
+                        and _deque_unbounded(value):
+                    yield ctx.finding(
+                        node, self.name,
+                        "unbounded `%s()` bound to a queue-named target "
+                        "(%s) — give it maxlen= (belt-and-braces even "
+                        "when a depth check sheds first)"
+                        % (name, "/".join(t for t in tnames if t)))
