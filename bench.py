@@ -22,7 +22,11 @@ QUICK = os.environ.get("BENCH_QUICK", "") not in ("", "0")
 SERVING = os.environ.get("BENCH_SERVING", "") not in ("", "0")
 # BENCH_DECODE=1: LLM decode soak — token-level continuous batching vs a
 # restart-per-batch baseline at the same slot count, mixed prompt/output
-# lengths, steady-state recompiles gauge-gated to 0 (rc != 0 otherwise)
+# lengths, steady-state recompiles gauge-gated to 0 (rc != 0 otherwise);
+# plus the shared-prefix soak: N prompts over K common system prompts at
+# caching off / prefix caching / caching+chunked-prefill — rc != 0 if
+# caching changes sampled tokens vs the no-cache oracle, hit ratio is 0,
+# TTFT p99 does not improve, or the recompile gauge moves
 DECODE = os.environ.get("BENCH_DECODE", "") not in ("", "0")
 # BENCH_CHAOS=1: run the bench under injected faults (MXNET_CHAOS spec, or
 # a default mild schedule) — proves the resilience layer holds the numbers
@@ -643,20 +647,126 @@ def _decode_bench():
     base_rate, base_stats, base_err = run("bench-decode-base",
                                           wave_mode=True)
     part["baseline_tokens_s"] = round(base_rate, 2)
+
+    # shared-prefix soak (ISSUE 14): N prompts over K common system
+    # prompts, served three ways at the SAME slot count — caching off
+    # (the no-cache oracle regime), prefix caching on, and caching +
+    # chunked prefill. Gates: identical sampled tokens across all three
+    # (caching must never change outputs), prefix_hit_ratio > 0, TTFT
+    # p99 better than caching-off, zero steady-state recompiles.
+    part["phase"] = "shared-prefix"
+    sp_rng = np.random.RandomState(1)
+    n_sys, sys_len, n_sp, sp_out = (4, 96, 24, 8) if QUICK \
+        else (8, 512, 96, 16)
+    sys_prompts = [sp_rng.randint(1, model.vocab_size,
+                                  sys_len).astype(np.int32)
+                   for _ in range(n_sys)]
+    sp_reqs = []
+    for i in range(n_sp):
+        suffix = sp_rng.randint(1, model.vocab_size,
+                                int(sp_rng.randint(2, 6))).astype(np.int32)
+        sp_reqs.append((np.concatenate([sys_prompts[i % n_sys], suffix]),
+                        sp_out))
+
+    def run_sp(name, prefix_cache, chunk):
+        eng = serving.DecodeEngine(
+            model, params, num_slots=slots, max_seq_len=max_seq,
+            prefill_buckets=(16, 32), name=name, timeout_ms=0,
+            prefix_cache=prefix_cache, prefill_chunk=chunk)
+        eng.warmup()
+        t0 = time.perf_counter()
+        outs, errs = [], []
+        futs = [eng.submit(p, m) for p, m in sp_reqs]
+        for f in futs:
+            try:
+                outs.append(f.result(timeout=600))
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                outs.append(None)
+                errs.append(repr(e))
+        elapsed = time.perf_counter() - t0
+        stats = eng.stats()
+        eng.close()
+        return outs, stats, elapsed, errs
+
+    sp = {}
+    sp_errors = []
+    sp_outs = {}
+    for key, cache_on, chunk in (
+            ("cache_off", False, 0),
+            ("cache_on", True, 0),
+            ("cache_on_chunked", True, 16 if QUICK else 64)):
+        outs, st, elapsed, errs = run_sp("bench-sp-" + key, cache_on, chunk)
+        sp_outs[key] = outs
+        sp_errors += errs
+        sp[key] = {
+            "tokens_s": round((st["tokens_generated"]) / elapsed, 2),
+            "ttft_p50_ms": round(st["ttft_p50_ms"], 3),
+            "ttft_p99_ms": round(st["ttft_p99_ms"], 3),
+            "prefix_hit_ratio": round(st.get("prefix_hit_ratio", 0.0), 4),
+            "prefill_chunks": st["prefill_chunks"],
+            "cow_copies": st["cow_copies"],
+            "pages_cached_end": st["kvcache"].get("pages_cached", 0),
+            "steady_state_recompiles": st.get("steady_state_recompiles"),
+        }
+    part["prefix_hit_ratio"] = sp["cache_on"]["prefix_hit_ratio"]
+    sp["ttft_p99_improvement"] = (
+        round(1.0 - sp["cache_on"]["ttft_p99_ms"]
+              / sp["cache_off"]["ttft_p99_ms"], 4)
+        if sp["cache_off"]["ttft_p99_ms"] else None)
+    # exactness gate: the cache-off run IS the no-cache oracle regime
+    # (tier-1 pins engine==oracle there); spot-check it against the
+    # dense oracle directly, then require bit-identical tokens from the
+    # cached and chunked runs
+    sp_mismatch = None
+    for i in range(2):
+        p, m = sp_reqs[i]
+        if sp_outs["cache_off"][i] is not None and not np.array_equal(
+                sp_outs["cache_off"][i],
+                model.reference_generate(params, p, m)):
+            sp_mismatch = "cache_off run diverged from the dense oracle " \
+                          "on request %d" % i
+    for key in ("cache_on", "cache_on_chunked"):
+        for i, (a, b) in enumerate(zip(sp_outs["cache_off"],
+                                       sp_outs[key])):
+            if a is None or b is None or not np.array_equal(a, b):
+                sp_mismatch = sp_mismatch or (
+                    "%s changed sampled tokens vs the no-cache oracle "
+                    "on request %d" % (key, i))
+                break
     part["phase"] = "done"
 
     recompiles = cont_stats.get("steady_state_recompiles")
     base_recompiles = base_stats.get("steady_state_recompiles")
-    errors = cont_err + base_err
+    sp_recompiles = sum(sp[k]["steady_state_recompiles"] or 0
+                        for k in ("cache_off", "cache_on",
+                                  "cache_on_chunked"))
+    errors = cont_err + base_err + sp_errors
     gate_err = None
     if recompiles:
         gate_err = ("continuous decode recompiled %d time(s) in steady "
                     "state (gate: 0 — membership churn must not retrace)"
                     % recompiles)
+    elif sp_recompiles:
+        gate_err = ("shared-prefix soak recompiled %d time(s) in steady "
+                    "state (gate: 0 — prefix hits, CoW copies and chunks "
+                    "must not retrace)" % sp_recompiles)
+    elif sp_mismatch:
+        gate_err = sp_mismatch + " (gate: caching/chunking must be exact)"
+    elif sp["cache_on"]["prefix_hit_ratio"] <= 0:
+        gate_err = ("shared-prefix soak measured prefix_hit_ratio 0 "
+                    "(gate: > 0 — the index must serve the common "
+                    "system prompts)")
+    elif sp["cache_on"]["ttft_p99_ms"] >= sp["cache_off"]["ttft_p99_ms"]:
+        gate_err = ("prefix caching did not improve TTFT p99 (%.3fms vs "
+                    "%.3fms caching-off at the same slot count)"
+                    % (sp["cache_on"]["ttft_p99_ms"],
+                       sp["cache_off"]["ttft_p99_ms"]))
     elif errors:
         gate_err = "; ".join(errors[:3])
     extra = {
         "requests": n_req, "slots": slots,
+        "shared_prefix": sp,
+        "shared_prefix_requests": n_sp,
         "baseline_slot_occupancy": round(base_stats["slot_occupancy"], 4),
         "baseline_steady_state_recompiles": base_recompiles,
         "speedup_vs_restart_per_batch": (round(cont_rate / base_rate, 4)
@@ -826,6 +936,11 @@ def _tenant_bench():
     tenant_rows = {}
     budget_violation = None
     for tid, snap in stats["tenants"].items():
+        if snap.get("pseudo"):
+            # the prefix-cache `shared` pseudo-tenant: page holdings
+            # only, no request lifecycle to report
+            tenant_rows[tid] = dict(snap)
+            continue
         tenant_rows[tid] = {
             "completed": snap["completed"],
             # the engine's TenantStats already counted every shed the

@@ -470,6 +470,33 @@ def paged_attention_reference(q, k_pool, v_pool, page_table, seq_lens,
     return jnp.where(any_valid, out, 0.0).astype(q.dtype)
 
 
+def paged_prefill_attention(q, k_pool, v_pool, page_row, start, length,
+                            scale=None):
+    """Chunked-prefill attention: C chunk queries of ONE sequence attend
+    over that sequence's pages (the prior prefix written by earlier
+    chunks/shared prefix pages AND the chunk's own rows, which the model
+    scatters into the pool before calling this).
+
+    q: (C, H, D) — the chunk's queries at absolute positions
+    ``start .. start+C-1``; page_row: (max_pages,) int32, the sequence's
+    page-table row; start/length: traced int32 scalars — ``length`` is
+    the chunk's real token count (padding rows beyond it come back
+    zeroed). Reuses the decode kernel by treating each chunk token as
+    its own grid row sharing one page table — every shape is static in
+    (C, max_pages, page_size), so one compile serves every chunk of a
+    rung no matter where it starts. Returns (C, H, D).
+    """
+    c = q.shape[0]
+    idx = jnp.arange(c, dtype=jnp.int32)
+    q_pos = start.astype(jnp.int32) + idx
+    # query i sees positions <= start+i (causal), padding rows see nothing
+    seq_lens = jnp.where(idx < length, q_pos + 1, 0).astype(jnp.int32)
+    pt = jnp.broadcast_to(page_row.astype(jnp.int32)[None, :],
+                          (c, page_row.shape[0]))
+    return paged_attention(q, k_pool, v_pool, pt, seq_lens, q_pos=q_pos,
+                           scale=scale)
+
+
 def paged_attention(q, k_pool, v_pool, page_table, seq_lens, q_pos=None,
                     scale=None):
     """Dispatcher the decode engine traces: the Pallas kernel on TPU (when

@@ -56,7 +56,7 @@ from .batcher import (EngineUnavailableError, QueueFullError,
 from .buckets import bucket_ladder, pad_to_bucket, select_bucket
 from .decode import DecodeEngine, PagedDecodeModel, TinyDecoder
 from .engine import BlockEngine, Engine, StableHLOEngine
-from .kvcache import OutOfPagesError, PagedKVCache
+from .kvcache import OutOfPagesError, PagedKVCache, PrefixMatch
 from .stats import ServingStats, TenantStats
 from .tenancy import (Tenant, TenantBreaker, TenantRegistry,
                       TenantUnavailableError, WeightedFairQueue)
@@ -69,7 +69,7 @@ __all__ = [
     "bucket_ladder", "select_bucket", "pad_to_bucket",
     "serve_block", "serve_stablehlo",
     "DecodeEngine", "PagedDecodeModel", "TinyDecoder",
-    "PagedKVCache", "OutOfPagesError",
+    "PagedKVCache", "OutOfPagesError", "PrefixMatch",
     "Tenant", "TenantRegistry", "TenantBreaker",
     "TenantUnavailableError", "WeightedFairQueue",
 ]

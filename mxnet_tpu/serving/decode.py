@@ -46,6 +46,29 @@ pools, slots reset) while the engine keeps answering later traffic —
 all under one circuit breaker whose open state sheds with
 :class:`EngineUnavailableError` instead of hanging.
 
+Prefix caching (``MXNET_DECODE_PREFIX_CACHE``, default on): admission
+walks the cache's rolling-hash prefix index and maps a matching system
+prompt's pages straight into the new slot's page table — refcounted,
+read-only, prefilled once per fleet instead of once per request; the
+first divergent/partial page is shared copy-on-write (a jitted device
+copy into a page charged to the writer), and only the non-shared tail is
+reserved against the tenant's budget (shared pages belong to the
+``shared`` pseudo-tenant). The tail — or, on a full hit, a one-token
+recompute of the last prompt position — runs through a *chunk* jit that
+attends over the sequence's pages, so a hit's prefill cost is the tail,
+not the prompt. Outputs stay exactly equal to the no-cache oracle: hits
+are token-verified against the stored runs, the index is flushed on
+weight swaps and pool re-zeros, and CoW means no sequence ever observes
+another's writes.
+
+Chunked prefill (``MXNET_DECODE_PREFILL_CHUNK`` = chunk size, default
+off): prefill splits into fixed-size chunks interleaved with decode
+ticks inside the same one-jitted-step regime — one statically-shaped
+chunk rung pre-compiled at :meth:`DecodeEngine.warmup`, each chunk
+carrying the KV written so far through the page table — so a long
+prompt stops monopolizing the tick loop and TTFT p99 stops tracking the
+longest prompt in the queue.
+
 Multi-tenancy (:mod:`~mxnet_tpu.serving.tenancy`): every request
 belongs to a tenant (``submit(..., tenant=)``; untagged = ``default``).
 The single FIFO is replaced by per-tenant bounded sub-queues drained by
@@ -76,10 +99,10 @@ from ..resilience import CircuitBreaker, chaos
 from .batcher import (EngineUnavailableError, QueueFullError,
                       RequestTimeoutError, ServerClosedError)
 from .buckets import select_bucket
-from .kvcache import OutOfPagesError, PagedKVCache, write_kv
+from .kvcache import OutOfPagesError, PagedKVCache, PrefixMatch, write_kv
 from .stats import ServingStats
-from .tenancy import (Tenant, TenantRegistry, TenantUnavailableError,
-                      WeightedFairQueue)
+from .tenancy import (SHARED_TENANT, Tenant, TenantRegistry,
+                      TenantUnavailableError, WeightedFairQueue)
 
 __all__ = ["DecodeEngine", "PagedDecodeModel", "TinyDecoder"]
 
@@ -88,6 +111,8 @@ _DEFAULT_MAX_SEQ_LEN = 256
 _DEFAULT_PREFILL_BUCKETS = "16,64"
 _DEFAULT_TIMEOUT_MS = 10000.0
 _DEFAULT_QUEUE_DEPTH = 256
+_DEFAULT_PREFIX_CACHE = 1  # sharing is exact by construction: default on
+_DEFAULT_PREFILL_CHUNK = 0  # 0 = monolithic prefill (one rung per prompt)
 
 _T_TOKENS = telemetry.counter(
     "mxnet_decode_tokens_total",
@@ -101,7 +126,7 @@ _T_EVENTS = telemetry.counter(
     "mxnet_decode_events_total",
     "decode engine lifecycle events (prefill, admitted, completed, "
     "evicted, shed_open_breaker, shed_tenant_breaker, deadline_evicted, "
-    "weight_swap)",
+    "weight_swap, cow_copy)",
     labels=("server", "event"))
 
 
@@ -150,10 +175,28 @@ class PagedDecodeModel:
         ``(last_token_logits (vocab,), k_pool, v_pool)``."""
         raise NotImplementedError
 
+    def prefill_chunk(self, params, tokens, start, length, k_pool, v_pool,
+                      page_table_row, write_pages, write_offsets):
+        """One prefill chunk of one sequence, attending THROUGH the page
+        table: ``tokens`` ``(C,)`` padded to the chunk rung at absolute
+        positions ``start .. start+C-1`` (``start``/``length`` traced
+        int32 scalars — one compile per rung, not per prompt or chunk
+        index), ``page_table_row`` ``(max_pages,)`` the slot's row.
+        Writes the chunk's K/V at ``write_*`` ``(C,)`` (padding and
+        already-cached positions target the null page), then attends
+        each chunk query over the sequence's pages — the prefix written
+        by earlier chunks or mapped from the prefix cache included.
+        Returns ``(last_real_token_logits (vocab,), k_pool, v_pool)``.
+        Both chunked prefill and the prefix-cache tail/recompute path
+        run through this."""
+        raise NotImplementedError
+
 
 class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "eos_id", "future", "t_submit",
-                 "deadline", "tokens", "last_t", "slot", "tenant")
+                 "deadline", "tokens", "last_t", "slot", "tenant",
+                 "match", "kv_cached", "filled", "prefilling", "seq",
+                 "epoch")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  eos_id: Optional[int], deadline: Optional[float],
@@ -168,6 +211,17 @@ class _DecodeRequest:
         self.last_t = 0.0
         self.slot = -1
         self.tenant = tenant
+        # prefix-cache / chunked-prefill state: the admission-time match
+        # (stashed by the guard), how many prompt tokens' KV came from
+        # shared pages, the next position the chunk scheduler processes,
+        # whether prefill is still in flight, and the admission order
+        # the chunk lane round-robins over
+        self.match: Optional[PrefixMatch] = None
+        self.kv_cached = 0
+        self.filled = 0
+        self.prefilling = False
+        self.seq = 0
+        self.epoch = 0  # weight-swap epoch at prefill start (stale guard)
 
 
 class DecodeEngine:
@@ -198,7 +252,9 @@ class DecodeEngine:
                  name: str = "decode", retry_policy=None,
                  breaker_threshold: Optional[int] = None,
                  breaker_reset_s: Optional[float] = None,
-                 dtype="float32", tenants=None):
+                 dtype="float32", tenants=None,
+                 prefix_cache: Optional[bool] = None,
+                 prefill_chunk: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
@@ -220,16 +276,36 @@ class DecodeEngine:
         if ring_prefill_len is None:
             ring_prefill_len = get_env("MXNET_DECODE_RING_PREFILL_LEN", 0,
                                        int, cache=False)
+        if prefix_cache is None:
+            prefix_cache = bool(get_env("MXNET_DECODE_PREFIX_CACHE",
+                                        _DEFAULT_PREFIX_CACHE, int,
+                                        cache=False))
+        if prefill_chunk is None:
+            prefill_chunk = get_env("MXNET_DECODE_PREFILL_CHUNK",
+                                    _DEFAULT_PREFILL_CHUNK, int,
+                                    cache=False)
         self.num_slots = max(1, int(num_slots))
         self.max_seq_len = int(max_seq_len)
         self._queue_depth = max(1, int(queue_depth))
         self._timeout_s = float(timeout_ms) / 1e3
         self._ring_len = max(0, int(ring_prefill_len))
+        self._prefix_cache = bool(prefix_cache)
+        self._chunk = max(0, min(int(prefill_chunk), self.max_seq_len))
         self._ladder = self._prefill_ladder(prefill_buckets)
+        # the chunk jit's statically-shaped rungs: chunked prefill uses
+        # ONE rung (the chunk size); with chunking off the prefix-cache
+        # tail pads to the prefill ladder instead
+        if self._chunk:
+            self._chunk_rungs: tuple = (self._chunk,)
+        elif self._prefix_cache:
+            self._chunk_rungs = self._ladder
+        else:
+            self._chunk_rungs = ()
         self._cache = PagedKVCache(
             self.num_slots, self.max_seq_len, model.num_layers,
             model.num_kv_heads, model.head_dim, page_size=page_size,
-            num_pages=num_pages, dtype=dtype, name=name)
+            num_pages=num_pages, dtype=dtype, name=name,
+            prefix_cache=self._prefix_cache)
         self._stats = ServingStats(name)
         self._name = name
         self._retry = retry_policy
@@ -281,12 +357,38 @@ class DecodeEngine:
                 write_offsets)
             return jnp.argmax(last).astype(jnp.int32), k_pool, v_pool
 
-        # pools are donated through both jits (they are dead the moment
+        # one prefill CHUNK: same (3, rung) packing plus the absolute
+        # start position and the slot's page-table row — the chunk
+        # attends through the pages (earlier chunks' and shared prefix
+        # KV included), so start/length are traced and one compile
+        # serves every chunk of a rung
+        def _chunk_fn(params, packed, start, length, page_row, k_pool,
+                      v_pool):
+            tokens, write_pages, write_offsets = packed
+            last, k_pool, v_pool = model.prefill_chunk(
+                params, tokens, start, length, k_pool, v_pool, page_row,
+                write_pages, write_offsets)
+            return jnp.argmax(last).astype(jnp.int32), k_pool, v_pool
+
+        # the copy-on-write copy: duplicate one page's K/V (all layers)
+        # into a fresh page so a sequence diverging inside a shared page
+        # writes into its own copy; src/dst are traced scalars — ONE
+        # compile, pre-warmed against the null page
+        def _cow_fn(k_pool, v_pool, src, dst):
+            k_pool = k_pool.at[:, dst].set(k_pool[:, src])
+            v_pool = v_pool.at[:, dst].set(v_pool[:, src])
+            return k_pool, v_pool
+
+        # pools are donated through the jits (they are dead the moment
         # the step returns — swap_pools rebinds to the outputs), so the
         # cache costs ONE pool of HBM, not two per step
         self._step = jax.jit(_step_fn,
                              donate_argnums=(2, 3) if donate else ())
         self._prefill_jit = jax.jit(_prefill_fn, donate_argnums=donate)
+        self._chunk_jit = jax.jit(
+            _chunk_fn, donate_argnums=(5, 6) if donate else ())
+        self._cow_jit = jax.jit(
+            _cow_fn, donate_argnums=(0, 1) if donate else ())
         self._pt_dev = None  # version-keyed device page table
         self._pt_version = -1
 
@@ -300,6 +402,10 @@ class DecodeEngine:
         self._evictions = 0
         self._occ_sum = 0.0
         self._ticks = 0
+        self._cow_copies = 0   # written/read under _cv only
+        self._admit_seq = 0    # admission order among prefilling slots
+        self._rr_last = 0      # round-robin cursor over that order
+        self._swap_epoch = 0   # worker-confined; bumps per applied swap
         self._thread = threading.Thread(target=self._worker, daemon=True,
                                         name="mxnet-decode-" + name)
         self._thread.start()
@@ -508,9 +614,11 @@ class DecodeEngine:
             return self._active_variant
 
     def warmup(self) -> int:
-        """Compile the decode step and every prefill rung before traffic
-        (dummy passes writing only to the null page); anchors the
-        steady-state-recompile gauge at 0. Returns the compile count."""
+        """Compile the decode step, every prefill rung, every chunk rung
+        and the CoW copy jit before traffic (dummy passes writing only
+        to the null page); anchors the steady-state-recompile gauge at 0
+        — a cold first shared-prefix request compiles NOTHING. Returns
+        the compile count."""
         jnp = self._jnp
         s = self.num_slots
         with self._cv:
@@ -522,13 +630,31 @@ class DecodeEngine:
             params, jnp.asarray(packed), self._cache.k_pool,
             self._cache.v_pool, self._device_page_table())
         self._cache.swap_pools(kp, vp)
-        for rung in self._ladder:
+        if not self._chunk:
+            # chunked mode never dispatches the monolithic rungs — every
+            # prompt runs through the one chunk rung compiled below
+            for rung in self._ladder:
+                pre = np.zeros((3, rung), np.int32)
+                pre[1], pre[2] = self._cache.null_write_slots(rung)
+                _tok, kp, vp = self._prefill_jit(
+                    params, jnp.asarray(pre),
+                    jnp.asarray(1, jnp.int32), self._cache.k_pool,
+                    self._cache.v_pool)
+                self._cache.swap_pools(kp, vp)
+        null_row = np.zeros((self._cache.max_pages,), np.int32)
+        for rung in self._chunk_rungs:
             pre = np.zeros((3, rung), np.int32)
             pre[1], pre[2] = self._cache.null_write_slots(rung)
-            _tok, kp, vp = self._prefill_jit(
-                params, jnp.asarray(pre),
-                jnp.asarray(1, jnp.int32), self._cache.k_pool,
-                self._cache.v_pool)
+            _tok, kp, vp = self._chunk_jit(
+                params, jnp.asarray(pre), jnp.asarray(0, jnp.int32),
+                jnp.asarray(1, jnp.int32), jnp.asarray(null_row),
+                self._cache.k_pool, self._cache.v_pool)
+            self._cache.swap_pools(kp, vp)
+        if self._prefix_cache:
+            # null -> null: harmless, and the CoW copy is compiled
+            kp, vp = self._cow_jit(
+                self._cache.k_pool, self._cache.v_pool,
+                jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
             self._cache.swap_pools(kp, vp)
         count = self.compile_count
         self._warm_compiles = count if count >= 0 else None
@@ -538,11 +664,13 @@ class DecodeEngine:
 
     @property
     def compile_count(self) -> int:
-        a = telemetry.jit_cache_size(self._step)
-        b = telemetry.jit_cache_size(self._prefill_jit)
-        if a < 0 or b < 0:
+        sizes = [telemetry.jit_cache_size(self._step),
+                 telemetry.jit_cache_size(self._prefill_jit),
+                 telemetry.jit_cache_size(self._chunk_jit),
+                 telemetry.jit_cache_size(self._cow_jit)]
+        if any(s < 0 for s in sizes):
             return -1
-        return a + b
+        return sum(sizes)
 
     def stats(self) -> dict:
         out = self._stats.snapshot()
@@ -559,12 +687,25 @@ class DecodeEngine:
                 "slot_occupancy": (self._occ_sum / self._ticks
                                    if self._ticks else 0.0),
                 "prefill_buckets": list(self._ladder),
+                "prefill_chunk": self._chunk,
+                "cow_copies": self._cow_copies,
                 "breaker": self._breaker.state,
                 "weight_swaps": self._swaps,
                 "active_variant": self._active_variant,
             })
         out["tenants"] = self._tenants.snapshot()
         out["kvcache"] = self._cache.stats()
+        out["prefix_cache_enabled"] = self._prefix_cache
+        if self._prefix_cache:
+            out["prefix_hit_ratio"] = out["kvcache"]["prefix_hit_ratio"]
+            # refcount>1 pages belong to the `shared` pseudo-tenant: no
+            # real tenant's budget is charged for them (a sharer pays
+            # only its exclusive tail + CoW copies)
+            out["tenants"][SHARED_TENANT] = {
+                "pseudo": True,
+                "pages_in_use_now": out["kvcache"]["shared_pages"],
+                "pages_cached": out["kvcache"]["pages_cached"],
+            }
         count = self.compile_count
         out["compile_count"] = count
         if self._warm_compiles is not None and count >= 0:
@@ -647,14 +788,30 @@ class DecodeEngine:
                 continue
             try:
                 self._admit()
-                active = [(i, r) for i, r in enumerate(self._slots)
-                          if r is not None]
-                if not active:
+                prefilling = [(i, r) for i, r in enumerate(self._slots)
+                              if r is not None and r.prefilling]
+                decoding = [(i, r) for i, r in enumerate(self._slots)
+                            if r is not None and not r.prefilling]
+                if prefilling:
+                    # ONE chunk per tick, ROUND-ROBIN over prefilling
+                    # slots (admission order, wrapping), then the tick
+                    # goes back to decoding. Round-robin — not oldest-
+                    # first — is what decouples TTFT from the longest
+                    # prompt: a 1-chunk prompt lands on its next turn
+                    # instead of waiting out a 100-chunk neighbour.
+                    cands = sorted(prefilling, key=lambda t: t[1].seq)
+                    slot, req = next(
+                        (t for t in cands if t[1].seq > self._rr_last),
+                        cands[0])
+                    self._rr_last = req.seq
+                    self._advance_prefill(slot, req)
+                if decoding:
+                    self._step_once(decoding)
+                elif not prefilling:
                     # every queued tenant deferred (pages/rate/breaker)
                     # with nothing in flight: yield instead of spinning
                     time.sleep(0.001)
                     continue
-                self._step_once(active)
             except Exception as exc:  # noqa: BLE001 - engine must survive
                 # belt-and-braces (the PR-2 batcher discipline): NO
                 # exception may kill the engine thread — that would hang
@@ -683,6 +840,13 @@ class DecodeEngine:
                 self._params = params
                 self._active_variant = variant
                 self._swaps += 1
+            self._swap_epoch += len(swaps)
+            if swaps and self._prefix_cache:
+                # cached KV was computed under the OLD weights: a prompt
+                # prefilled under the new ones must not match it — flush
+                # the index (in-flight sequences keep their pages and
+                # continue, the documented rollout semantic)
+                self._cache.clear_prefix_index()
         for _params, _variant, fut in swaps:
             _T_EVENTS.inc(server=self._name, event="weight_swap")
             if fut.set_running_or_notify_cancel():
@@ -765,15 +929,29 @@ class DecodeEngine:
         if tenant.breaker.state == "open":
             return False
         total = int(req.prompt.size) + req.max_new
+        # the admission walk: map-able shared prefix pages reduce both
+        # the global reservation AND the tenant's charge — reserve()
+        # only pays for the non-shared tail (+ the CoW copy). Stashed on
+        # the request; _prefill consumes it on the same worker pass, so
+        # the index cannot change in between.
+        match = (self._cache.match_prefix(req.prompt)
+                 if self._prefix_cache
+                 and not (self._ring_len
+                          and req.prompt.size >= self._ring_len)
+                 else None)
+        req.match = match
         need = self._cache.pages_for(total)
-        if need > self._cache.pages_free:
+        if match is not None:
+            need -= len(match.full)
+        if not self._cache.can_admit_prefix(total, match):
             # global page pressure: this head defers, a cheaper tenant
             # behind it may still fit
             tenant.stats.on_defer("pages")
             return False
         if not tenant.within_page_budget(need):
-            # the tenant is at ITS quota — only its own completions can
-            # unblock it, everyone else keeps flowing
+            # the tenant is at ITS quota (shared pages charge the
+            # `shared` pseudo-tenant, not this budget) — only its own
+            # completions can unblock it, everyone else keeps flowing
             tenant.stats.on_defer("pages")
             return False
         if not tenant.take_tokens(total):
@@ -821,18 +999,62 @@ class DecodeEngine:
                                  if r is not None], exc)
 
     def _prefill(self, req: _DecodeRequest, slot: int):
-        from .. import resilience
-
         # tenant-scoped chaos site, OUTSIDE the retry policy: a fault
         # scheduled against this tenant models the tenant's own traffic
         # being poisoned — it fails this request (feeding the tenant's
         # breaker via _admit's handler), it is not an engine transient
         # to be retried away. Site: serving.decode.tenant.<id>.
         chaos.maybe_fail("serving.decode.tenant.%s" % req.tenant.tenant_id)
+        p = int(req.prompt.size)
+        total = p + req.max_new
+        req.epoch = self._swap_epoch  # worker-confined read
+        ring = bool(self._ring_len and p >= self._ring_len)
+        if self._prefix_cache and not ring:
+            # the admission walk's match (stashed by the guard on this
+            # same worker pass): shared full pages map refcounted into
+            # the slot, the divergent/partial page gets a private CoW
+            # copy, and reserve() pays only for the non-shared tail
+            matched, cow_src, cow_dst = self._cache.admit_prefix(
+                slot, total, req.match)
+        else:
+            self._cache.reserve(slot, total)
+            matched, cow_src, cow_dst = 0, None, None
+        # shared pages charge the `shared` pseudo-tenant (i.e. nobody):
+        # the tenant's budget pays for its exclusive tail + CoW copies
+        req.tenant.charge_pages(self._cache.exclusive_pages(slot))
+        if cow_src is not None:
+            self._run_cow(cow_src, cow_dst)
+        req.kv_cached = matched
+        # at least the LAST prompt position always runs through the
+        # model: its logits are the first output token — a full-prompt
+        # hit recomputes that one position (null writes) over the
+        # shared/CoW pages instead of re-prefilling anything
+        req.filled = min(matched, p - 1)
+        if self._chunk and not ring:
+            req.prefilling = True
+            with self._cv:
+                self._admit_seq += 1
+                req.seq = self._admit_seq
+            self._slots[slot] = req
+            _T_EVENTS.inc(server=self._name, event="admitted")
+            return
+        if matched == 0:
+            tok = self._run_full_prefill(req, slot, ring=ring)
+        else:
+            rung = select_bucket(p - req.filled, self._ladder)
+            tok = self._run_chunk(slot, req, req.filled, p, rung)
+        self._finish_prefill(req, slot, tok)
+
+    def _run_full_prefill(self, req: _DecodeRequest, slot: int,
+                          ring: bool = False):
+        """The monolithic prefill: whole prompt padded to a ladder rung,
+        attention in-graph (or routed through ring attention for
+        long-context prompts). The cold-cache path — a prefix hit runs
+        :meth:`_run_chunk` over the tail instead."""
+        from .. import resilience
+
         jnp = self._jnp
         p = int(req.prompt.size)
-        self._cache.reserve(slot, p + req.max_new)
-        req.tenant.charge_pages(self._cache.pages_owned(slot))
         rung = select_bucket(p, self._ladder)
         pre = np.zeros((3, rung), np.int32)  # tokens, write pages, offsets
         pre[0, :p] = req.prompt
@@ -840,7 +1062,6 @@ class DecodeEngine:
         npg, noff = self._cache.null_write_slots(rung - p)
         pre[1] = np.concatenate([wpg, npg])
         pre[2] = np.concatenate([woff, noff])
-        ring = self._ring_len and p >= self._ring_len
         policy = self._retry or resilience.default_policy()
 
         def attempt():
@@ -857,10 +1078,118 @@ class DecodeEngine:
                 self._cache.k_pool, self._cache.v_pool)
 
         tok, kp, vp = policy.call(attempt, site="serving.decode.prefill")
+        self._cache.swap_pools(kp, vp)
+        return tok
+
+    def _run_chunk(self, slot: int, req: _DecodeRequest, start: int,
+                   end: int, rung: int):
+        """One jitted prefill chunk over prompt positions ``[start,
+        end)`` of ``slot``, padded to ``rung``. Positions below
+        ``req.kv_cached`` are only *recomputed* (their KV already sits
+        in shared/CoW pages — writes redirect to the null page); the
+        rest scatter into the slot's reserved pages. Attention runs over
+        the slot's page row, so each chunk sees everything written
+        before it. Returns the device argmax token of position
+        ``end - 1``."""
+        from .. import resilience
+
+        jnp = self._jnp
+        n = end - start
+        pre = np.zeros((3, rung), np.int32)
+        pre[0, :n] = req.prompt[start:end]
+        cached_n = max(0, min(req.kv_cached, end) - start)
+        pages, offs = [], []
+        if cached_n:
+            npg, noff = self._cache.null_write_slots(cached_n)
+            pages.append(npg)
+            offs.append(noff)
+        if n - cached_n:
+            wpg, woff = self._cache.write_slots(slot, start + cached_n,
+                                                n - cached_n)
+            pages.append(wpg)
+            offs.append(woff)
+        if rung - n:
+            npg, noff = self._cache.null_write_slots(rung - n)
+            pages.append(npg)
+            offs.append(noff)
+        pre[1] = np.concatenate(pages)
+        pre[2] = np.concatenate(offs)
+        row = np.ascontiguousarray(self._cache.page_table[slot])
+        policy = self._retry or resilience.default_policy()
+
+        def attempt():
+            chaos.maybe_fail("serving.decode.prefill")
+            if self._pools_dead():
+                raise MXNetError(  # not transient: stop the retry loop
+                    "KV pools consumed by a failed prefill (donation); "
+                    "eviction required")
+            return telemetry.jit_call(
+                "serving.decode_prefill_chunk", self._chunk_jit,
+                self._params, jnp.asarray(pre),
+                jnp.asarray(start, jnp.int32), jnp.asarray(n, jnp.int32),
+                jnp.asarray(row), self._cache.k_pool, self._cache.v_pool)
+
+        tok, kp, vp = policy.call(attempt, site="serving.decode.prefill")
+        self._cache.swap_pools(kp, vp)
+        self._stats.on_prefill_chunk()
+        return tok
+
+    def _run_cow(self, src: int, dst: int):
+        """The copy-on-write device copy (jitted, precompiled at
+        warmup): the divergent/partial page's K/V duplicated into the
+        writer's own page BEFORE any of its writes can land there —
+        sharers never observe each other's tokens."""
+        jnp = self._jnp
+        kp, vp = telemetry.jit_call(
+            "serving.decode_cow", self._cow_jit, self._cache.k_pool,
+            self._cache.v_pool, jnp.asarray(src, jnp.int32),
+            jnp.asarray(dst, jnp.int32))
+        self._cache.swap_pools(kp, vp)
+        with self._cv:
+            self._cow_copies += 1
+        _T_EVENTS.inc(server=self._name, event="cow_copy")
+
+    def _advance_prefill(self, slot: int, req: _DecodeRequest):
+        """Chunked prefill: ONE chunk for ``slot``, then the tick yields
+        back to decoding. Completion delivers the first token (the TTFT
+        mark). A chunk failure is request-level — exactly this future
+        fails (feeding the TENANT breaker), the engine keeps ticking —
+        unless donation consumed the pools, which escalates to the full
+        eviction like any pool death."""
+        p = int(req.prompt.size)
+        end = min(req.filled + self._chunk, p)
+        try:
+            tok = self._run_chunk(slot, req, req.filled, end, self._chunk)
+        except Exception as exc:  # noqa: BLE001 - isolate to request
+            self._slots[slot] = None
+            self._release_slot(slot, req)
+            req.tenant.on_request_failure()
+            self._stats.on_error()
+            self._fail(req, exc)
+            if self._pools_dead():
+                self._evict([(i, r) for i, r in enumerate(self._slots)
+                             if r is not None], exc)
+            return
+        req.filled = end
+        if end >= p:
+            self._finish_prefill(req, slot, tok)
+
+    def _finish_prefill(self, req: _DecodeRequest, slot: int, tok):
+        """Prefill complete (monolithic, tail or final chunk): index the
+        prompt's pages for future sharers, deliver the first token and
+        hand the slot to the decode tick."""
+        p = int(req.prompt.size)
         self._breaker.on_success()
         req.tenant.breaker.on_success()
-        self._cache.swap_pools(kp, vp)
         self._cache.seq_lens[slot] = p
+        if not (self._ring_len and p >= self._ring_len) \
+                and req.epoch == self._swap_epoch:
+            # a swap that landed mid-prefill (between chunks) flushed
+            # the index AND left this sequence's earlier pages holding
+            # old-weight KV: serving the request is the documented
+            # in-flight rollout semantic, but RE-INDEXING those pages
+            # would hand stale KV to future prompts — skip the insert
+            self._cache.insert_prefix(slot, req.prompt)
         self._prefills += 1
         _T_EVENTS.inc(server=self._name, event="prefill")
         # first token: ONE scalar fetch per admitted sequence (prefill
@@ -875,8 +1204,11 @@ class DecodeEngine:
         self._tokens_total += 1
         _T_TOKENS.inc(server=self._name)
         req.slot = slot
-        _T_EVENTS.inc(server=self._name, event="admitted")
+        req.prefilling = False
+        if not self._chunk:
+            _T_EVENTS.inc(server=self._name, event="admitted")
         if self._finished(req, first):
+            self._slots[slot] = None
             self._complete(req, slot, now)
         else:
             self._slots[slot] = req
@@ -959,7 +1291,10 @@ class DecodeEngine:
             toks = fetch_host([sampled])[0]
         except Exception as exc:  # noqa: BLE001 - evict, don't die
             self._breaker.on_failure()
-            self._evict(active, exc)
+            # the pool re-zero kills EVERY in-flight sequence's KV —
+            # chunked-prefilling slots included, not just this tick's
+            self._evict([(i, r) for i, r in enumerate(self._slots)
+                         if r is not None], exc)
             return
         self._breaker.on_success()
         now = time.perf_counter()
@@ -999,10 +1334,13 @@ class DecodeEngine:
                 or (req.eos_id is not None and tok == req.eos_id))
 
     def _release_slot(self, slot: int, req: _DecodeRequest):
-        """Free a slot's pages AND return them to the owning tenant's
-        budget. Idempotent (a slot already freed owns 0 pages), so the
-        close()/worker race can double-call it harmlessly."""
-        freed = self._cache.pages_owned(slot)
+        """Free a slot's page mappings AND return its EXCLUSIVE pages to
+        the owning tenant's budget — shared prefix pages were never
+        charged to it (they belong to the ``shared`` pseudo-tenant) and
+        live on for other sharers / the prefix index. Idempotent (a slot
+        already freed owns 0 pages), so the close()/worker race can
+        double-call it harmlessly."""
+        freed = self._cache.exclusive_pages(slot)
         self._cache.free(slot)
         req.tenant.release_pages(freed)
 
@@ -1162,6 +1500,35 @@ class TinyDecoder(PagedDecodeModel):
             else:
                 att = attn(q, k, v, self.scale)
             x = x + att.reshape(t, h * d) @ layer["wo"]
+            x = x + self._mlp(self._norm(x, layer["ln2"]), layer)
+        logits = self._norm(x, params["lnf"]) @ params["unembed"]
+        return logits[length - 1], k_pool, v_pool
+
+    def prefill_chunk(self, params, tokens, start, length, k_pool, v_pool,
+                      page_table_row, write_pages, write_offsets):
+        import jax.numpy as jnp
+
+        from ..ops import pallas_kernels
+
+        c = tokens.shape[0]
+        h, kh, d = self.num_heads, self.num_kv_heads, self.head_dim
+        positions = start.astype(jnp.int32) + jnp.arange(c, dtype=jnp.int32)
+        x = params["embed"][tokens] + self._pe(positions)
+        for li, layer in enumerate(params["layers"]):
+            hx = self._norm(x, layer["ln1"])
+            q = (hx @ layer["wq"]).reshape(c, h, d)
+            k = (hx @ layer["wk"]).reshape(c, kh, d)
+            v = (hx @ layer["wv"]).reshape(c, kh, d)
+            # scatter FIRST so in-chunk positions read their own K/V back
+            # through the pages like every earlier chunk's (already-cached
+            # positions write to the null page — their KV is in the
+            # shared/CoW pages, this pass only recomputes activations)
+            k_pool, v_pool = write_kv(k_pool, v_pool, li, k, v,
+                                      write_pages, write_offsets)
+            att = pallas_kernels.paged_prefill_attention(
+                q, k_pool[li], v_pool[li], page_table_row, start, length,
+                scale=self.scale)
+            x = x + att.reshape(c, h * d) @ layer["wo"]
             x = x + self._mlp(self._norm(x, layer["ln2"]), layer)
         logits = self._norm(x, params["lnf"]) @ params["unembed"]
         return logits[length - 1], k_pool, v_pool

@@ -72,6 +72,12 @@ _T_TPOT = telemetry.histogram(
     "time per output token: inter-token interval during decode in "
     "milliseconds",
     labels=("server",))
+_T_CHUNKS = telemetry.counter(
+    "mxnet_decode_prefill_chunks_total",
+    "prefill chunks executed by the decode plane (chunked prefill "
+    "interleaves these with decode ticks so TTFT stops tracking the "
+    "longest prompt in the queue)",
+    labels=("server",))
 
 
 def _percentile_rows(out: Dict, pairs) -> None:
@@ -109,6 +115,7 @@ class ServingStats:
         self.timeouts = 0
         self.errors = 0
         self.batches = 0
+        self.prefill_chunks = 0
         self.padded_rows = 0
         self.served_rows = 0
         self.isolation_retries = 0
@@ -192,6 +199,14 @@ class ServingStats:
             self._tpot_ms.extend(tpot_ms_batch)
         _T_TPOT.observe_many(tpot_ms_batch, server=self.name)
 
+    def on_prefill_chunk(self):
+        """One prefill chunk executed (decode plane, chunked prefill or
+        a prefix-cache tail completion). Chunk rate, not token rate —
+        off the per-token hot path."""
+        with self._lock:
+            self.prefill_chunks += 1
+        _T_CHUNKS.inc(server=self.name)
+
     def on_error(self):
         with self._lock:
             self.errors += 1
@@ -240,6 +255,7 @@ class ServingStats:
                 "timeouts": self.timeouts,
                 "errors": self.errors,
                 "batches": self.batches,
+                "prefill_chunks": self.prefill_chunks,
                 "isolation_retries": self.isolation_retries,
                 "fallbacks": self.fallbacks,
                 "unavailable": self.unavailable,

@@ -65,10 +65,18 @@ from .stats import TenantStats
 
 __all__ = ["Tenant", "TenantRegistry", "TenantBreaker",
            "TenantUnavailableError", "WeightedFairQueue", "parse_tenants",
-           "PRIORITY_CLASSES", "DEFAULT_TENANT"]
+           "PRIORITY_CLASSES", "DEFAULT_TENANT", "SHARED_TENANT"]
 
 #: The tenant untagged ``submit()`` calls ride.
 DEFAULT_TENANT = "default"
+
+#: Reserved pseudo-tenant: prefix-cache pages shared by more than one
+#: sequence (refcount > 1) are charged here, to NO real tenant's page
+#: budget — a sharer pays only for its exclusive tail and CoW copies, so
+#: shared system prompts are never double-charged. The id cannot be
+#: registered or submitted against; it appears as a synthetic row in
+#: ``stats()["tenants"]`` reporting the engine-wide shared-page count.
+SHARED_TENANT = "shared"
 
 #: Strict-priority admission classes: a lower value is admitted first,
 #: weights apportion the share *within* a class only. ``batch`` traffic
@@ -469,6 +477,11 @@ class TenantRegistry:
         """Create (or return the existing) tenant. Like the telemetry
         get-or-create contract, kwargs only apply on first creation."""
         tenant_id = str(tenant_id)
+        if tenant_id == SHARED_TENANT:
+            raise MXNetError(
+                "tenant id %r is reserved for the prefix-cache shared-"
+                "page pseudo-tenant (refcount>1 pages charged to no real "
+                "tenant); pick another id" % SHARED_TENANT)
         with self._lock:
             t = self._tenants.get(tenant_id)
             if t is not None:

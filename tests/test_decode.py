@@ -624,3 +624,399 @@ def test_ring_prefill_path_matches_oracle(tiny):
         out = eng.generate([3, 1, 4, 1, 5], 4)
     np.testing.assert_array_equal(
         out, model.reference_generate(params, [3, 1, 4, 1, 5], 4))
+
+
+# ---------------------------------------------------------------------------
+# prefix caching: refcounted allocator, CoW, index walk (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def _pcache(**kw):
+    kw.setdefault("prefix_cache", True)
+    return _cache(**kw)
+
+
+def test_kvcache_share_never_frees_referenced_page():
+    # donor prefixes 16 tokens (2 full pages), indexed; a sharer maps
+    # them; freeing the donor must NOT return the shared pages to the
+    # free list — the sharer still reads them
+    c = _pcache(num_slots=2)
+    prompt = np.arange(1, 17, dtype=np.int32)
+    c.reserve(0, 16)
+    c.insert_prefix(0, prompt)
+    m = c.match_prefix(prompt)
+    assert m is not None and len(m.full) == 2 and m.partial is None
+    assert m.matched == 16
+    matched, cow_src, cow_dst = c.admit_prefix(1, 24, m)
+    assert matched == 16 and cow_src is None
+    shared = [int(p) for p in c.page_table[0, :2]]
+    assert [int(p) for p in c.page_table[1, :2]] == shared
+    assert c.shared_pages == 2
+    c.free(0)
+    # pages live on for the sharer: not free, not cached
+    assert all(p not in c._free and p not in c._cached for p in shared)
+    c.free(1)
+    # last ref dropped, still indexed -> parked in the cached-LRU
+    assert all(p in c._cached for p in shared)
+    assert c.pages_in_use == 0 and c.shared_pages == 0
+
+
+def test_kvcache_cow_at_divergent_partial_page():
+    # donor prompt 12 tokens (1 full + partial fill 4); a prompt
+    # diverging INSIDE the partial page shares up to the divergence and
+    # gets a fresh CoW page mapped in the partial's position
+    c = _pcache(num_slots=2)
+    donor = np.asarray([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12], np.int32)
+    c.reserve(0, 12)
+    c.insert_prefix(0, donor)
+    probe = np.asarray([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 99, 98], np.int32)
+    m = c.match_prefix(probe)
+    assert m is not None and len(m.full) == 1
+    assert m.partial is not None and m.partial_len == 2  # [9, 10] match
+    assert m.matched == 10
+    matched, cow_src, cow_dst = c.admit_prefix(1, 20, m)
+    assert matched == 10
+    assert cow_src == int(c.page_table[0, 1])   # the donor's partial page
+    assert cow_dst == int(c.page_table[1, 1])   # the sharer's private copy
+    assert cow_dst != cow_src
+    assert c.exclusive_pages(1) == 2  # CoW page + 1 tail page (20 tokens)
+    # the full page is shared read-only, the partial was copied
+    assert int(c.page_table[1, 0]) == int(c.page_table[0, 0])
+    assert c.shared_pages == 1
+
+
+def test_kvcache_match_verifies_tokens_not_just_hashes():
+    c = _pcache(num_slots=2)
+    donor = np.arange(1, 13, dtype=np.int32)
+    c.reserve(0, 12)
+    c.insert_prefix(0, donor)
+    # diverges at token 0: nothing shareable
+    assert c.match_prefix(np.asarray([9, 9, 9], np.int32)) is None
+    # diverges inside the FIRST full page: partial CoW candidate only
+    probe = np.arange(1, 13, dtype=np.int32)
+    probe[5] = 77
+    m = c.match_prefix(probe)
+    assert m is not None and len(m.full) == 0
+    assert m.partial is not None and m.partial_len == 5
+
+
+def test_kvcache_reclaims_cached_pages_under_pressure():
+    # pool of 4 allocatable pages, all parked in the index (ref 0): a
+    # fresh reservation must reclaim them oldest-first instead of
+    # raising OutOfPagesError
+    c = _pcache(num_slots=2, max_seq_len=32, num_pages=5)
+    c.reserve(0, 32)  # all 4 pages
+    c.insert_prefix(0, np.arange(1, 25, dtype=np.int32))  # 3 indexed
+    c.free(0)
+    assert c.pages_cached == 3 and c.pages_free == 1
+    assert c.pages_available == 4
+    c.reserve(1, 32)  # needs 4: 1 free + 3 reclaimed
+    assert c._owned[1] == 4
+    assert c.pages_cached == 0
+    # index entries for the reclaimed pages are gone: no stale hits
+    assert c.match_prefix(np.arange(1, 25, dtype=np.int32)) is None
+
+
+def test_kvcache_churn_no_growth_with_sharing():
+    # the 200-cycle regression with the index ON and shared prefixes:
+    # pages recycle through free-list <-> cached-LRU <-> slots, the pool
+    # never grows and reservations never fail
+    c = _pcache(num_slots=2, max_seq_len=32, page_size=8)
+    cap = c.num_pages
+    rng = np.random.RandomState(0)
+    base = rng.randint(1, 100, 24).astype(np.int32)
+    for i in range(200):
+        slot = i % 2
+        c.free(slot)
+        n = int(rng.randint(1, 25))
+        prompt = base[:n].copy()
+        if rng.rand() < 0.3:
+            prompt[rng.randint(0, prompt.size)] = 101 + i % 7  # divergent
+        m = c.match_prefix(prompt)
+        try:
+            c.admit_prefix(slot, min(32, n + 8), m)
+        except OutOfPagesError:
+            # legitimate deferral under pressure (pinned matched pages
+            # can't double as fresh tail pages): the engine would wait
+            # for a completion — emulate it, then admission MUST succeed
+            c.free(1 - slot)
+            m = c.match_prefix(prompt)
+            c.admit_prefix(slot, min(32, n + 8), m)
+        c.seq_lens[slot] = n
+        c.insert_prefix(slot, prompt)
+    assert c.num_pages == cap
+    c.free(0)
+    c.free(1)
+    assert c.pages_in_use == 0
+    assert c.pages_free + c.pages_cached == cap - 1
+
+
+def test_kvcache_clear_index_returns_cached_pages():
+    c = _pcache()
+    c.reserve(0, 16)
+    c.insert_prefix(0, np.arange(1, 17, dtype=np.int32))
+    c.free(0)
+    assert c.pages_cached == 2
+    c.clear_prefix_index()
+    assert c.pages_cached == 0
+    assert c.pages_free == c.num_pages - 1
+    assert c.match_prefix(np.arange(1, 17, dtype=np.int32)) is None
+
+
+def test_kvcache_shared_pages_gauge():
+    from mxnet_tpu.serving import kvcache as kvc
+
+    name = "shared-gauge-test"
+    c = _pcache(num_slots=2, name=name)
+    prompt = np.arange(1, 17, dtype=np.int32)
+    c.reserve(0, 16)
+    c.insert_prefix(0, prompt)
+    c.admit_prefix(1, 16, c.match_prefix(prompt))
+    assert kvc._T_SHARED.value(cache=name) == 2
+    c.free(1)
+    assert kvc._T_SHARED.value(cache=name) == 0
+    assert kvc._T_PREFIX_HITS.value(cache=name) == 1
+
+
+# ---------------------------------------------------------------------------
+# DecodeEngine: prefix caching + chunked prefill vs the no-cache oracle
+# ---------------------------------------------------------------------------
+
+def test_engine_prefix_cache_exact_and_compiles_nothing(tiny):
+    # the shared-prefix oracle-exactness acceptance + the warmup
+    # regression: after warmup, a COLD first shared-prefix request (and
+    # every hit after it — tail chunks, CoW copies included) compiles
+    # nothing
+    model, params = tiny
+    sysp = [5, 9, 2, 7, 1, 3, 8, 4, 6, 2, 11, 13]  # 12 tokens, ps 8
+    reqs = [(np.asarray(sysp + [20 + i], np.int32), 5) for i in range(6)]
+    with _engine(tiny, num_slots=2, page_size=8, prefix_cache=True) as eng:
+        warm = eng.warmup()
+        futs = [eng.submit(p, m) for p, m in reqs]
+        outs = [f.result(timeout=120) for f in futs]
+        stats = eng.stats()
+    for (p, m), got in zip(reqs, outs):
+        np.testing.assert_array_equal(
+            got, model.reference_generate(params, p, m))
+    assert stats["kvcache"]["prefix_hits"] >= 4
+    assert stats["prefix_hit_ratio"] > 0
+    assert stats["cow_copies"] >= 1  # prompts diverge inside page 2
+    assert stats["compile_count"] == warm  # cold shared path: 0 compiles
+    assert stats["steady_state_recompiles"] == 0
+    assert stats["kvcache"]["pages_in_use"] == 0
+    assert stats["tenants"]["shared"]["pseudo"] is True
+
+
+def test_engine_full_prompt_hit_recomputes_last_token(tiny):
+    # identical prompt resubmitted: the whole prompt is covered by the
+    # index, only the last position is recomputed (no KV rewritten) and
+    # the output must stay oracle-exact
+    model, params = tiny
+    prompt = np.asarray([3, 1, 4, 1, 5, 9, 2, 6, 5, 3], np.int32)
+    ref = model.reference_generate(params, prompt, 6)
+    with _engine(tiny, page_size=8, prefix_cache=True) as eng:
+        eng.warmup()
+        np.testing.assert_array_equal(eng.generate(prompt, 6), ref)
+        np.testing.assert_array_equal(eng.generate(prompt, 6), ref)
+        stats = eng.stats()
+    assert stats["kvcache"]["prefix_hits"] == 1
+    assert stats["kvcache"]["prefix_tokens_matched"] == 10
+    assert stats["steady_state_recompiles"] == 0
+
+
+def test_engine_chunked_prefill_exact(tiny):
+    # chunked prefill alone (cache off): every prompt runs through the
+    # one chunk rung, outputs oracle-exact, chunk count = sum of
+    # ceil(p / C), zero steady-state recompiles
+    model, params = tiny
+    rng = np.random.RandomState(3)
+    reqs = [(rng.randint(1, 32, int(rng.randint(1, 14))).astype(np.int32),
+             int(rng.randint(1, 7))) for _ in range(7)]
+    with _engine(tiny, num_slots=2, prefix_cache=False,
+                 prefill_chunk=4) as eng:
+        warm = eng.warmup()
+        futs = [eng.submit(p, m) for p, m in reqs]
+        outs = [f.result(timeout=120) for f in futs]
+        stats = eng.stats()
+    for (p, m), got in zip(reqs, outs):
+        np.testing.assert_array_equal(
+            got, model.reference_generate(params, p, m))
+    want_chunks = sum(-(-p.size // 4) for p, _m in reqs)
+    assert stats["prefill_chunks"] == want_chunks
+    assert stats["compile_count"] == warm
+    assert stats["steady_state_recompiles"] == 0
+    assert stats["kvcache"]["pages_in_use"] == 0
+
+
+def test_engine_chunked_plus_cache_exact(tiny):
+    # both optimisations composed: shared prefixes + chunk interleaving
+    model, params = tiny
+    sysp = [7, 3, 7, 3, 1, 1, 2, 2, 9]
+    reqs = [(np.asarray(sysp + [15 + i, 14 - i], np.int32), 5)
+            for i in range(5)]
+    with _engine(tiny, num_slots=2, page_size=8, prefix_cache=True,
+                 prefill_chunk=4) as eng:
+        warm = eng.warmup()
+        futs = [eng.submit(p, m) for p, m in reqs]
+        outs = [f.result(timeout=120) for f in futs]
+        stats = eng.stats()
+    for (p, m), got in zip(reqs, outs):
+        np.testing.assert_array_equal(
+            got, model.reference_generate(params, p, m))
+    assert stats["kvcache"]["prefix_hits"] >= 3
+    assert stats["prefill_chunks"] > 0
+    assert stats["compile_count"] == warm
+    assert stats["steady_state_recompiles"] == 0
+
+
+def test_engine_chunked_short_prompt_not_blocked_by_long(tiny):
+    # the TTFT-decoupling property, functionally: a short request
+    # submitted alongside a LONG prompt (many chunks) completes while
+    # the long one is still prefilling — chunks yield the tick
+    with _engine(tiny, num_slots=2, max_seq_len=48, prefix_cache=False,
+                 prefill_chunk=4) as eng:
+        eng.warmup()
+        order = []
+        f_long = eng.submit(np.arange(1, 33, dtype=np.int32), 4)  # 8 chunks
+        f_short = eng.submit([2, 4], 2)                           # 1 chunk
+        f_long.add_done_callback(lambda _f: order.append("long"))
+        f_short.add_done_callback(lambda _f: order.append("short"))
+        f_long.result(timeout=120)
+        f_short.result(timeout=120)
+        stats = eng.stats()
+    assert order[0] == "short"
+    assert stats["prefill_chunks"] == 9
+    assert stats["steady_state_recompiles"] == 0
+
+
+def test_engine_cow_shared_eviction_leaves_sharers_intact(tiny):
+    # chaos: the sharer's tail prefill faults AFTER its pages were
+    # mapped/CoW'd — exactly its future fails and its mappings release,
+    # while the donor (mid-decode on the shared pages) finishes
+    # oracle-exact. at=2 targets the second prefill-site call: the
+    # donor's monolithic prefill is call 1, the sharer's tail chunk is
+    # call 2.
+    model, params = tiny
+    prompt = np.asarray([6, 2, 6, 2, 1, 5, 1, 5, 3, 9], np.int32)
+    with _engine(tiny, num_slots=2, page_size=8, prefix_cache=True,
+                 retry_policy=RetryPolicy(max_attempts=1)) as eng:
+        eng.warmup()
+        with chaos.active("seed=1,site=serving.decode.prefill,at=2"):
+            donor = eng.submit(prompt, 16)
+            time.sleep(0.05)  # let the donor prefill + start decoding
+            doomed = eng.submit(prompt, 16)
+            with pytest.raises(chaos.FaultInjected):
+                doomed.result(timeout=120)
+            out = donor.result(timeout=120)
+        stats = eng.stats()
+    np.testing.assert_array_equal(
+        out, model.reference_generate(params, prompt, 16))
+    assert stats["errors"] == 1
+    assert stats["evictions"] == 0  # request-level failure, no eviction
+    assert stats["kvcache"]["pages_in_use"] == 0
+    # the engine still answers shared-prefix traffic afterwards
+    with _engine(tiny, page_size=8, prefix_cache=True) as eng2:
+        eng2.warmup()
+        np.testing.assert_array_equal(
+            eng2.generate(prompt, 4),
+            model.reference_generate(params, prompt, 4))
+
+
+def test_engine_weight_swap_flushes_prefix_index(tiny):
+    # cached KV was computed under the old weights: after swap_params
+    # the same prompt must match NOTHING and the output must equal the
+    # new-params oracle (a stale hit would poison it)
+    model, params = tiny
+    params_b = model.init_params(1)
+    prompt = np.asarray([8, 6, 7, 5, 3, 0 + 1, 9, 4, 2, 12], np.int32)
+    with _engine(tiny, page_size=8, prefix_cache=True) as eng:
+        eng.warmup()
+        np.testing.assert_array_equal(
+            eng.generate(prompt, 5),
+            model.reference_generate(params, prompt, 5))
+        eng.swap_params(params_b, timeout=120)
+        np.testing.assert_array_equal(
+            eng.generate(prompt, 5),
+            model.reference_generate(params_b, prompt, 5))
+        stats = eng.stats()
+    assert stats["kvcache"]["prefix_hits"] == 0  # flush: no stale hit
+    assert stats["steady_state_recompiles"] == 0
+
+
+def test_engine_eviction_clears_prefix_index(tiny):
+    # a tick-level eviction re-zeroes the pools: stale index entries
+    # pointing at zeroed pages must die with them, and later shared
+    # traffic stays oracle-exact
+    model, params = tiny
+    prompt = np.asarray([4, 4, 2, 2, 8, 8, 1, 1, 6, 6], np.int32)
+    with _engine(tiny, num_slots=1, page_size=8, prefix_cache=True,
+                 retry_policy=RetryPolicy(max_attempts=1)) as eng:
+        eng.warmup()
+        with chaos.active("seed=1,site=serving.decode,at=2"):
+            f1 = eng.submit(prompt, 6)
+            with pytest.raises(chaos.FaultInjected):
+                f1.result(timeout=120)
+        # the future fails before the worker's reset_pools finishes:
+        # poll for the flush instead of racing it
+        deadline = time.time() + 10
+        while eng.stats()["kvcache"]["pages_cached"] and \
+                time.time() < deadline:
+            time.sleep(0.01)
+        assert eng.stats()["kvcache"]["pages_cached"] == 0  # index flushed
+        np.testing.assert_array_equal(
+            eng.generate(prompt, 6),
+            model.reference_generate(params, prompt, 6))
+
+
+def test_prefix_and_chunk_metrics_render_prometheus(tiny):
+    name = "prefix-prom-test"
+    prompt = np.asarray([1, 2, 3, 4, 5, 6, 7, 8, 9, 10], np.int32)
+    with _engine(tiny, name=name, page_size=8, prefix_cache=True,
+                 prefill_chunk=4) as eng:
+        eng.warmup()
+        eng.generate(prompt, 3)
+        eng.generate(prompt, 3)
+    text = telemetry.render_prometheus()
+    assert 'mxnet_kvcache_prefix_hits_total{cache="%s"}' % name in text
+    assert 'mxnet_kvcache_prefix_misses_total{cache="%s"}' % name in text
+    assert 'mxnet_kvcache_shared_pages' in text
+    assert 'mxnet_decode_prefill_chunks_total{server="%s"}' % name in text
+
+
+def test_kvcache_admit_prefix_rejects_before_mutating():
+    # review regression: a total past max_seq_len must raise BEFORE any
+    # mapping — no half-admitted slot with live refcounts
+    c = _pcache(num_slots=2, max_seq_len=32)
+    donor = np.arange(1, 17, dtype=np.int32)
+    c.reserve(0, 16)
+    c.insert_prefix(0, donor)
+    m = c.match_prefix(donor)
+    before = c._ref.copy()
+    with pytest.raises(MXNetError, match="max_seq_len"):
+        c.admit_prefix(1, 40, m)
+    assert c._owned[1] == 0 and c.exclusive_pages(1) == 0
+    np.testing.assert_array_equal(c._ref, before)
+    assert c.prefix_hits == 0  # nothing was admitted
+
+
+def test_engine_swap_mid_chunked_prefill_never_reindexes_stale_kv(tiny):
+    # review regression: a weight swap landing BETWEEN chunks of an
+    # in-flight prefill flushes the index; the straddling sequence's
+    # pages hold old-weight KV and must NOT be re-indexed at completion
+    # — later identical prompts must match the NEW-params oracle
+    model, params = tiny
+    params_b = model.init_params(1)
+    prompt = np.arange(1, 33, dtype=np.int32)  # 16 chunks of 2
+    with _engine(tiny, num_slots=1, max_seq_len=48, page_size=8,
+                 prefix_cache=True, prefill_chunk=2) as eng:
+        eng.warmup()
+        f = eng.submit(prompt, 2)
+        time.sleep(0.01)  # let some chunks land under the old weights
+        eng.swap_params(params_b, timeout=120)
+        f.result(timeout=120)  # mixed-weight output: the documented
+        #                        in-flight rollout semantic — not checked
+        # the invariant: whatever the race, the next identical prompt is
+        # exact under the NEW weights (a stale re-index would poison it)
+        np.testing.assert_array_equal(
+            eng.generate(prompt, 5),
+            model.reference_generate(params_b, prompt, 5))
+        assert eng.stats()["steady_state_recompiles"] == 0

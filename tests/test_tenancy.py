@@ -642,3 +642,85 @@ def test_tenant_metric_families_render(tiny):
         assert '%s{server="%s",tenant="alpha"' % (fam, name) in text \
             or '%s_count{server="%s",tenant="alpha"' % (fam, name) in text \
             or fam in text
+
+
+# ---------------------------------------------------------------------------
+# prefix caching x tenancy: shared pages charge no tenant twice (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def test_shared_tenant_id_is_reserved():
+    reg = TenantRegistry(server=_uname("shr"))
+    with pytest.raises(MXNetError, match="reserved"):
+        reg.register("shared")
+    with pytest.raises(MXNetError, match="reserved"):
+        parse_tenants("shared,weight=2") and reg.register(
+            **parse_tenants("shared,weight=2")[0])
+
+
+def test_shared_pages_not_double_charged_two_tenant_soak(tiny):
+    # the budget-invariant soak: A and B share one 16-token system
+    # prompt; each budget (3 pages of 8) covers exactly ONE cold
+    # worst-case request (2 prompt pages + 1 generation page). Only
+    # tail-only charging lets BOTH run concurrently: a sharer pays 1
+    # page, not 3 — double-charging would defer every concurrent pair.
+    model, params = tiny
+    sysp = list(np.random.RandomState(11).randint(1, 30, 16))
+    with _engine(tiny, num_slots=2, max_seq_len=32, page_size=8,
+                 prefix_cache=True, tenants="A,pages=3;B,pages=3") as eng:
+        eng.warmup()
+        # cold lap: A prefills the shared prompt once (charged 3)
+        p0 = np.asarray(sysp, np.int32)
+        np.testing.assert_array_equal(
+            eng.generate(p0, 8, tenant="A"),
+            model.reference_generate(params, p0, 8))
+        # warm soak: both tenants ride the shared prefix concurrently
+        futs = []
+        for i in range(6):
+            futs.append((p0, eng.submit(p0, 8, tenant="A" if i % 2 else "B")))
+        for p, f in futs:
+            np.testing.assert_array_equal(
+                f.result(timeout=120),
+                model.reference_generate(params, p, 8))
+        # poll a moment where both sharers were live at once
+        stats = eng.stats()
+    a, b = stats["tenants"]["A"], stats["tenants"]["B"]
+    assert a["completed"] + b["completed"] == 7
+    # the invariant: per-tenant high-water marks under tail-only charge
+    assert a["pages_in_use_max"] <= 3
+    assert b["pages_in_use_max"] <= 3
+    # both warm sequences fit at once ONLY because shared pages charge
+    # the pseudo-tenant: no deferral needed in the warm soak
+    assert a["deferred_pages"] == 0 and b["deferred_pages"] == 0
+    assert stats["tenants"]["shared"]["pseudo"] is True
+    assert stats["kvcache"]["prefix_hits"] >= 6
+    assert stats["kvcache"]["pages_in_use"] == 0
+    assert stats["steady_state_recompiles"] == 0
+
+
+def test_shared_pseudo_tenant_counts_refcounted_pages(tiny):
+    # while two sequences share prefix pages, the `shared` pseudo row
+    # reports refcount>1 pages; once everyone frees, it reads 0
+    model, params = tiny
+    sysp = np.asarray(list(range(1, 17)), np.int32)
+    with _engine(tiny, num_slots=2, max_seq_len=64, page_size=8,
+                 prefix_cache=True) as eng:
+        eng.warmup()
+        eng.generate(sysp, 2, tenant="A")  # seed the index
+        fa = eng.submit(sysp, 30, tenant="A")
+        fb = eng.submit(sysp, 30, tenant="B")
+        seen_shared = 0
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            snap = eng.stats()
+            seen_shared = max(
+                seen_shared,
+                snap["tenants"]["shared"]["pages_in_use_now"])
+            if fa.done() and fb.done():
+                break
+            time.sleep(0.005)
+        fa.result(timeout=120)
+        fb.result(timeout=120)
+        stats = eng.stats()
+    assert seen_shared >= 2  # both mapped the 2 full prompt pages
+    assert stats["tenants"]["shared"]["pages_in_use_now"] == 0
+    assert stats["kvcache"]["pages_in_use"] == 0
