@@ -154,6 +154,23 @@ def _attach_telemetry(out):
             out["chaos"] = resilience.snapshot()
     except Exception:  # noqa: BLE001 - emit must survive a broken import
         pass
+    try:
+        from mxnet_tpu.telemetry import flightrec, slo
+
+        # live SLO verdicts on EVERY line: the alert summary a scraper
+        # would have paged on, evaluated in-process
+        out["slo_alerts"] = [
+            {"alert": a["alert"], "instance": a["instance"],
+             "level": a["level"], "burn": a["burn"]}
+            for a in slo.evaluate()]
+        if out.get("error"):
+            # an error/watchdog line is a death: commit the black box
+            # and point the line at it, so the post-mortem starts from
+            # the dump instead of from nothing (the r05 lesson)
+            out["flightrec_path"] = flightrec.dump(
+                "bench error path: %s" % out["error"])
+    except Exception:  # noqa: BLE001 - emit must survive a broken import
+        pass
     return out
 
 
@@ -181,6 +198,16 @@ def _acquire_backend(timeout_s=120.0, retries=2):
     line so the driver can tell infra failure from code failure."""
     result = {}
 
+    def note(step, **fields):
+        # backend-init is exactly where r03-r05 died with nothing to
+        # read: every step leaves a flight-recorder breadcrumb
+        try:
+            from mxnet_tpu.telemetry import flightrec
+
+            flightrec.record("bench.backend_init", step=step, **fields)
+        except Exception:  # noqa: BLE001 - breadcrumbs must not break init
+            pass
+
     def probe():
         try:
             import jax
@@ -190,12 +217,19 @@ def _acquire_backend(timeout_s=120.0, retries=2):
 
     start = time.perf_counter()
     err = None
-    for _ in range(retries):
+    for attempt in range(retries):
+        note("probe_start", attempt=attempt, timeout_s=timeout_s)
         t = threading.Thread(target=probe, daemon=True)
         t.start()
         t.join(timeout_s)
         if "devices" in result:
+            note("probe_ok", attempt=attempt,
+                 devices=len(result["devices"]),
+                 elapsed_s=round(time.perf_counter() - start, 3))
             return result["devices"]
+        note("probe_failed", attempt=attempt,
+             error=result.get("error") or "hung",
+             elapsed_s=round(time.perf_counter() - start, 3))
         err = result.pop("error", None)
         if err is None:
             # the probe HUNG (vs raised): it still holds jax's global backend
@@ -419,6 +453,7 @@ def _serving_bench():
 
     threading.Thread(target=watchdog, daemon=True).start()
     devices = _acquire_backend()
+    _install_blackbox()
     import numpy as np
 
     from mxnet_tpu import gluon, nd, serving
@@ -573,6 +608,7 @@ def _decode_bench():
 
     threading.Thread(target=watchdog, daemon=True).start()
     devices = _acquire_backend()
+    _install_blackbox()
     import numpy as np
 
     from mxnet_tpu import serving
@@ -708,6 +744,29 @@ def _decode_bench():
             "pages_cached_end": st["kvcache"].get("pages_cached", 0),
             "steady_state_recompiles": st.get("steady_state_recompiles"),
         }
+    # trace-overhead delta (ISSUE 15): the SAME continuous soak run at
+    # MXNET_TRACE_SAMPLE=0 then traced at 1.0 — per-request tracing must
+    # cost <= 5% tokens/s or it cannot stay on in production
+    from mxnet_tpu.telemetry import slo as slo_engine
+    from mxnet_tpu.telemetry import tracing
+
+    part["phase"] = "trace-overhead-sample0"
+    tracing.set_sample(0.0)
+    t_off_rate, _t_off_stats, t_off_err = run("bench-trace-off",
+                                              wave_mode=False)
+    part["phase"] = "trace-overhead-sample1"
+    tracing.set_sample(1.0)
+    t_on_rate, t_on_stats, t_on_err = run("bench-trace-on",
+                                          wave_mode=False)
+    tracing.set_sample(None)
+    trace_overhead = (max(0.0, 1.0 - t_on_rate / t_off_rate)
+                      if t_off_rate else None)
+    part["trace_overhead"] = (round(trace_overhead, 4)
+                              if trace_overhead is not None else None)
+    # the SLO engine evaluated throughout (every stats() call); its
+    # fired alerts must agree with the raw counters it read from
+    slo_contradictions = slo_engine.audit()
+
     part["prefix_hit_ratio"] = sp["cache_on"]["prefix_hit_ratio"]
     sp["ttft_p99_improvement"] = (
         round(1.0 - sp["cache_on"]["ttft_p99_ms"]
@@ -740,7 +799,8 @@ def _decode_bench():
     sp_recompiles = sum(sp[k]["steady_state_recompiles"] or 0
                         for k in ("cache_off", "cache_on",
                                   "cache_on_chunked"))
-    errors = cont_err + base_err + sp_errors
+    trace_recompiles = t_on_stats.get("steady_state_recompiles")
+    errors = cont_err + base_err + sp_errors + t_off_err + t_on_err
     gate_err = None
     if recompiles:
         gate_err = ("continuous decode recompiled %d time(s) in steady "
@@ -761,12 +821,27 @@ def _decode_bench():
                     "%.3fms caching-off at the same slot count)"
                     % (sp["cache_on"]["ttft_p99_ms"],
                        sp["cache_off"]["ttft_p99_ms"]))
+    elif trace_recompiles:
+        gate_err = ("tracing at sample=1.0 recompiled %d time(s) in "
+                    "steady state (gate: 0 — instrumentation must not "
+                    "touch shapes)" % trace_recompiles)
+    elif trace_overhead is not None and trace_overhead > 0.05:
+        gate_err = ("tracing at sample=1.0 cost %.1f%% tokens/s vs the "
+                    "sampling-0 soak (gate: <= 5%%)"
+                    % (trace_overhead * 100.0))
+    elif slo_contradictions:
+        gate_err = ("SLO engine contradicts its raw series: "
+                    + "; ".join(slo_contradictions[:3]))
     elif errors:
         gate_err = "; ".join(errors[:3])
     extra = {
         "requests": n_req, "slots": slots,
         "shared_prefix": sp,
         "shared_prefix_requests": n_sp,
+        "trace_overhead": part["trace_overhead"],
+        "traced_tokens_s": round(t_on_rate, 2),
+        "untraced_tokens_s": round(t_off_rate, 2),
+        "slo_contradictions": slo_contradictions,
         "baseline_slot_occupancy": round(base_stats["slot_occupancy"], 4),
         "baseline_steady_state_recompiles": base_recompiles,
         "speedup_vs_restart_per_batch": (round(cont_rate / base_rate, 4)
@@ -827,6 +902,7 @@ def _tenant_bench():
 
     threading.Thread(target=watchdog, daemon=True).start()
     devices = _acquire_backend()
+    _install_blackbox()
     import numpy as np
 
     from mxnet_tpu import serving
@@ -1018,6 +1094,7 @@ def _zero_bench():
             flags + " --xla_force_host_platform_device_count=2").strip()
 
     devices = _acquire_backend()
+    _install_blackbox()
     import numpy as np
 
     import mxnet_tpu as mx  # noqa: F401 - registers backends
@@ -1159,6 +1236,7 @@ def _elastic_bench():
             flags + " --xla_force_host_platform_device_count=2").strip()
 
     devices = _acquire_backend()
+    _install_blackbox()
     import tempfile
 
     import numpy as np
@@ -1339,6 +1417,21 @@ def _elastic_bench():
     return 6 if err else 0
 
 
+def _install_blackbox():
+    """Best-effort SIGTERM black-box for every bench mode: a bench
+    killed by the driver/scheduler leaves its flight-recorder dump even
+    when no error line made it out. Called AFTER _acquire_backend(), on
+    the main thread: importing mxnet_tpu eagerly imports jax, and doing
+    that before the hang-guarded probe would re-open exactly the
+    unguarded-backend-init death the probe exists to bound."""
+    try:
+        from mxnet_tpu.telemetry import flightrec
+
+        flightrec.install_signal_dump()
+    except Exception:  # noqa: BLE001 - the bench must run regardless
+        pass
+
+
 def main():
     if ELASTIC:
         return _elastic_bench()
@@ -1367,6 +1460,7 @@ def main():
     threading.Thread(target=watchdog, daemon=True).start()
 
     devices = _acquire_backend()
+    _install_blackbox()
     try:
 
         import jax

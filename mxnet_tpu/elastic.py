@@ -62,6 +62,7 @@ import numpy as np
 from . import resilience, telemetry
 from .base import MXNetError, fetch_host, get_env
 from .resilience import chaos
+from .telemetry import flightrec as _flightrec
 
 __all__ = ["CheckpointManager", "run_elastic", "start_heartbeat",
            "stop_heartbeat", "get_dead_nodes",
@@ -249,6 +250,10 @@ def start_preemption_watcher(poll_interval: float = 1.0) -> bool:
 
             def handler(signum, frame):
                 _PREEMPT.set()
+                # black box first: if the grace period is short, the dump
+                # must not depend on reaching the next step boundary
+                _flightrec.record("preemption.sigterm")
+                _flightrec.dump("SIGTERM (preemption notice)")
                 _LOG.warning("SIGTERM received: preemption checkpoint will "
                              "run at the next step boundary")
                 if callable(prev):
@@ -304,6 +309,7 @@ def step_boundary(manager: Optional["CheckpointManager"] = None,
     if not preempt_requested():
         return
     telemetry.PREEMPTIONS.inc()
+    _flightrec.record("preemption.honored")
     if save_fn is not None:
         try:
             save_fn()
@@ -383,6 +389,8 @@ def commit_bytes(path: str, data: bytes, kind: str) -> None:
         "ckpt.commit",
         lambda: CheckpointManager._atomic_write(
             path, lambda p: _write_bytes(p, data)))
+    _flightrec.record("ckpt.commit", file=os.path.basename(path),
+                      artifact=kind, bytes=len(data))
     note_progress()
 
 
@@ -533,6 +541,7 @@ class CheckpointManager(object):
         commit is step progress for the stall watchdog."""
         resilience.call("ckpt.commit",
                         lambda: self._atomic_write(path, writer))
+        _flightrec.record("ckpt.commit", file=os.path.basename(path))
         note_progress()
 
     def _commit_bytes(self, path: str, data: bytes, kind: str) -> None:
@@ -1117,6 +1126,13 @@ def _invoke_attempt(train_fn, start_epoch: int, manager: CheckpointManager,
     while not done.wait(poll):
         if time.monotonic() - _last_progress() > stall_timeout:
             cancelled.set()
+            # the hang class of death: dump the black box BEFORE the
+            # restart machinery tears state down, so "what was the run
+            # doing when it wedged" survives even if the restart also dies
+            _flightrec.record("elastic.stall",
+                              stall_timeout_s=stall_timeout)
+            _flightrec.dump("elastic stall watchdog (no progress in "
+                            "%.1fs)" % stall_timeout)
             raise StallError(
                 "no step progress in %.1fs (MXNET_ELASTIC_STALL_SECS); "
                 "treating the attempt as hung" % stall_timeout)
@@ -1217,6 +1233,8 @@ def run_elastic(train_fn: Callable[[int, CheckpointManager], object],
                 attempt += 1
             reason = "stall" if isinstance(exc, StallError) else "exception"
             telemetry.ELASTIC_RESTARTS.inc(reason=reason)
+            _flightrec.record("elastic.restart", reason=reason,
+                              attempt=attempt, error=repr(exc))
             goodput()
             if attempt > max_restarts:
                 restarts.inc(site="elastic.restart", outcome="exhausted")

@@ -97,6 +97,9 @@ def _max_devices() -> int:
 
 def note_fallback(reason: str) -> None:
     FALLBACKS.inc(reason=reason)
+    from ..telemetry import flightrec
+
+    flightrec.record("zero.fallback", reason=reason)
 
 
 #: per-class memo of whether _host_scalars emits kernel extras — probed

@@ -110,6 +110,11 @@ class CircuitBreaker:
         gauge, transitions = _metrics()
         gauge.set(STATE_VALUE[to], site=self.site)
         transitions.inc(site=self.site, to=to)
+        # black box: breaker trips are the canonical "what changed right
+        # before the death" event (telemetry resolved by _metrics above)
+        from ..telemetry import flightrec
+
+        flightrec.record("breaker", site=self.site, to=to)
 
     # ------------------------------------------------------------------
     def allow(self) -> bool:

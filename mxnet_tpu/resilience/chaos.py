@@ -249,6 +249,12 @@ class _ChaosState:
                     rule.injected += 1
                     self._injected[site] = self._injected.get(site, 0) + 1
                     _faults_counter().inc(site=site)
+                    # black box: the injected fault is very often THE
+                    # event that precedes a death — the dump must name it
+                    from ..telemetry import flightrec
+
+                    flightrec.record("chaos.fault", site=site,
+                                     action=rule.action, call=n)
                     exc_cls = _ACTIONS.get(rule.action)
                     if exc_cls is not None:
                         raise exc_cls(site, n)

@@ -59,6 +59,9 @@ import numpy as np
 from .. import resilience, telemetry
 from ..base import MXNetError, get_env, np_dtype
 from ..resilience import CircuitBreaker, chaos
+from ..telemetry import flightrec as _flightrec
+from ..telemetry import slo as _slo
+from ..telemetry import tracing as _tracing
 from .buckets import bucket_ladder, pad_to_bucket, select_bucket
 from .engine import Engine
 from .stats import ServingStats
@@ -94,14 +97,16 @@ class EngineUnavailableError(ServingError):
 
 
 class _Request:
-    __slots__ = ("data", "future", "t_submit", "deadline", "tenant")
+    __slots__ = ("data", "future", "t_submit", "deadline", "tenant",
+                 "trace")
 
-    def __init__(self, data, deadline, tenant=None):
+    def __init__(self, data, deadline, tenant=None, trace=None):
         self.data = data
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         self.deadline = deadline
         self.tenant = tenant
+        self.trace = trace
 
 
 def _tenancy():
@@ -195,6 +200,8 @@ class Server:
                 server=name, spec=tenants, max_cost=1.0,
                 default_queue_depth=self._queue_depth)
         self._wfq = ten.WeightedFairQueue(self._tenants)
+        # burn-ratio denominator for the SLO engine's QueueDepthBurn
+        _slo.note_bound("queue_depth", name, self._queue_depth)
         self._warm_compiles: Optional[int] = None
         self._cv = threading.Condition()
         self._closed = False
@@ -223,17 +230,22 @@ class Server:
                 "serving request shape %s != sample_shape %s"
                 % (arr.shape, self._sample_shape))
         tobj = self._tenants.resolve(tenant)
+        # trace minted at submit() (MXNET_TRACE_SAMPLE-gated) — the
+        # batch plane's hops: enqueue, batch, complete/timeout/shed
+        trace = _tracing.start_trace("batch", self._name, tobj.tenant_id)
+        _tracing.event(trace, "submit")
         state = tobj.breaker.state
         if state == "open":
             # per-tenant shed: this tenant's poisoned/failing traffic is
             # refused at the door while every other tenant keeps serving
             tobj.stats.on_shed(breaker=True)
+            _tracing.finish(trace, "shed", reason="tenant_breaker")
             raise _tenancy().TenantUnavailableError(tobj.tenant_id, state)
         timeout_s = (self._timeout_s if timeout_ms is None
                      else float(timeout_ms) / 1e3)
         deadline = (None if timeout_s <= 0
                     else time.perf_counter() + timeout_s)
-        req = _Request(arr, deadline, tobj)
+        req = _Request(arr, deadline, tobj, trace)
         shed = None
         depth = 0
         with self._cv:
@@ -253,7 +265,10 @@ class Server:
         if shed:
             self._stats.on_shed()
             tobj.stats.on_shed()
+            _tracing.finish(trace, "shed", reason="queue_full")
             raise QueueFullError(shed)
+        _tracing.event(trace, "enqueue", tenant_depth=depth,
+                       queue_depth=gdepth)
         self._stats.on_submit(gdepth)
         tobj.stats.on_submit(depth)
         return req.future
@@ -307,6 +322,7 @@ class Server:
         out["breakers"] = {slot.name: slot.breaker.state
                            for slot in self._slots}
         out["tenants"] = self._tenants.snapshot()
+        out["alerts"] = _slo.evaluate()
         if self._warm_compiles is not None and count >= 0:
             steady = count - self._warm_compiles
             out["steady_state_recompiles"] = steady
@@ -358,8 +374,12 @@ class Server:
         The non-consuming state check runs first; ``allow()`` (which may
         consume the half-open probe) only when the pop will happen."""
         if tenant.breaker.state == "open":
+            _tracing.event(req.trace, "defer", reason="breaker")
             return False
-        return tenant.breaker.allow()
+        if not tenant.breaker.allow():
+            _tracing.event(req.trace, "defer", reason="breaker")
+            return False
+        return True
 
     def _shed_tenant_breakers(self):
         """Queued work of tenants whose breaker is OPEN is answered now
@@ -372,6 +392,7 @@ class Server:
         exc_cls = _tenancy().TenantUnavailableError
         for tenant, req in dropped:
             tenant.stats.on_shed(breaker=True)
+            _tracing.finish(req.trace, "shed", reason="tenant_breaker")
             self._fail(req, exc_cls(tenant.tenant_id, "open"))
 
     def _worker(self):
@@ -414,6 +435,7 @@ class Server:
                 self._stats.on_timeout()
                 if req.tenant is not None:
                     req.tenant.stats.on_timeout()
+                _tracing.finish(req.trace, "timeout", where="queued")
                 self._fail(req, RequestTimeoutError(
                     "request spent > its deadline queued"))
             if not batch:
@@ -426,6 +448,11 @@ class Server:
                 bucket = select_bucket(len(batch), self._ladder)
                 padded = pad_to_bucket([r.data for r in batch], bucket,
                                        self._dtype)
+                for req in batch:
+                    _tracing.event(req.trace, "batch", bucket=bucket,
+                                   real_rows=len(batch),
+                                   queue_wait_ms=round(
+                                       (now - req.t_submit) * 1e3, 3))
                 self._stats.on_batch(len(batch), bucket, depth)
                 self._run_batch(batch, padded)
             except Exception as exc:  # noqa: BLE001 - batcher must survive
@@ -464,11 +491,18 @@ class Server:
             except Exception as exc:  # noqa: BLE001 - degrade, don't die
                 slot.breaker.on_failure()
                 self._stats.on_engine_failure(slot.name)
+                _flightrec.record("serving.engine_failure",
+                                  server=self._name, engine=slot.name,
+                                  error=repr(exc))
                 last_exc = exc
                 continue
             slot.breaker.on_success()
             if slot is not self._slots[0]:
+                # a fallback serve is a fleet-health event (degraded
+                # mode), not just a counter — the black box keeps it
                 self._stats.on_fallback(slot.name)
+                _flightrec.record("serving.fallback", server=self._name,
+                                  engine=slot.name)
             return out
         if last_exc is not None:
             raise last_exc
@@ -486,6 +520,8 @@ class Server:
             for req in reqs:
                 if req.tenant is not None:
                     req.tenant.stats.on_shed()
+                _tracing.finish(req.trace, "shed",
+                                reason="engine_unavailable")
                 self._fail(req, exc)
             return
         except Exception as exc:  # noqa: BLE001 - isolation boundary
@@ -522,6 +558,8 @@ class Server:
             if req.future.set_running_or_notify_cancel():
                 req.future.set_result(result)
                 lat = (done - req.t_submit) * 1e3
+                _tracing.finish(req.trace, "complete",
+                                latency_ms=round(lat, 3))
                 self._stats.on_complete(lat)
                 if req.tenant is not None:
                     req.tenant.stats.on_complete(lat)
@@ -529,6 +567,8 @@ class Server:
 
     @staticmethod
     def _fail(req: _Request, exc: BaseException):
+        # generic terminal fallback (specific verdicts finished earlier)
+        _tracing.finish(req.trace, "error", error=type(exc).__name__)
         if req.future.done():  # already resolved (only the batcher resolves)
             return
         if req.future.set_running_or_notify_cancel():

@@ -69,6 +69,15 @@ carrying the KV written so far through the page table — so a long
 prompt stops monopolizing the tick loop and TTFT p99 stops tracking the
 longest prompt in the queue.
 
+Observability (:mod:`~mxnet_tpu.telemetry`): a sampled request
+(``MXNET_TRACE_SAMPLE``) carries a trace minted at :meth:`submit`
+through every hop — enqueue, admission-guard deferral verdicts,
+admission, prefill chunks, prefix hits/CoW, every decode tick, the
+terminal — queryable by ``trace_id``; the flight recorder keeps each
+tick's in-flight request set plus evictions/swaps/faults so a mid-tick
+death leaves a readable black box (the worker catch-all dumps it), and
+``stats()["alerts"]`` carries the live SLO engine's verdicts.
+
 Multi-tenancy (:mod:`~mxnet_tpu.serving.tenancy`): every request
 belongs to a tenant (``submit(..., tenant=)``; untagged = ``default``).
 The single FIFO is replaced by per-tenant bounded sub-queues drained by
@@ -86,6 +95,7 @@ and recompiles nothing (same pytree signature = same jit signature).
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from concurrent.futures import Future
@@ -94,6 +104,9 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import flightrec as _flightrec
+from ..telemetry import slo as _slo
+from ..telemetry import tracing as _tracing
 from ..base import MXNetError, fetch_host, get_env
 from ..resilience import CircuitBreaker, chaos
 from .batcher import (EngineUnavailableError, QueueFullError,
@@ -192,11 +205,18 @@ class PagedDecodeModel:
         raise NotImplementedError
 
 
+#: process-wide request ids for the flight recorder's per-tick in-flight
+#: set — ALWAYS minted (unlike trace ids, which are sampled): the black
+#: box must identify every sequence on the failing tick, not just the
+#: sampled ones. itertools.count.__next__ is GIL-atomic — no lock.
+_RID = itertools.count(1)
+
+
 class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "eos_id", "future", "t_submit",
                  "deadline", "tokens", "last_t", "slot", "tenant",
                  "match", "kv_cached", "filled", "prefilling", "seq",
-                 "epoch")
+                 "epoch", "rid", "trace")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  eos_id: Optional[int], deadline: Optional[float],
@@ -222,6 +242,10 @@ class _DecodeRequest:
         self.prefilling = False
         self.seq = 0
         self.epoch = 0  # weight-swap epoch at prefill start (stale guard)
+        self.rid = next(_RID)
+        # the sampled request trace (None = unsampled: every hop's
+        # tracing.event() is then a single `is None` check)
+        self.trace: Optional[_tracing.Trace] = None
 
 
 class DecodeEngine:
@@ -326,6 +350,9 @@ class DecodeEngine:
         self._wfq = WeightedFairQueue(
             self._tenants,
             cost_fn=lambda r: float(int(r.prompt.size) + r.max_new))
+        # the SLO engine's burn ratios divide by bounds the registry
+        # cannot carry — register this engine's queue capacity
+        _slo.note_bound("queue_depth", name, self._queue_depth)
         self._params_sig = _tree_sig(params)
         self._pending_swaps: List[tuple] = []
         self._variants = {}
@@ -490,18 +517,27 @@ class DecodeEngine:
         total = int(arr.size) + max_new
         need = self._cache.pages_for(total)
         capacity = self._cache.num_pages - 1
+        tobj = self._tenants.resolve(tenant)
+        # the trace is minted HERE — at submit(), the contract — so
+        # EVERY door-reject (pool capacity, budgets, breaker) and shed
+        # leaves a queryable chain too
+        trace = _tracing.start_trace("decode", self._name, tobj.tenant_id)
+        _tracing.event(trace, "submit", prompt_tokens=int(arr.size),
+                       max_new=max_new)
         if need > capacity:
+            _tracing.finish(trace, "rejected", reason="pool_capacity")
             raise MXNetError(
                 "prompt %d + max_new %d needs %d KV pages but the pool "
                 "only has %d: raise MXNET_KVCACHE_PAGES or shrink the "
                 "request" % (arr.size, max_new, need, capacity))
-        tobj = self._tenants.resolve(tenant)
         if tobj.page_budget is not None and need > tobj.page_budget:
+            _tracing.finish(trace, "rejected", reason="page_budget")
             raise MXNetError(
                 "request needs %d KV pages but tenant %r's page budget "
                 "is %d: it could never be admitted"
                 % (need, tobj.tenant_id, tobj.page_budget))
         if tobj.rate > 0.0 and total > tobj.burst:
+            _tracing.finish(trace, "rejected", reason="burst_budget")
             raise MXNetError(
                 "request costs %d tokens but tenant %r's burst budget "
                 "is %.0f: it could never be admitted"
@@ -512,12 +548,14 @@ class DecodeEngine:
             # door while every other tenant keeps flowing
             tobj.stats.on_shed(breaker=True)
             _T_EVENTS.inc(server=self._name, event="shed_tenant_breaker")
+            _tracing.finish(trace, "shed", reason="tenant_breaker")
             raise TenantUnavailableError(tobj.tenant_id, state)
         timeout_s = (self._timeout_s if timeout_ms is None
                      else float(timeout_ms) / 1e3)
         deadline = (None if timeout_s <= 0
                     else time.perf_counter() + timeout_s)
         req = _DecodeRequest(arr, max_new, eos_id, deadline, tobj)
+        req.trace = trace
         shed = None
         depth = 0
         with self._cv:
@@ -539,7 +577,10 @@ class DecodeEngine:
         if shed:
             self._stats.on_shed()
             tobj.stats.on_shed()
+            _tracing.finish(trace, "shed", reason="queue_full")
             raise QueueFullError(shed)
+        _tracing.event(trace, "enqueue", rid=req.rid, tenant_depth=depth,
+                       queue_depth=gdepth)
         self._stats.on_submit(gdepth)
         tobj.stats.on_submit(depth)
         return req.future
@@ -713,6 +754,8 @@ class DecodeEngine:
             out["steady_state_recompiles"] = steady
             telemetry.set_steady_state_recompiles(
                 "serving." + self._name, steady)
+        # live SLO verdicts over the series this snapshot just refreshed
+        out["alerts"] = _slo.evaluate()
         return out
 
     def close(self, drain: bool = True, timeout: Optional[float] = None):
@@ -816,7 +859,12 @@ class DecodeEngine:
                 # belt-and-braces (the PR-2 batcher discipline): NO
                 # exception may kill the engine thread — that would hang
                 # every in-flight and queued future forever. Evict
-                # whatever was in flight and keep serving.
+                # whatever was in flight and keep serving. This is also a
+                # black-box moment: something unexpected reached the
+                # catch-all, so commit the ring before state is torn down.
+                _flightrec.record("decode.engine_exception",
+                                  server=self._name, error=repr(exc))
+                _flightrec.dump("decode engine catch-all: %r" % (exc,))
                 self._breaker.on_failure()
                 self._evict([(i, r) for i, r in enumerate(self._slots)
                              if r is not None], exc)
@@ -847,8 +895,10 @@ class DecodeEngine:
                 # the index (in-flight sequences keep their pages and
                 # continue, the documented rollout semantic)
                 self._cache.clear_prefix_index()
-        for _params, _variant, fut in swaps:
+        for _params, variant, fut in swaps:
             _T_EVENTS.inc(server=self._name, event="weight_swap")
+            _flightrec.record("decode.weight_swap", server=self._name,
+                              variant=variant)
             if fut.set_running_or_notify_cancel():
                 fut.set_result(True)
 
@@ -859,6 +909,7 @@ class DecodeEngine:
         for tenant, req in expired:
             self._stats.on_timeout()
             tenant.stats.on_timeout()
+            _tracing.finish(req.trace, "timeout", where="queued")
             self._fail(req, RequestTimeoutError(
                 "request spent > its deadline queued"))
 
@@ -883,6 +934,10 @@ class DecodeEngine:
             self._stats.on_timeout()
             req.tenant.stats.on_timeout()
             _T_EVENTS.inc(server=self._name, event="deadline_evicted")
+            _tracing.finish(req.trace, "timeout", where="mid_decode",
+                            tokens=len(req.tokens))
+            _flightrec.record("decode.deadline_evict", server=self._name,
+                              rid=req.rid, tenant=req.tenant.tenant_id)
             self._fail(req, RequestTimeoutError(
                 "deadline expired mid-decode after %d generated tokens: "
                 "evicted at the tick boundary" % len(req.tokens)))
@@ -901,6 +956,7 @@ class DecodeEngine:
         for tenant, req in dropped:
             tenant.stats.on_shed(breaker=True)
             _T_EVENTS.inc(server=self._name, event="shed_tenant_breaker")
+            _tracing.finish(req.trace, "shed", reason="tenant_breaker")
             self._fail(req, TenantUnavailableError(tenant.tenant_id,
                                                    "open"))
 
@@ -914,6 +970,7 @@ class DecodeEngine:
         for tenant, req in dropped:
             self._stats.on_unavailable(1)
             tenant.stats.on_shed()
+            _tracing.finish(req.trace, "shed", reason="engine_breaker")
             self._fail(req, exc)
             _T_EVENTS.inc(server=self._name, event="shed_open_breaker")
 
@@ -927,6 +984,7 @@ class DecodeEngine:
         # tokens are never charged for an admission its breaker would
         # refuse anyway (the worker's shed pass drains it shortly)
         if tenant.breaker.state == "open":
+            _tracing.event(req.trace, "defer", reason="breaker")
             return False
         total = int(req.prompt.size) + req.max_new
         # the admission walk: map-able shared prefix pages reduce both
@@ -947,15 +1005,18 @@ class DecodeEngine:
             # global page pressure: this head defers, a cheaper tenant
             # behind it may still fit
             tenant.stats.on_defer("pages")
+            _tracing.event(req.trace, "defer", reason="pages_global")
             return False
         if not tenant.within_page_budget(need):
             # the tenant is at ITS quota (shared pages charge the
             # `shared` pseudo-tenant, not this budget) — only its own
             # completions can unblock it, everyone else keeps flowing
             tenant.stats.on_defer("pages")
+            _tracing.event(req.trace, "defer", reason="pages_budget")
             return False
         if not tenant.take_tokens(total):
             tenant.stats.on_defer("rate")
+            _tracing.event(req.trace, "defer", reason="rate")
             return False
         # allow() LAST: it may consume the half-open probe, so it must
         # only run when the pop — and therefore the prefill that reports
@@ -963,7 +1024,10 @@ class DecodeEngine:
         # the tokens just taken: the request never ran.
         if not tenant.breaker.allow():
             tenant.refund_tokens(total)
+            _tracing.event(req.trace, "defer", reason="breaker")
             return False
+        _tracing.event(req.trace, "admission_verdict", pages_needed=need,
+                       matched_pages=len(match.full) if match else 0)
         return True
 
     def _admit(self):
@@ -1025,6 +1089,14 @@ class DecodeEngine:
         if cow_src is not None:
             self._run_cow(cow_src, cow_dst)
         req.kv_cached = matched
+        _tracing.event(req.trace, "admit", slot=slot, ring=ring,
+                       queue_wait_ms=round(
+                           (time.perf_counter() - req.t_submit) * 1e3, 3))
+        if matched:
+            _tracing.event(req.trace, "prefix_hit", tokens_cached=matched)
+        if cow_src is not None:
+            _tracing.event(req.trace, "cow_copy", src_page=cow_src,
+                           dst_page=cow_dst)
         # at least the LAST prompt position always runs through the
         # model: its logits are the first output token — a full-prompt
         # hit recomputes that one position (null writes) over the
@@ -1056,6 +1128,8 @@ class DecodeEngine:
         jnp = self._jnp
         p = int(req.prompt.size)
         rung = select_bucket(p, self._ladder)
+        _tracing.event(req.trace, "prefill", rung=rung, tokens=p,
+                       ring=ring)
         pre = np.zeros((3, rung), np.int32)  # tokens, write pages, offsets
         pre[0, :p] = req.prompt
         wpg, woff = self._cache.write_slots(slot, 0, p)
@@ -1095,6 +1169,8 @@ class DecodeEngine:
 
         jnp = self._jnp
         n = end - start
+        _tracing.event(req.trace, "prefill_chunk", start=start, end=end,
+                       rung=rung)
         pre = np.zeros((3, rung), np.int32)
         pre[0, :n] = req.prompt[start:end]
         cached_n = max(0, min(req.kv_cached, end) - start)
@@ -1197,6 +1273,7 @@ class DecodeEngine:
         first = int(fetch_host([tok])[0])
         now = time.perf_counter()
         ttft = (now - req.t_submit) * 1e3
+        _tracing.event(req.trace, "first_token", ttft_ms=round(ttft, 3))
         self._stats.on_first_token(ttft)
         req.tenant.stats.on_first_token(ttft)
         req.tokens.append(first)
@@ -1268,6 +1345,17 @@ class DecodeEngine:
             # reserve() guarantees pos is covered, so index directly
             packed[3, slot] = self._cache.page_table[slot, pos // ps]
             packed[4, slot] = pos % ps
+        # black box: the in-flight set BEFORE the step executes, so a
+        # mid-tick death's dump names the failing tick's sequences and
+        # their tenants (the post-mortem acceptance contract). One event
+        # per tick, one deque append — the enabled() guard keeps even
+        # the reqs-list BUILD off the MXNET_TELEMETRY=0 hot path.
+        if telemetry.enabled():
+            _flightrec.record(
+                "decode.tick", server=self._name, tick=self._ticks,
+                reqs=[[req.rid, req.tenant.tenant_id,
+                       "prefill" if req.prefilling else "decode"]
+                      for req in self._slots if req is not None])
         policy = self._retry or resilience.default_policy()
 
         def attempt():
@@ -1306,6 +1394,11 @@ class DecodeEngine:
             tok = int(toks[slot])
             req.tokens.append(tok)
             ms = (now - req.last_t) * 1e3
+            # every decode tick the sequence participates in is a hop of
+            # its (sampled) trace — the None path is one pointer check
+            _tracing.event(req.trace, "tick",
+                           token_index=len(req.tokens),
+                           tpot_ms=round(ms, 3))
             tpots.append(ms)
             tenant_tpots.setdefault(req.tenant, []).append(ms)
             tenant_slots[req.tenant] = tenant_slots.get(req.tenant, 0) + 1
@@ -1347,6 +1440,8 @@ class DecodeEngine:
     def _complete(self, req: _DecodeRequest, slot: int, now: float):
         self._release_slot(slot, req)
         _T_EVENTS.inc(server=self._name, event="completed")
+        _tracing.finish(req.trace, "complete", tokens=len(req.tokens),
+                        latency_ms=round((now - req.t_submit) * 1e3, 3))
         if req.future.done():
             # close(drain=False) raced the in-flight tick and already
             # failed this future; completing it now would raise
@@ -1365,6 +1460,10 @@ class DecodeEngine:
         TICK-level fault: it feeds the engine breaker (the caller), not
         the tenants' — the victims were bystanders of an engine failure,
         not misbehaving traffic."""
+        _flightrec.record(
+            "decode.evict", server=self._name, error=repr(exc),
+            reqs=[[req.rid, req.tenant.tenant_id]
+                  for _slot, req in active])
         for slot, req in active:
             self._slots[slot] = None
             self._release_slot(slot, req)
@@ -1372,11 +1471,16 @@ class DecodeEngine:
             req.tenant.stats.on_error()
             self._evictions += 1
             _T_EVENTS.inc(server=self._name, event="evicted")
+            _tracing.finish(req.trace, "evict",
+                            tokens=len(req.tokens), error=repr(exc))
             self._fail(req, exc)
         self._cache.reset_pools()
 
     @staticmethod
     def _fail(req: _DecodeRequest, exc: BaseException):
+        # generic terminal fallback: paths with a more specific verdict
+        # (evict/timeout/shed) finish the trace first and this no-ops
+        _tracing.finish(req.trace, "error", error=type(exc).__name__)
         if req.future.done():
             return
         if req.future.set_running_or_notify_cancel():

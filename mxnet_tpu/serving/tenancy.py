@@ -58,6 +58,8 @@ import time
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from .. import telemetry
+from ..telemetry import flightrec as _flightrec
+from ..telemetry import slo as _slo
 from ..base import MXNetError, get_env
 from ..resilience.breaker import STATE_VALUE
 from .batcher import EngineUnavailableError
@@ -164,6 +166,10 @@ class TenantBreaker:
                        tenant=self.tenant_id)
         _T_BREAKER_TRANS.inc(server=self.server, tenant=self.tenant_id,
                              to=to)
+        # black box: "which tenant's breaker tripped right before the
+        # death" is the first question a post-mortem asks
+        _flightrec.record("tenant_breaker", server=self.server,
+                          tenant=self.tenant_id, to=to)
 
     def _prune(self, now: float) -> None:
         horizon = now - self.window_s
@@ -277,6 +283,13 @@ class Tenant:
         self.priority = int(priority)
         self.queue_depth = max(1, int(queue_depth))
         self.page_budget = page_budget if page_budget else None
+        # the SLO engine divides the tenant burn/violation alerts by
+        # these (instance key mirrors the registry's sorted-label key:
+        # server/tenant)
+        inst = "%s/%s" % (registry.server, tenant_id)
+        _slo.note_bound("tenant_queue_depth", inst, self.queue_depth)
+        if self.page_budget is not None:
+            _slo.note_bound("tenant_pages", inst, self.page_budget)
         self.rate = max(0.0, float(rate))
         self.breaker = breaker
         self.stats = stats

@@ -20,6 +20,19 @@ piece                 what it gives you
 :mod:`.exporters`     ``render_prometheus()`` text format, ``snapshot()``
                       JSON, and the ``MXNET_TELEMETRY_EMIT_SECS`` JSONL
                       emitter thread for post-mortems of hung runs
+:mod:`.tracing`       per-request causality: ``trace_id`` minted at
+                      ``submit()``, typed hop events through both serving
+                      planes, ``get_trace()`` + chrome-trace export
+                      (``MXNET_TRACE_SAMPLE``-gated)
+:mod:`.flightrec`     bounded lock-cheap event ring (breaker trips,
+                      ticks, evictions, faults, swaps, commits) dumped
+                      atomically on death paths — the black box
+:mod:`.slo`           the docs/observability.md burn alerts, evaluated
+                      live over the registry (``mxnet_slo_burn`` gauges,
+                      ``stats()["alerts"]``)
+:mod:`.httpd`         stdlib introspection daemon: ``/metrics``,
+                      ``/healthz``, ``/debug/state``,
+                      ``/debug/trace/<id>`` (``MXNET_METRICS_PORT``)
 ====================  =====================================================
 
 Publishers wired in-framework: ``serving.ServingStats``, ``profiler.
@@ -35,6 +48,7 @@ and the metric naming scheme.
 from __future__ import annotations
 
 from . import accounting, exporters, registry, spans
+from . import flightrec, httpd, slo, tracing
 from .accounting import (CKPT_BYTES, CKPT_CORRUPTION, CKPT_RESTORE_MS,
                          CKPT_SAVE_MS, COMPILE_CACHE_HITS,
                          COMPILE_CACHE_MISSES,
@@ -48,9 +62,11 @@ from .accounting import (CKPT_BYTES, CKPT_CORRUPTION, CKPT_RESTORE_MS,
                          set_steady_state_recompiles)
 from .exporters import (Emitter, render_prometheus, snapshot, start_emitter,
                         stop_emitter)
+from .httpd import start_httpd, stop_httpd
 from .registry import (Counter, Gauge, Histogram, Registry, REGISTRY,
                        counter, gauge, histogram, enabled, set_enabled)
 from .spans import span, traced
+from .tracing import get_trace, start_trace
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
@@ -67,9 +83,23 @@ __all__ = [
     "PREEMPTIONS", "CKPT_CORRUPTION", "ELASTIC_GOODPUT", "ELASTIC_RESTARTS",
     "render_prometheus", "snapshot", "Emitter", "start_emitter",
     "stop_emitter",
+    "tracing", "flightrec", "slo", "httpd",
+    "start_trace", "get_trace", "start_httpd", "stop_httpd",
 ]
 
 # Post-mortem channel: MXNET_TELEMETRY_EMIT_SECS > 0 starts the JSONL
 # emitter as soon as telemetry loads (start_emitter reads the knob and
 # no-ops at <= 0, the default).
 start_emitter()
+
+# Introspection endpoint: MXNET_METRICS_PORT > 0 serves /metrics,
+# /healthz, /debug/state and /debug/trace/<id> from a stdlib daemon
+# thread (start_httpd no-ops at the default of 0). Best-effort at
+# import: two processes sharing the configured port must not turn the
+# second one's `import mxnet_tpu` into an Errno 98 crash — the same
+# degrade-don't-die contract the Emitter holds. An explicit
+# start_httpd() call still raises, so misconfiguration stays visible.
+try:
+    start_httpd()
+except OSError:
+    pass

@@ -207,6 +207,13 @@ def jit_call(site: str, jitted, *args, **kwargs):
         if after > before:
             RECOMPILES.inc(after - before, site=site)
             COMPILE_SECONDS.inc(time.perf_counter() - t0, site=site)
+            # black box: a steady-state recompile at a serving site is a
+            # rollback trigger — the dump must show it happened, when
+            from . import flightrec
+
+            flightrec.record("recompile", site=site,
+                             count=after - before,
+                             seconds=round(time.perf_counter() - t0, 4))
     return out
 
 

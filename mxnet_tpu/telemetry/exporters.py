@@ -19,6 +19,7 @@ Three ways the same registry leaves the process:
 """
 from __future__ import annotations
 
+import atexit
 import json
 import threading
 import time
@@ -142,6 +143,20 @@ class Emitter(threading.Thread):
 
 _emitter_lock = threading.Lock()
 _emitter: Optional[Emitter] = None
+_atexit_registered = False
+
+
+def _atexit_flush():
+    """Final snapshot line at interpreter exit: a run that dies BETWEEN
+    emit intervals (the exact post-mortem window the flight recorder
+    also serves) still leaves its last-known state on disk instead of
+    losing up to one full interval of tail. Registered once, when the
+    first emitter starts; a daemon thread cannot flush itself at exit —
+    it is killed mid-wait — so the hook runs on the main thread."""
+    with _emitter_lock:
+        emitter = _emitter
+    if emitter is not None:
+        emitter.emit_once()
 
 
 def start_emitter(interval_s: Optional[float] = None,
@@ -161,11 +176,15 @@ def start_emitter(interval_s: Optional[float] = None,
     if path is None:
         path = get_env("MXNET_TELEMETRY_EMIT_PATH", _DEFAULT_EMIT_PATH,
                        cache=False)
+    global _atexit_registered
     with _emitter_lock:
         if _emitter is not None and _emitter.is_alive():
             return _emitter
         _emitter = Emitter(interval_s, path)
         _emitter.start()
+        if not _atexit_registered:
+            atexit.register(_atexit_flush)
+            _atexit_registered = True
         return _emitter
 
 

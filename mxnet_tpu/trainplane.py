@@ -234,6 +234,11 @@ class TrainPlane(_PlaneBase):
 
     def _demote(self, reason: str):
         FALLBACKS.inc(reason=reason)
+        # black box: a plane demotion changes the performance regime —
+        # post-mortems must see it next to whatever broke afterwards
+        from .telemetry import flightrec
+
+        flightrec.record("trainplane.fallback", reason=reason)
         self._plane = "eager"
         self._why_eager = reason
         if mode() == "1":

@@ -31,6 +31,21 @@ import pytest
 
 
 @pytest.fixture(autouse=True)
+def _flightrec_in_tmp(tmp_path):
+    """The flight recorder dumps on death paths some tests deliberately
+    exercise (decode worker catch-all, SIGTERM); default its path into
+    the test's tmp dir so suites never litter the repo root. Tests that
+    assert on the dump set MXNET_FLIGHTREC_PATH explicitly."""
+    prev = os.environ.get("MXNET_FLIGHTREC_PATH")
+    os.environ["MXNET_FLIGHTREC_PATH"] = str(tmp_path / "flightrec.json")
+    yield
+    if prev is None:
+        os.environ.pop("MXNET_FLIGHTREC_PATH", None)
+    else:
+        os.environ["MXNET_FLIGHTREC_PATH"] = prev
+
+
+@pytest.fixture(autouse=True)
 def _seeded():
     """Seeded determinism per test (reference tests/python/unittest/common.py
     @with_seed): failures are reproducible."""

@@ -309,6 +309,35 @@ def test_start_emitter_runs_and_stops(tmp_path):
     assert not em.is_alive()
 
 
+def test_emitter_atexit_flushes_short_lived_process(tmp_path):
+    """Regression (ISSUE-15 satellite): a run that dies BETWEEN emit
+    intervals must still leave its final snapshot — start_emitter
+    registers an atexit flush, so a short-lived subprocess whose
+    interval (1h) never elapses still writes its tail line."""
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    path = str(tmp_path / "tail.jsonl")
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_TELEMETRY_EMIT_SECS="3600",
+               MXNET_TELEMETRY_EMIT_PATH=path,
+               PYTHONPATH=repo)
+    code = ("from mxnet_tpu import telemetry\n"
+            "telemetry.counter('mxnet_atexit_probe_total').inc()\n")
+    proc = subprocess.run([sys.executable, "-c", code], env=env, cwd=repo,
+                          timeout=180, capture_output=True)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    with open(path) as f:
+        lines = [json.loads(l) for l in f if l.strip()]
+    # the interval never elapsed: every line on disk came from the
+    # atexit flush, and it carries the counter bumped mid-run
+    assert lines, "atexit flush wrote nothing"
+    assert "mxnet_atexit_probe_total" in lines[-1]["metrics"]
+
+
 # ---------------------------------------------------------------------------
 # acceptance smoke: serving + training publish >= 15 distinct series
 # ---------------------------------------------------------------------------

@@ -1045,6 +1045,133 @@ def test_unbounded_queue_repo_gate_clean_and_justified():
 
 
 # ---------------------------------------------------------------------------
+# metric-cardinality
+# ---------------------------------------------------------------------------
+
+def test_metric_cardinality_flags_interpolated_labels():
+    # f-string, %-format and .format label values are runtime data
+    f = lint("""
+        REQS = telemetry.counter("mxnet_x_total", labels=("rid",))
+        def f(request_id):
+            REQS.inc(rid=f"req-{request_id}")
+        """, rule="metric-cardinality")
+    assert len(f) == 1 and "'rid'" in f[0].message
+    f = lint("""
+        REQS = telemetry.counter("mxnet_x_total", labels=("who",))
+        def f(uid):
+            REQS.inc(who="user-%s" % uid)
+        """, rule="metric-cardinality")
+    assert len(f) == 1
+    f = lint("""
+        H = telemetry.histogram("mxnet_h_ms", labels=("k",))
+        def f(x, ms):
+            H.observe(ms, k="{}".format(x))
+        """, rule="metric-cardinality")
+    assert len(f) == 1
+
+
+def test_metric_cardinality_flags_exception_text_and_ids():
+    # str(e) / a bare except-handler binding IS exception text; id-ish
+    # parameter names (request_id, trace_id, prompt) are per-request data
+    f = lint("""
+        G = telemetry.gauge("mxnet_g", labels=("err",))
+        def f():
+            try:
+                pass
+            except Exception as e:
+                G.set(1, err=str(e))
+        """, rule="metric-cardinality")
+    assert len(f) == 1 and "str()" in f[0].message
+    f = lint("""
+        G = telemetry.gauge("mxnet_g", labels=("err",))
+        def f():
+            try:
+                pass
+            except Exception as e:
+                G.set(1, err=e)
+        """, rule="metric-cardinality")
+    assert len(f) == 1
+    f = lint("""
+        H = telemetry.histogram("mxnet_h_ms", labels=("req",))
+        def f(trace_id, ms):
+            H.observe(ms, req=trace_id)
+        """, rule="metric-cardinality")
+    assert len(f) == 1
+
+
+def test_metric_cardinality_sees_chained_and_cross_module_handles():
+    # telemetry.counter(...).inc(...) and the ALL-CAPS cross-module
+    # handle convention (telemetry.RECOMPILES) are both update sites
+    f = lint("""
+        def f(prompt):
+            telemetry.counter("mxnet_p_total", labels=("p",)).inc(p=prompt)
+        """, rule="metric-cardinality")
+    assert len(f) == 1
+    f = lint("""
+        from .. import telemetry
+        def f(request_id):
+            telemetry.RECOMPILES.inc(site="x-%s" % request_id)
+        """, rule="metric-cardinality")
+    assert len(f) == 1
+
+
+def test_metric_cardinality_negative_cases():
+    # constant labels, plain bounded names, attribute reads and the
+    # tenant exemption (TenantRegistry bounds tenant ids) are all legal
+    assert lint("""
+        T = telemetry.counter("mxnet_t", labels=("event",))
+        def f():
+            T.inc(event="shed")
+        """, rule="metric-cardinality") == []
+    assert lint("""
+        T = telemetry.counter("mxnet_t", labels=("tenant",))
+        def f(tenant_id):
+            T.inc(tenant="t-%s" % tenant_id)
+        """, rule="metric-cardinality") == []
+    assert lint("""
+        T = telemetry.counter("mxnet_t", labels=("site",))
+        def f(site):
+            T.inc(site=site)
+        """, rule="metric-cardinality") == []
+    assert lint("""
+        T = telemetry.gauge("mxnet_g", labels=("server",))
+        class S:
+            def f(self):
+                T.set(1, server=self.name)
+        """, rule="metric-cardinality") == []
+    # a non-metric receiver's .set() is out of scope
+    assert lint("""
+        def f(x, request_id):
+            x.set(1, rid=request_id)
+        """, rule="metric-cardinality") == []
+    # scope is mxnet_tpu/ only
+    assert lint("""
+        T = telemetry.counter("t", labels=("rid",))
+        def f(request_id):
+            T.inc(rid=f"{request_id}")
+        """, rule="metric-cardinality",
+        relpath="tools/whatever.py") == []
+
+
+def test_metric_cardinality_repo_gate_clean_and_justified():
+    # survivors (PJRT device ordinals, exception CLASS names) ride the
+    # baseline WITH a justification each; everything else is clean
+    files = collect_files(["mxnet_tpu"], root=REPO)
+    findings = [f for f in lint_files(files, root=REPO,
+                                      passes=["metric-cardinality"])]
+    baseline = load_baseline(DEFAULT_BASELINE)
+    assert apply_baseline(findings, baseline) == []
+    justs = core.load_justifications(DEFAULT_BASELINE)
+    for f in findings:
+        assert f.baseline_key() in justs, \
+            "metric-cardinality baseline entries must carry a justification"
+    # the new telemetry v2 modules are finding-free by construction
+    assert [f for f in findings
+            if "tracing" in f.path or "flightrec" in f.path
+            or "slo" in f.path or "httpd" in f.path] == []
+
+
+# ---------------------------------------------------------------------------
 # whole-program graph engine (symbol table / call graph / lattices)
 # ---------------------------------------------------------------------------
 

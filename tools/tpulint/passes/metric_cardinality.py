@@ -1,0 +1,171 @@
+"""metric-cardinality: unbounded runtime data fed into metric labels.
+
+The telemetry naming contract (docs/observability.md) is that labels
+identify *which instance of a thing*, never unbounded user data: a label
+set is a SERIES, each distinct value a new one held forever by the
+registry and shipped on every scrape. A label fed from a request id, a
+raw prompt-derived string or exception text turns a bounded gauge into
+an unbounded memory leak + scrape bomb — the classic Prometheus
+cardinality explosion, invisible until production traffic arrives.
+
+Flagged in ``mxnet_tpu/``: update calls (``inc``/``dec``/``set``/
+``observe``/``observe_many``) on metric handles — module-level
+``NAME = telemetry.counter/gauge/histogram(...)`` assignments, handles
+reached as ``telemetry.SOME_METRIC``, or a chained
+``telemetry.counter(...).inc(...)`` — whose label keyword values are:
+
+- f-strings / ``%``-formatted / ``str.format`` strings (runtime
+  interpolation into a label value),
+- ``str(...)`` / ``repr(...)`` coercions (the exception-text idiom),
+- names bound by an ``except ... as e`` handler,
+- identifier names that *are* per-request data: ``*request_id``,
+  ``*trace_id``, ``uuid``, ``prompt``-ish.
+
+Per-tenant labels stay legal by construction: ``TenantRegistry`` bounds
+the tenant-id set (spec + auto-registration under operator control), so
+a keyword literally named ``tenant`` is exempt. Survivors that are
+genuinely bounded some other way ride the baseline WITH a justification.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional, Set
+
+from ..core import FileContext, Finding, Pass, dotted_name, register
+
+_UPDATE_METHODS = {"inc", "dec", "set", "observe", "observe_many"}
+_CONSTRUCTORS = {"counter", "gauge", "histogram"}
+
+#: keywords that are the sample value, not a label
+_VALUE_KWARGS = {"value"}
+
+#: label names bounded by construction elsewhere (TenantRegistry)
+_BOUNDED_LABELS = {"tenant"}
+
+_IDISH_RE = re.compile(
+    r"(?:^|_)(?:request|trace|req|session|uuid)_?id$"
+    r"|^uuid\d*$|^prompt(?:s|_text)?$|(?:^|_)prompt$",
+    re.IGNORECASE)
+
+
+def _constructor_call(node: ast.AST) -> bool:
+    """``telemetry.counter(...)`` / ``registry.gauge(...)`` /
+    ``REGISTRY.histogram(...)`` / bare ``counter(...)`` (the
+    from-import spelling inside the telemetry package)."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func) or ""
+    return name.rsplit(".", 1)[-1] in _CONSTRUCTORS
+
+
+def _metric_handles(tree: ast.Module) -> Set[str]:
+    """Names bound (at module or class level) to a metric constructor."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _constructor_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    out.add(t.attr)
+    return out
+
+
+def _receiver_is_metric(func: ast.Attribute, handles: Set[str]) -> bool:
+    recv = func.value
+    if _constructor_call(recv):  # telemetry.counter(...).inc(...)
+        return True
+    name = dotted_name(recv)
+    if not name:
+        return False
+    tail = name.rsplit(".", 1)[-1]
+    if tail in handles:
+        return True
+    # cross-module handles: telemetry.RECOMPILES.inc(...) — the
+    # ALL-CAPS module-constant convention every telemetry handle uses
+    parts = name.split(".")
+    return len(parts) >= 2 and parts[-1].isupper() and len(parts[-1]) > 1
+
+
+def _except_names(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.name:
+            out.add(node.name)
+    return out
+
+
+def _unbounded(value: ast.AST, exc_names: Set[str]) -> Optional[str]:
+    """Why this label value is unbounded runtime data (None = looks
+    bounded)."""
+    if isinstance(value, ast.JoinedStr):
+        if any(isinstance(v, ast.FormattedValue) for v in value.values):
+            return "f-string interpolation"
+        return None
+    if isinstance(value, ast.BinOp) and isinstance(value.op, ast.Mod):
+        left = value.left
+        if isinstance(left, ast.Constant) and isinstance(left.value, str):
+            return "%-formatted string"
+        return None
+    if isinstance(value, ast.Call):
+        fname = dotted_name(value.func) or ""
+        tail = fname.rsplit(".", 1)[-1]
+        if tail in ("str", "repr") and fname in ("str", "repr"):
+            return "str()/repr() coercion (exception-text idiom)"
+        if isinstance(value.func, ast.Attribute) \
+                and value.func.attr == "format" and value.args:
+            return "str.format interpolation"
+        return None
+    name = None
+    if isinstance(value, ast.Name):
+        name = value.id
+    elif isinstance(value, ast.Attribute):
+        name = value.attr
+    if name is None:
+        return None
+    if name in exc_names:
+        return "except-handler binding (exception text)"
+    if _IDISH_RE.search(name):
+        return "per-request identifier %r" % name
+    return None
+
+
+@register
+class MetricCardinalityPass(Pass):
+    name = "metric-cardinality"
+    description = ("Counter/Gauge/Histogram label values fed from "
+                   "unbounded runtime data (request ids, prompt-derived "
+                   "strings, exception text) — every distinct value is a "
+                   "new series the registry holds forever; labels must "
+                   "come from registry-bounded sets")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        handles = _metric_handles(ctx.tree)
+        exc_names = _except_names(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute) \
+                    or node.func.attr not in _UPDATE_METHODS:
+                continue
+            if not _receiver_is_metric(node.func, handles):
+                continue
+            for kw in node.keywords:
+                if kw.arg is None or kw.arg in _VALUE_KWARGS \
+                        or kw.arg in _BOUNDED_LABELS:
+                    continue
+                why = _unbounded(kw.value, exc_names)
+                if why:
+                    yield ctx.finding(
+                        node, self.name,
+                        "label %r of %s.%s() fed from unbounded runtime "
+                        "data (%s): unbounded label values explode "
+                        "series cardinality — key the label from a "
+                        "registry-bounded set and put the detail in a "
+                        "log/trace/flight-recorder event instead"
+                        % (kw.arg,
+                           dotted_name(node.func.value) or "<metric>",
+                           node.func.attr, why))
