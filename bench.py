@@ -74,7 +74,9 @@ _LINT_STAMP = None
 def _lint_stamp():
     """``lint_clean``/``lint_findings`` for every emitted JSON line: was
     the source tree the bench ran on statically clean (tpulint, all
-    passes — incl. the v3 recompile-risk/pallas/sharding gates), and how
+    passes — incl. the v3 recompile-risk/pallas/sharding gates and the
+    v4 concurrency/lifecycle gates: lock-order-cycle,
+    blocking-under-lock, cv-protocol, resource-lifecycle), and how
     many non-baselined findings were open if not. A perf number from a
     tree with a predicted recompile storm reads very differently from
     one off a clean tree, so the evidence rides the line. Memoized (one

@@ -285,10 +285,11 @@ class Bilinear(Initializer):
 
     def _init_weight(self, _, arr):
         shape = self._shape(arr)
-        weight = np.zeros(int(np.prod(shape)), dtype="float32")
+        size = int(np.prod(shape))  # hoisted: one host conversion, not per-iteration
+        weight = np.zeros(size, dtype="float32")
         f = np.ceil(shape[3] / 2.0)
         c = (2 * f - 1 - f % 2) / (2.0 * f)
-        for i in range(int(np.prod(shape))):
+        for i in range(size):
             x = i % shape[3]
             y = (i // shape[3]) % shape[2]
             weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))

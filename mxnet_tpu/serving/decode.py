@@ -1420,6 +1420,12 @@ class DecodeEngine:
         occ = len(active) / float(s)
         self._occ_sum += occ
         _T_OCCUPANCY.set(occ, server=self._name)
+        # MXNET_KVCACHE_AUDIT: re-prove the page refcount invariant at
+        # every tick boundary, not just on cache mutations — seq_lens
+        # advances and slot completion both ran above without a page-map
+        # change, and the audit contract is "per tick"
+        if self._cache.audit:
+            self._cache.audit_check()
 
     @staticmethod
     def _finished(req: _DecodeRequest, tok: int) -> bool:
@@ -1684,7 +1690,10 @@ class TinyDecoder(PagedDecodeModel):
                 x = x + att.reshape(t, h * d) @ layer["wo"]
                 x = x + self._mlp(self._norm(x, layer["ln2"]), layer)
             logits = self._norm(x, params["lnf"]) @ params["unembed"]
-            nxt = int(jnp.argmax(logits[-1]))
+            # the batched-fetch idiom even for one value: the transfer is
+            # explicit, and greedy decode is inherently per-token (the
+            # fetched token IS the next input)
+            nxt = int(fetch_host([jnp.argmax(logits[-1])])[0])  # tpulint: disable=decode-host-sync -- correctness oracle, never a serving path; per-token fetch is the point
             out.append(nxt)
             toks.append(nxt)
             if eos_id is not None and nxt == eos_id:

@@ -235,6 +235,11 @@ class PagedKVCache:
         # engine keys its cached DEVICE copy of the page table on it, so
         # steady decode ticks skip the host->device put entirely
         self.version = 0
+        # MXNET_KVCACHE_AUDIT=1: every mutation (and every engine tick)
+        # re-proves the refcount invariant — the runtime twin of the
+        # static resource-lifecycle pass
+        self.audit = bool(get_env("MXNET_KVCACHE_AUDIT", 0, int,
+                                  cache=False))
         _T_CAPACITY.set(self.num_pages - 1, cache=self.name)
         self._publish()
 
@@ -365,9 +370,22 @@ class PagedKVCache:
         demand). Idempotent."""
         for i in range(self._owned[slot]):
             page = int(self.page_table[slot, i])
+            if page == 0 or self._ref[page] <= 0:
+                # double-free: this mapping's page already dropped its
+                # last reference. Decref once only — decrementing past
+                # zero used to clamp AND re-append the page, planting a
+                # duplicate free-list entry that hands one page to two
+                # slots (silent KV corruption). Audit mode makes the
+                # re-entrant release loud instead of absorbing it.
+                if self.audit:
+                    raise MXNetError(
+                        "kvcache %r audit: double-free of page %d via "
+                        "slot %d (refcount already 0) — a release path "
+                        "ran twice over one mapping" % (self.name, page,
+                                                        slot))
+                continue
             self._ref[page] -= 1
-            if self._ref[page] <= 0:
-                self._ref[page] = 0
+            if self._ref[page] == 0:
                 entry = self._page_entry.get(page)
                 if entry is not None:
                     self._cached[page] = entry
@@ -585,6 +603,69 @@ class PagedKVCache:
         _T_PAGES.set(self.pages_in_use, cache=self.name)
         _T_CACHED.set(self.pages_cached, cache=self.name)
         _T_SHARED.set(self.shared_pages, cache=self.name)
+        if self.audit:
+            self.audit_check()
+
+    def audit_check(self) -> None:
+        """``MXNET_KVCACHE_AUDIT=1``: re-prove the refcount invariant —
+        the runtime counterpart of tpulint's ``resource-lifecycle`` pass.
+        Runs after every mutation (via :meth:`_publish`) and once per
+        decode tick from the engine. Raises :class:`MXNetError` on the
+        first violated invariant:
+
+        - ``pages_in_use`` equals the number of pages with a live ref;
+        - ``sum(ref)`` equals the number of live page-table mappings
+          (the first ``owned`` entries of every slot row);
+        - the free list holds no duplicates, no null page, no referenced
+          page, and is disjoint from the cached-LRU;
+        - cached pages all carry refcount 0.
+        """
+        live_refs = int(np.count_nonzero(self._ref > 0))
+        if self.pages_in_use != live_refs:
+            raise MXNetError(
+                "kvcache %r audit: pages_in_use %d != pages with live "
+                "refs %d (free=%d cached=%d) — a release path leaked or "
+                "double-counted" % (self.name, self.pages_in_use,
+                                    live_refs, len(self._free),
+                                    len(self._cached)))
+        mappings = sum(self._owned)
+        total_ref = int(self._ref.sum())
+        if total_ref != mappings:
+            raise MXNetError(
+                "kvcache %r audit: sum of page refcounts %d != live "
+                "page-table mappings %d — refcounts and table rows "
+                "disagree" % (self.name, total_ref, mappings))
+        free_set = set(self._free)
+        if len(free_set) != len(self._free):
+            raise MXNetError(
+                "kvcache %r audit: duplicate entries on the free list — "
+                "one page would be handed to two slots"
+                % (self.name,))
+        if 0 in free_set:
+            raise MXNetError(
+                "kvcache %r audit: null page 0 on the free list"
+                % (self.name,))
+        if free_set & set(self._cached):
+            raise MXNetError(
+                "kvcache %r audit: page(s) %s on the free list AND in "
+                "the cached-LRU" % (self.name,
+                                    sorted(free_set & set(self._cached))))
+        bad = [p for p in self._free if self._ref[p] > 0]
+        if bad:
+            raise MXNetError(
+                "kvcache %r audit: referenced page(s) %s on the free "
+                "list" % (self.name, bad))
+        bad = [p for p in self._cached if self._ref[p] != 0]
+        if bad:
+            raise MXNetError(
+                "kvcache %r audit: cached-LRU page(s) %s carry a live "
+                "refcount" % (self.name, bad))
+        for s in range(self.num_slots):
+            if self._exclusive[s] > self._owned[s]:
+                raise MXNetError(
+                    "kvcache %r audit: slot %d exclusive count %d > "
+                    "owned %d" % (self.name, s, self._exclusive[s],
+                                  self._owned[s]))
 
     def stats(self) -> dict:
         out = {

@@ -132,7 +132,8 @@ def _parse_subgraph(v):
 
 
 def _sg_inputs(attrs):
-    return ["arg%d" % i for i in range(int(attrs.get("num_args", 1)))]
+    n = int(attrs.get("num_args", 1))  # hoisted out of the comprehension
+    return ["arg%d" % i for i in range(n)]
 
 
 def _sg_outputs(attrs):

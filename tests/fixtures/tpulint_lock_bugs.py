@@ -1,0 +1,95 @@
+"""Seeded concurrency bugs for the v4 lock/lifecycle passes.
+
+Linted under a ``mxnet_tpu/`` pseudo-path by ``tests/test_tpulint.py``;
+each class plants exactly ONE bug for exactly ONE pass, so the suite can
+assert per-pass exactness (a pass that fires twice here has a precision
+regression; one that fires zero times has a recall regression).
+
+NOT imported at runtime — pure lint fixture.
+"""
+import threading
+
+from mxnet_tpu.base import fetch_host
+
+
+class PoolA:
+    """BUG 1 (lock-order-cycle), forward half: A -> B.
+
+    ``peer`` is typed through a string annotation on purpose — the
+    analyzer must resolve ``self.peer.poke()`` through the attr-type
+    layer, not the call graph's symbol table."""
+
+    def __init__(self, peer: "PoolB"):
+        self._lock = threading.Lock()
+        self.peer = peer
+
+    def forward(self):
+        with self._lock:
+            return self.peer.poke()
+
+    def poke(self):
+        with self._lock:
+            return 1
+
+
+class PoolB:
+    """BUG 1, reverse half: B -> A closes the cycle — two threads
+    running ``forward`` and ``backward`` deadlock on first interleave."""
+
+    def __init__(self, peer: PoolA):
+        self._lock = threading.Lock()
+        self.peer = peer
+
+    def backward(self):
+        with self._lock:
+            return self.peer.poke()
+
+    def poke(self):
+        with self._lock:
+            return 2
+
+
+class Sampler:
+    """BUG 2 (blocking-under-lock): a device->host fetch inside the
+    critical section — every thread waiting on ``_lock`` stalls for the
+    full round trip."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last = None
+
+    def snapshot(self, batch):
+        with self._lock:
+            self._last = fetch_host([batch])[0]
+            return self._last
+
+
+class Waiter:
+    """BUG 3 (cv-protocol): single-shot wait — a spurious wakeup or a
+    notify landing before the wait returns with the predicate false."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = False
+
+    def await_ready(self):
+        with self._cv:
+            self._cv.wait()
+            return self._ready
+
+
+class Prefiller:
+    """BUG 4 (resource-lifecycle): pages reserved, a fallible call, then
+    the free — if ``_run_model`` raises, the reservation leaks (no
+    ``finally``, no owner transfer, no caller-side handler)."""
+
+    def __init__(self, cache):
+        self._cache = cache
+
+    def admit(self, slot, pages):
+        self._cache.reserve(slot, pages)
+        self._run_model(slot)
+        self._cache.free(slot)
+
+    def _run_model(self, slot):
+        return slot

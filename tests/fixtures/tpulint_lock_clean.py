@@ -1,0 +1,106 @@
+"""Clean concurrency idioms for the v4 lock/lifecycle passes.
+
+Every class here is a distilled version of a pattern the serving plane
+actually uses, written the RIGHT way — the suite asserts ZERO findings
+across ALL passes, so any false positive on these idioms is a
+regression:
+
+- ``Engine``: the tick-boundary CV discipline — the condition variable
+  guards bookkeeping only; the batch is swapped out under the lock and
+  every device fetch happens outside it; waits are timed, looped, and
+  observe the shutdown flag; notifies hold the CV.
+- ``Admitter``: the catch-all evict-then-free caller-protection idiom
+  plus the subscript-store ownership transfer (``self._slots[slot] =
+  req`` is the consuming last touch), and guard-polarity token charges
+  settled in the handler.
+- ``Copier``: the lifecycle-synchronized hand-off — ``_skip`` is
+  written only while the worker is quiescent (before ``start()``), so
+  the happens-before edge is ``Thread.start()``, not a lock.
+
+NOT imported at runtime — pure lint fixture.
+"""
+import threading
+
+from mxnet_tpu.base import fetch_host
+
+
+class Engine:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._pending = []
+        self._closed = False
+        self._t = threading.Thread(target=self._worker, daemon=True)
+
+    def submit(self, item):
+        with self._cv:
+            self._pending.append(item)
+            self._cv.notify_all()
+
+    def close(self, timeout=None):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._t.join(timeout)
+
+    def _worker(self):
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait(0.1)
+                if self._closed and not self._pending:
+                    return
+                batch, self._pending = self._pending, []
+            self._step(batch)
+
+    def _step(self, batch):
+        out = fetch_host(batch)
+        return ", ".join(str(x) for x in out)
+
+
+class Admitter:
+    def __init__(self, cache, tenant):
+        self._cache = cache
+        self._tenant = tenant
+        self._slots = {}
+
+    def admit(self, req, slot, pages, tokens):
+        if not self._tenant.take_tokens(tokens):
+            return False
+        try:
+            self._prefill(req, slot, pages)
+        except Exception:
+            self._release(slot)
+            self._tenant.refund_tokens(tokens)
+            raise
+        return True
+
+    def _prefill(self, req, slot, pages):
+        self._cache.reserve(slot, pages)
+        self._tenant.charge_pages(pages)
+        self._slots[slot] = req
+
+    def _release(self, slot):
+        self._slots.pop(slot, None)
+        self._cache.free(slot)
+        self._tenant.release_pages(1)
+
+
+class Copier:
+    def __init__(self):
+        self._skip = 0
+        self._done = []
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def configure(self, skip):
+        self._skip = skip  # worker not started yet: start() publishes it
+
+    def start(self):
+        self._t.start()
+
+    def _run(self):
+        for i in range(self._skip, 8):
+            self._done.append(i)
+
+    def finish(self):
+        self._t.join()
+        return list(self._done)

@@ -1020,3 +1020,98 @@ def test_engine_swap_mid_chunked_prefill_never_reindexes_stale_kv(tiny):
             eng.generate(prompt, 5),
             model.reference_generate(params_b, prompt, 5))
         assert eng.stats()["steady_state_recompiles"] == 0
+
+
+# ---------------------------------------------------------------------------
+# MXNET_KVCACHE_AUDIT: the runtime twin of the static resource-lifecycle
+# pass — re-proves the refcount invariant on every mutation and tick
+# ---------------------------------------------------------------------------
+
+def test_kvcache_double_free_decrefs_once_silently_when_audit_off(
+        monkeypatch):
+    # a release path running twice over one mapping used to clamp the
+    # refcount AND re-append the page — a duplicate free-list entry that
+    # hands one page to two slots. The guard decrefs once and keeps the
+    # free list duplicate-free. (Pinned audit-off: the suite may run
+    # under MXNET_KVCACHE_AUDIT=1, where this same shape raises.)
+    monkeypatch.setenv("MXNET_KVCACHE_AUDIT", "0")
+    c = _pcache(num_slots=2)
+    c.reserve(0, 16)  # 2 exclusive pages
+    row = [int(p) for p in c.page_table[0, :2]]
+    c.free(0)
+    assert len(set(c._free)) == len(c._free)
+    # simulate the stale mapping a re-entrant release would observe
+    c.page_table[0, :2] = row
+    c._owned[0] = 2
+    free_before = list(c._free)
+    c.free(0)  # absorbed: no decref past zero, no duplicate entry
+    assert list(c._free) == free_before
+    assert len(set(c._free)) == len(c._free)
+    c.reserve(1, 16)  # the pool still hands out distinct pages
+    got = [int(p) for p in c.page_table[1, :2]]
+    assert len(set(got)) == 2
+
+
+def test_kvcache_double_free_raises_under_audit(monkeypatch):
+    monkeypatch.setenv("MXNET_KVCACHE_AUDIT", "1")
+    c = _pcache(num_slots=2)
+    assert c.audit
+    c.reserve(0, 16)
+    row = [int(p) for p in c.page_table[0, :2]]
+    c.free(0)
+    c.page_table[0, :2] = row
+    c._owned[0] = 2
+    with pytest.raises(MXNetError, match="double-free"):
+        c.free(0)
+
+
+def test_kvcache_audit_check_passes_through_cow_sharing(monkeypatch):
+    # the full CoW lifecycle — donor indexes, sharer maps + CoW page,
+    # donor freed, sharer freed — keeps every audit invariant green
+    monkeypatch.setenv("MXNET_KVCACHE_AUDIT", "1")
+    c = _pcache(num_slots=2)
+    donor = np.asarray([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12], np.int32)
+    c.reserve(0, 12)
+    c.insert_prefix(0, donor)
+    probe = np.asarray([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 99, 98], np.int32)
+    c.admit_prefix(1, 20, c.match_prefix(probe))
+    c.audit_check()
+    c.free(0)
+    c.free(1)
+    c.audit_check()
+    assert c.pages_in_use == 0
+
+
+def test_engine_audit_shared_prefix_chaos_eviction(tiny, monkeypatch):
+    # two slots decode on CoW-shared prefix pages; a chaos decode fault
+    # (retries off) evicts them mid-tick. Each eviction must decref the
+    # shared pages exactly once — the per-tick audit turns any re-entrant
+    # release into a hard failure instead of silent KV corruption — and
+    # the engine must answer shared-prefix traffic afterwards.
+    monkeypatch.setenv("MXNET_KVCACHE_AUDIT", "1")
+    model, params = tiny
+    prompt = np.asarray([6, 2, 6, 2, 1, 5, 1, 5, 3, 9], np.int32)
+    with _engine(tiny, num_slots=2, page_size=8, prefix_cache=True,
+                 retry_policy=RetryPolicy(max_attempts=1)) as eng:
+        assert eng._cache.audit
+        eng.warmup()
+        # donor populates the prefix index, then completes (pages parked)
+        np.testing.assert_array_equal(
+            eng.generate(prompt, 2),
+            model.reference_generate(params, prompt, 2))
+        with chaos.active("seed=1,site=serving.decode,at=3"):
+            futs = [eng.submit(prompt, 12) for _ in range(2)]
+            evicted = 0
+            for f in futs:
+                try:
+                    f.result(timeout=120)
+                except chaos.FaultInjected:
+                    evicted += 1
+        assert evicted >= 1  # at least one sharer died on the faulted tick
+        mid = eng.stats()
+        assert mid["kvcache"]["pages_in_use"] == 0
+        # the audited engine keeps serving the shared prefix, exactly
+        np.testing.assert_array_equal(
+            eng.generate(prompt, 4),
+            model.reference_generate(params, prompt, 4))
+        eng._cache.audit_check()

@@ -1747,6 +1747,248 @@ def test_seeded_bugs_exactly_three_across_all_passes():
 
 
 # ---------------------------------------------------------------------------
+# v4 concurrency passes: lock-order-cycle / blocking-under-lock /
+# cv-protocol / resource-lifecycle (tools/tpulint/locks.py)
+# ---------------------------------------------------------------------------
+
+LOCK_RULES = ["blocking-under-lock", "cv-protocol", "lock-order-cycle",
+              "resource-lifecycle"]
+LOCK_BUGS = (REPO / "tests" / "fixtures" / "tpulint_lock_bugs.py").read_text()
+LOCK_CLEAN = (REPO / "tests" / "fixtures"
+              / "tpulint_lock_clean.py").read_text()
+
+
+def _lint_lock_bugs(rule):
+    return lint_source("mxnet_tpu/_lock_bugs.py", LOCK_BUGS, passes=[rule])
+
+
+def test_lock_bug_lock_order_cycle():
+    f = _lint_lock_bugs("lock-order-cycle")
+    assert len(f) == 1
+    assert "PoolA._lock" in f[0].message and "PoolB._lock" in f[0].message
+    # both witness directions are named
+    assert "PoolA.forward" in f[0].message
+    assert "PoolB.backward" in f[0].message
+
+
+def test_lock_bug_blocking_under_lock():
+    f = _lint_lock_bugs("blocking-under-lock")
+    assert len(f) == 1
+    assert "fetch_host" in f[0].message and "Sampler._lock" in f[0].message
+
+
+def test_lock_bug_cv_protocol():
+    f = _lint_lock_bugs("cv-protocol")
+    assert len(f) == 1
+    assert "bare" in f[0].message and "while" in f[0].message
+
+
+def test_lock_bug_resource_lifecycle():
+    f = _lint_lock_bugs("resource-lifecycle")
+    assert len(f) == 1
+    assert "reserve" in f[0].message and "KV cache pages" in f[0].message
+
+
+def test_lock_bugs_exactly_four_across_all_passes():
+    # each seeded bug is caught by EXACTLY its pass — no cross-talk with
+    # any other pass in the registry
+    f = lint_source("mxnet_tpu/_lock_bugs.py", LOCK_BUGS)
+    assert sorted(x.rule for x in f) == LOCK_RULES
+
+
+def test_lock_clean_fixture_zero_findings_across_all_passes():
+    # the tick-boundary swap, caller-protection, subscript-store transfer
+    # and lifecycle-synchronized hand-off idioms must never be flagged —
+    # by ANY pass, not just the four new ones
+    f = lint_source("mxnet_tpu/_lock_clean.py", LOCK_CLEAN)
+    assert f == []
+
+
+def test_lock_order_one_way_hierarchy_is_clean():
+    # a strict A->B ordering (the repo's engine->tenant shape) is fine;
+    # only a cycle deadlocks
+    src = """
+        import threading
+
+        class Outer:
+            def __init__(self, inner: "Inner"):
+                self._lock = threading.Lock()
+                self.inner = inner
+
+            def step(self):
+                with self._lock:
+                    return self.inner.poke()
+
+        class Inner:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self):
+                with self._lock:
+                    return 1
+    """
+    assert lint(src, "lock-order-cycle") == []
+
+
+def test_blocking_under_lock_transitive_names_witness_chain():
+    src = """
+        import threading
+
+        class Holder:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def tick(self):
+                with self._lock:
+                    return self._drain()
+
+            def _drain(self):
+                import time
+                time.sleep(0.1)
+    """
+    f = lint(src, "blocking-under-lock")
+    assert len(f) == 1
+    assert "time.sleep" in f[0].message and "_drain" in f[0].message
+
+
+def test_blocking_under_lock_str_join_and_timed_get_are_clean():
+    src = """
+        import threading
+
+        class Holder:
+            def __init__(self, q):
+                self._lock = threading.Lock()
+                self._q = q
+
+            def fmt(self, xs):
+                with self._lock:
+                    item = self._q.get(timeout=0.5)
+                    return ", ".join(str(x) for x in xs) + str(item)
+    """
+    assert lint(src, "blocking-under-lock") == []
+
+
+def test_blocking_under_lock_untimed_queue_get_flagged():
+    src = """
+        import threading
+
+        class Holder:
+            def __init__(self, q):
+                self._lock = threading.Lock()
+                self._q = q
+
+            def pull(self):
+                with self._lock:
+                    return self._q.get()
+    """
+    f = lint(src, "blocking-under-lock")
+    assert len(f) == 1 and "queue.get()" in f[0].message
+
+
+def test_cv_protocol_untimed_wait_without_shutdown_flag():
+    src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._items = []
+
+            def pull(self):
+                with self._cv:
+                    while not self._items:
+                        self._cv.wait()
+                    return self._items.pop()
+    """
+    f = lint(src, "cv-protocol")
+    assert len(f) == 1 and "shutdown" in f[0].message
+
+
+def test_cv_protocol_timed_looped_shutdown_wait_is_clean():
+    src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._items = []
+                self._closed = False
+
+            def pull(self):
+                with self._cv:
+                    while not self._items and not self._closed:
+                        self._cv.wait(0.5)
+                    self._cv.notify_all()
+    """
+    assert lint(src, "cv-protocol") == []
+
+
+def test_cv_protocol_notify_without_cv_lock():
+    src = """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def kick(self):
+                self._cv.notify_all()
+    """
+    f = lint(src, "cv-protocol")
+    assert len(f) == 1 and "notify" in f[0].message
+
+
+def test_resource_lifecycle_try_finally_is_clean():
+    src = """
+        class C:
+            def __init__(self, cache):
+                self._cache = cache
+
+            def run(self, slot, pages):
+                self._cache.reserve(slot, pages)
+                try:
+                    return self._work(slot)
+                finally:
+                    self._cache.free(slot)
+
+            def _work(self, slot):
+                return slot
+    """
+    assert lint(src, "resource-lifecycle") == []
+
+
+def test_resource_lifecycle_early_return_leak():
+    src = """
+        class C:
+            def __init__(self, cache):
+                self._cache = cache
+
+            def run(self, slot, pages, fast):
+                self._cache.reserve(slot, pages)
+                if fast:
+                    return None
+                self._cache.free(slot)
+    """
+    f = lint(src, "resource-lifecycle")
+    assert len(f) == 1 and "return" in f[0].message
+
+
+def test_lock_rule_repo_findings_are_baselined_with_justifications():
+    # same acceptance contract as shared-state-race: every baselined
+    # finding from the four concurrency passes carries a justification
+    counts = load_baseline(DEFAULT_BASELINE)
+    justs = core.load_justifications(DEFAULT_BASELINE)
+    keys = [k for k in counts
+            if any("::%s::" % r in k for r in LOCK_RULES)]
+    # the deliberate admission-guard hand-offs are known and must stay
+    # documented
+    assert any("::resource-lifecycle::" in k for k in keys), \
+        "expected the admission-guard hand-off findings baselined"
+    for k in keys:
+        assert justs.get(k), "baselined finding lacks a justification: %s" % k
+
+
+# ---------------------------------------------------------------------------
 # incremental cache + --stats + runtime gates
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,1056 @@
+r"""tpulint concurrency & resource-lifecycle interpreter (v4).
+
+PRs 13-15 tripled the threaded, lock-holding, resource-owning surface of
+the serving stack, and the failure shapes that surface grows are exactly
+the ones a file-local pass cannot see: a lock-order inversion between
+``DecodeEngine._cv`` and a ``TenantBreaker`` lock two call frames away, a
+``fetch_host()`` stalling a whole tick because a helper runs under a
+condition variable, an error path that returns without ``free()``-ing the
+pages it reserved. This module is the whole-program layer those hazards
+live in — the concurrency analogue of the v3 shape interpreter, built on
+the same PR-10 :class:`~tools.tpulint.graph.ProjectGraph` and memoized
+per graph the same way (:func:`analyze`), so the four passes riding it
+share one interpretation per lint scope.
+
+What it computes
+----------------
+
+**Lock identities.** Every ``with self._lock:`` / ``.acquire()`` site is
+resolved to a per-class identity (``DecodeEngine._cv``, ``Tenant._lock``,
+``slo._ENGINE_LOCK`` for module globals). Resolution goes beyond the
+call graph's own symbol table with a light type-inference layer:
+``self.X = ClassName(...)`` attribute construction, annotated parameters
+(``tenant: Tenant``, string annotations included), annotated dataclass
+fields, and ``@property`` access (``tenant.breaker.state`` resolves to
+the property method, whose lock acquisition then counts). Two runtime
+locks of the same class on *different instances* share one identity —
+the analysis tracks ordering between lock *classes*, so a self-edge is
+never reported (``t1._lock`` then ``t2._lock`` is legal).
+
+**The lock-acquisition graph.** An edge ``A -> B`` is added when lock B
+is taken while A is lexically held — directly (nested ``with``) or
+through a call that *transitively* acquires B, via call-edge propagation
+bounded by :data:`~tools.tpulint.graph.DEFAULT_DEPTH`. Callback
+references passed as arguments (``self._wfq.pop(self._admit_guard)``)
+count as may-be-invoked, because the weighted-fair pick really does run
+the guard under the engine CV. A cycle in this graph across any two
+classes is a *static deadlock*: two threads acquiring in opposite orders
+need only interleave once (lock-order-cycle pass — the finding carries
+both witness paths).
+
+**The held-lock context lattice.** The dual of v2's traced/thread
+contexts: each function's entry set of possibly-held locks, seeded at
+call sites inside ``with`` blocks and closed over call edges. It powers
+the blocking-under-lock pass (a ``fetch_host`` / jit dispatch /
+``queue.get(timeout=None)`` / ``Thread.join`` / ``time.sleep`` reachable
+with a lock held serializes every waiter — the tick-stall shape the
+flight recorder only sees post-mortem) and the cv-protocol pass's
+"notify without the CV's lock held" check.
+
+**Resource protocols.** :data:`PROTOCOLS` declares the repo's paired
+acquire/release disciplines — KV pages (``reserve``/``admit_prefix`` vs
+``free``, with the PR-14 CoW refcounts), tenant page budgets
+(``charge_pages``/``release_pages``), token buckets
+(``take_tokens``/``refund_tokens``), breaker probe leases (``allow()``
+vs ``on_success``/``on_failure``), decode slots and the flight-recorder
+ring (declared for documentation; their ownership is engine-internal).
+The resource-lifecycle pass runs a path-sensitive paired checker over
+each function: an acquire that can leak through an exception edge or an
+early return — no ``finally``, no owner transfer — is flagged. Transfer
+follows the ``donation_prep`` idiom from the use-after-donate pass: a
+*consuming call is the sanctioned last touch*. Recognized transfers:
+declared transfer tails (the fleet/disagg PRs register page-export
+hand-offs here as first-class), a store into a ``self`` container
+(``self._slots[slot] = req`` — ownership moves to the object), and
+**caller protection** — every resolved call site of the leaking
+function sits in a ``try`` whose handler/finally transitively releases
+the protocol (the ``_admit`` catch-all that evicts-then-frees protects
+``_prefill``). Guard polarity is modeled: ``if not take_tokens(): return``
+acquires only *after* the guard; ``if take_tokens():`` holds only inside
+the body. Protocol implementation files audit their own internals and
+are exempt, like use-after-donate exempts ``fastpath/fused.py``.
+
+Pure stdlib ``ast`` — no JAX import, no device work, and the same
+deliberate conservatism as the rest of the whole-program layer: an
+unresolvable receiver contributes nothing, so no context spreads through
+a speculative edge. The runtime twin of the static lifecycle story is
+``MXNET_KVCACHE_AUDIT=1`` (``PagedKVCache.audit_check``), which re-proves
+the refcount invariant every engine tick.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import dotted_name
+from .graph import ProjectGraph, ClassInfo, FuncInfo, _own_nodes
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: Attribute/name tokens that mean "this object is a lock" — shared with
+#: the v2 races pass, plus semaphores.
+_LOCKISH = ("lock", "mutex", "cond", "_cv", "_mu", "sem")
+#: The subset that means "condition variable" (wait/notify protocol).
+_CVISH = ("cond", "cv")
+#: Predicate names through which a shutdown can wake an untimed wait.
+_SHUTDOWNISH = ("closed", "shutdown", "stop", "running", "done", "exit",
+                "quit", "alive", "dead", "drain")
+
+#: Device->host syncs and unbounded waits that must not run under a lock.
+_BLOCKING_CALL_TAILS = {
+    "fetch_host": "`fetch_host()` (device->host transfer)",
+    "device_get": "`device_get()` (device->host transfer)",
+    "sleep": "`time.sleep()`",
+    "jit_call": "jit dispatch (`telemetry.jit_call`)",
+}
+_BLOCKING_METHOD_TAILS = {
+    "asnumpy": "`.asnumpy()` (device->host transfer)",
+    "item": "`.item()` (device->host transfer)",
+    "tolist": "`.tolist()` (device->host transfer)",
+    "block_until_ready": "`.block_until_ready()`",
+    "wait_to_read": "`.wait_to_read()`",
+}
+#: `.join()` is blocking only on thread-ish receivers (str.join is not).
+_THREADISH = ("thread", "worker", "proc")
+#: `.get()` with no timeout is blocking only on queue-ish receivers.
+_QUEUEISH = ("queue", "_q")
+
+
+def _lockish(name: Optional[str]) -> bool:
+    low = (name or "").lower()
+    return any(t in low for t in _LOCKISH)
+
+
+def _cvish(name: Optional[str]) -> bool:
+    low = (name or "").lower()
+    return any(t in low for t in _CVISH)
+
+
+# ---------------------------------------------------------------------------
+# Resource protocols
+# ---------------------------------------------------------------------------
+
+class Protocol:
+    """One paired acquire/release resource discipline.
+
+    ``receiver_tokens`` gate the tail-name match to receivers that look
+    like the owning object (``self._cache.free()`` matches the KV
+    protocol, ``pool.free()`` does not). ``transfer_tails`` are the
+    sanctioned consuming last touches — the extension point where the
+    fleet/disagg PRs (ROADMAP 2b/4) register page-export hand-offs as
+    first-class transfers instead of leaks. ``impl_files`` audit their
+    own internals and are exempt from the checker.
+    """
+
+    __slots__ = ("name", "what", "acquire_tails", "release_tails",
+                 "transfer_tails", "receiver_tokens", "impl_files")
+
+    def __init__(self, name: str, what: str,
+                 acquire_tails: Tuple[str, ...],
+                 release_tails: Tuple[str, ...],
+                 transfer_tails: Tuple[str, ...],
+                 receiver_tokens: Tuple[str, ...],
+                 impl_files: Tuple[str, ...]):
+        self.name = name
+        self.what = what
+        self.acquire_tails = acquire_tails
+        self.release_tails = release_tails
+        self.transfer_tails = transfer_tails
+        self.receiver_tokens = receiver_tokens
+        self.impl_files = impl_files
+
+
+PROTOCOLS: Tuple[Protocol, ...] = (
+    Protocol("kv-page", "KV cache pages (CoW-refcounted)",
+             acquire_tails=("reserve", "admit_prefix"),
+             release_tails=("free", "reset_pools"),
+             # fleet/disagg page-export hand-offs register here
+             transfer_tails=("export_pages", "import_pages"),
+             receiver_tokens=("cache", "kv"),
+             impl_files=("mxnet_tpu/serving/kvcache.py",)),
+    Protocol("page-budget", "tenant page-budget charge",
+             acquire_tails=("charge_pages",),
+             release_tails=("release_pages",),
+             transfer_tails=(),
+             receiver_tokens=("tenant",),
+             impl_files=("mxnet_tpu/serving/tenancy.py",)),
+    Protocol("token-bucket", "tenant token-bucket charge",
+             acquire_tails=("take_tokens",),
+             release_tails=("refund_tokens",),
+             transfer_tails=(),
+             receiver_tokens=("tenant",),
+             impl_files=("mxnet_tpu/serving/tenancy.py",)),
+    Protocol("probe-lease", "breaker half-open probe lease",
+             acquire_tails=("allow",),
+             release_tails=("on_success", "on_failure"),
+             transfer_tails=(),
+             receiver_tokens=("breaker",),
+             impl_files=("mxnet_tpu/serving/tenancy.py",)),
+    # Declared for the protocol table (docs/resilience.md) but not
+    # checkable by paired call tails: decode slots are owned through
+    # `self._slots[i] = req` stores (the store IS the transfer) and the
+    # flight-recorder ring is an append-only atomic deque (no release).
+    Protocol("decode-slot", "decode engine slot",
+             acquire_tails=(), release_tails=("_release_slot",),
+             transfer_tails=(), receiver_tokens=(),
+             impl_files=("mxnet_tpu/serving/decode.py",)),
+    Protocol("flightrec-ring", "flight-recorder ring slot",
+             acquire_tails=(), release_tails=(),
+             transfer_tails=(), receiver_tokens=(),
+             impl_files=("mxnet_tpu/telemetry/flightrec.py",)),
+)
+
+
+# ---------------------------------------------------------------------------
+# Findings (thin records the four passes turn into core.Finding objects)
+# ---------------------------------------------------------------------------
+
+class Rec:
+    """One reportable site: an ast node (for the line) + a message that
+    is stable under refactors (no line numbers, no full chains — the
+    baseline keys embed the message)."""
+
+    __slots__ = ("node", "_msg")
+
+    def __init__(self, node: ast.AST, msg: str):
+        self.node = node
+        self._msg = msg
+
+    def message(self) -> str:
+        return self._msg
+
+
+def _fname(info: FuncInfo) -> str:
+    return info.name if info.cls is None else "%s.%s" % (info.cls, info.name)
+
+
+def _is_property(fn_node: ast.AST) -> bool:
+    for dec in getattr(fn_node, "decorator_list", ()):
+        d = dotted_name(dec) or ""
+        if d.rsplit(".", 1)[-1] in ("property", "cached_property"):
+            return True
+    return False
+
+
+def _ann_class_name(ann: Optional[ast.AST]) -> Optional[str]:
+    """The class name an annotation points at: ``Tenant``,
+    ``"_DecodeRequest"`` (string form), ``Optional[Tenant]``."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.rsplit(".", 1)[-1].strip("'\" ")
+    if isinstance(ann, ast.Subscript):
+        base = dotted_name(ann.value) or ""
+        if base.rsplit(".", 1)[-1] in ("Optional", "Final", "ClassVar"):
+            return _ann_class_name(ann.slice)
+        return None
+    d = dotted_name(ann)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+class LockAnalysis:
+    """One whole-program concurrency/lifecycle interpretation. Results
+    are per-relpath lists of :class:`Rec`, consumed by the four thin
+    passes (lock-order-cycle, blocking-under-lock, cv-protocol,
+    resource-lifecycle)."""
+
+    def __init__(self, graph: ProjectGraph):
+        self.graph = graph
+        self.cycle_findings: Dict[str, List[Rec]] = {}
+        self.blocking_findings: Dict[str, List[Rec]] = {}
+        self.cv_findings: Dict[str, List[Rec]] = {}
+        self.lifecycle_findings: Dict[str, List[Rec]] = {}
+
+        # type layer
+        self._attr_types: Dict[Tuple[str, str], str] = {}   # (cls, attr) -> cls
+        self._fn_env: Dict[ast.AST, Dict[str, str]] = {}    # name -> cls
+        # extended call resolution
+        self._call_targets: Dict[ast.AST, List[FuncInfo]] = {}
+        self._cb_targets: Dict[ast.AST, List[FuncInfo]] = {}
+        self._prop_targets: Dict[ast.AST, FuncInfo] = {}
+        self._callers: Dict[ast.AST, List[Tuple[FuncInfo, ast.AST]]] = {}
+        # per-function facts from the lexical walk
+        self._direct_acquires: Dict[ast.AST, Dict[str, ast.AST]] = {}
+        self._direct_blocks: Dict[ast.AST, Dict[str, ast.AST]] = {}
+        self._calls_held: Dict[ast.AST, List[Tuple[ast.AST, Tuple[str, ...]]]] = {}
+        self._with_edges: List[Tuple[str, str, ast.AST, FuncInfo]] = []
+        # propagated summaries
+        self._may_acquire: Dict[ast.AST, Dict[str, Optional[FuncInfo]]] = {}
+        self._may_block: Dict[ast.AST, Dict[str, Optional[FuncInfo]]] = {}
+        self._may_release: Dict[ast.AST, Set[str]] = {}
+        self._entry_held: Dict[ast.AST, Set[str]] = {}
+        # acquisition graph: (src, dst) -> (witness node, holder FuncInfo,
+        #                                   description of how dst is taken)
+        self.lock_edges: Dict[Tuple[str, str], Tuple[ast.AST, FuncInfo, str]] = {}
+
+        self._funcs = sorted(graph.funcs.values(), key=lambda i: i.qname)
+        self._collect_attr_types()
+        self._resolve_calls()
+        self._walk_all()
+        self._propagate_summaries()
+        self._build_call_edges()
+        self._propagate_entry_held()
+        self._find_cycles()
+        self._find_blocking()
+        self._find_cv()
+        self._find_lifecycle()
+
+    # -- type layer ---------------------------------------------------------
+
+    def _cinfo(self, cls_name: Optional[str],
+               module: Optional[str] = None) -> Optional[ClassInfo]:
+        if not cls_name:
+            return None
+        cands = self.graph.classes_by_name.get(cls_name, ())
+        if not cands:
+            return None
+        if module:
+            for c in cands:
+                if c.module == module:
+                    return c
+        return cands[0]
+
+    def _collect_attr_types(self) -> None:
+        """``(class, attr) -> class`` from constructor stores
+        (``self.X = ClassName(...)``), annotated-parameter stores
+        (``self.X = param`` with ``param: Cls``) and annotated class
+        fields (dataclass rows)."""
+        for cands in self.graph.classes_by_name.values():
+            for cinfo in cands:
+                for stmt in cinfo.node.body:
+                    if isinstance(stmt, ast.AnnAssign) \
+                            and isinstance(stmt.target, ast.Name):
+                        cname = _ann_class_name(stmt.annotation)
+                        if self._cinfo(cname) is not None:
+                            self._attr_types[(cinfo.name, stmt.target.id)] \
+                                = cname
+                for m in cinfo.methods.values():
+                    params = self._param_anns(m.node)
+                    for node in _own_nodes(m.node):
+                        if not isinstance(node, ast.Assign):
+                            continue
+                        for tgt in node.targets:
+                            if not (isinstance(tgt, ast.Attribute)
+                                    and isinstance(tgt.value, ast.Name)
+                                    and tgt.value.id == "self"):
+                                continue
+                            cname = None
+                            if isinstance(node.value, ast.Call):
+                                cname = self._ctor_class(node.value, m)
+                            elif isinstance(node.value, ast.Name):
+                                cname = params.get(node.value.id)
+                            if cname and self._cinfo(cname) is not None:
+                                self._attr_types.setdefault(
+                                    (cinfo.name, tgt.attr), cname)
+
+    @staticmethod
+    def _param_anns(fn_node: ast.AST) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        args = getattr(fn_node, "args", None)
+        if args is None:
+            return out
+        for a in list(args.posonlyargs) + list(args.args) \
+                + list(args.kwonlyargs):
+            cname = _ann_class_name(a.annotation)
+            if cname:
+                out[a.arg] = cname
+        return out
+
+    def _ctor_class(self, call: ast.Call, info: FuncInfo) -> Optional[str]:
+        d = dotted_name(call.func)
+        if not d:
+            return None
+        tail = d.rsplit(".", 1)[-1]
+        return tail if tail in self.graph.classes_by_name else None
+
+    def _env_of(self, info: FuncInfo) -> Dict[str, str]:
+        env = self._fn_env.get(info.node)
+        if env is None:
+            env = dict(self._param_anns(info.node))
+            for node in _own_nodes(info.node):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Call):
+                    cname = self._ctor_class(node.value, info)
+                    if cname:
+                        env.setdefault(node.targets[0].id, cname)
+            self._fn_env[info.node] = env
+        return env
+
+    def _class_of_expr(self, expr: ast.AST, info: FuncInfo) -> Optional[str]:
+        """The class NAME of an expression's value, or None."""
+        if isinstance(expr, ast.Name):
+            if expr.id in ("self", "cls"):
+                return info.cls
+            return self._env_of(info).get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = self._class_of_expr(expr.value, info)
+            if base is None:
+                return None
+            return self._attr_types.get((base, expr.attr))
+        if isinstance(expr, ast.Call):
+            return self._ctor_class(expr, info)
+        return None
+
+    # -- extended call resolution -------------------------------------------
+
+    def _resolve_calls(self) -> None:
+        g = self.graph
+        for info in self._funcs:
+            minfo = g.modules.get(info.module)
+            if minfo is None:
+                continue
+            fstack = g._enclosing_stack(info.node)
+            for node in _own_nodes(info.node):
+                if isinstance(node, ast.Call):
+                    targets = list(g._resolve_ref(minfo, info.cls, fstack,
+                                                  node.func, as_call=True))
+                    if not targets and isinstance(node.func, ast.Attribute):
+                        cname = self._class_of_expr(node.func.value, info)
+                        cinfo = self._cinfo(cname, info.module)
+                        if cinfo is not None:
+                            m = g._method_of(cinfo, node.func.attr)
+                            if m is not None:
+                                targets = [m]
+                    if targets:
+                        self._call_targets[node] = targets
+                    # callback-reference arguments: a method handed to a
+                    # call may be invoked by it (the weighted-fair pick
+                    # runs `_admit_guard` under the engine CV)
+                    cbs: List[FuncInfo] = []
+                    for arg in list(node.args) \
+                            + [kw.value for kw in node.keywords]:
+                        if isinstance(arg, (ast.Name, ast.Attribute)):
+                            cbs.extend(g._resolve_ref(minfo, info.cls, fstack,
+                                                      arg, as_call=False))
+                    if cbs:
+                        self._cb_targets[node] = cbs
+                elif isinstance(node, ast.Attribute) \
+                        and isinstance(node.ctx, ast.Load):
+                    parent = getattr(node, "tpulint_parent", None)
+                    if isinstance(parent, ast.Call) and parent.func is node:
+                        continue  # call receiver, handled above
+                    cname = self._class_of_expr(node.value, info)
+                    cinfo = self._cinfo(cname, info.module)
+                    if cinfo is not None:
+                        m = g._method_of(cinfo, node.attr)
+                        if m is not None and _is_property(m.node):
+                            self._prop_targets[node] = m
+        # reverse map for caller-protection analysis
+        for info in self._funcs:
+            for node in _own_nodes(info.node):
+                for t in self._targets_at(node):
+                    self._callers.setdefault(t.node, []).append((info, node))
+
+    def _targets_at(self, node: ast.AST) -> List[FuncInfo]:
+        out = list(self._call_targets.get(node, ()))
+        out.extend(self._cb_targets.get(node, ()))
+        prop = self._prop_targets.get(node)
+        if prop is not None:
+            out.append(prop)
+        return out
+
+    # -- lock identity ------------------------------------------------------
+
+    def _lock_id(self, dotted: str, info: FuncInfo) -> str:
+        parts = dotted.split(".")
+        if parts[0] in ("self", "cls") and info.cls:
+            if len(parts) == 2:
+                return "%s.%s" % (info.cls, parts[1])
+            # self.a.b -> type of self.a
+            base: Optional[str] = info.cls
+            for attr in parts[1:-1]:
+                base = self._attr_types.get((base, attr)) if base else None
+            if base:
+                return "%s.%s" % (base, parts[-1])
+        elif len(parts) >= 2:
+            base = self._class_of_expr_path(parts[:-1], info)
+            if base:
+                return "%s.%s" % (base, parts[-1])
+        # module-scoped fallback: `with _ENGINE_LOCK:` / unresolved recv
+        return "%s.%s" % (info.module.rsplit(".", 1)[-1], dotted)
+
+    def _class_of_expr_path(self, parts: Sequence[str],
+                            info: FuncInfo) -> Optional[str]:
+        base = self._env_of(info).get(parts[0])
+        for attr in parts[1:]:
+            if base is None:
+                return None
+            base = self._attr_types.get((base, attr))
+        return base
+
+    def _with_lock_ids(self, node: ast.AST,
+                       info: FuncInfo) -> List[Tuple[str, str]]:
+        """``(lock_id, dotted_text)`` for each lockish item of a With."""
+        out = []
+        for item in node.items:
+            d = dotted_name(item.context_expr)
+            if d and _lockish(d.rsplit(".", 1)[-1]):
+                out.append((self._lock_id(d, info), d))
+        return out
+
+    # -- lexical walk: direct acquires, blocks, calls-under-lock ------------
+
+    def _walk_all(self) -> None:
+        for info in self._funcs:
+            acquires: Dict[str, ast.AST] = {}
+            blocks: Dict[str, ast.AST] = {}
+            calls: List[Tuple[ast.AST, Tuple[str, ...]]] = []
+
+            def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    ids = self._with_lock_ids(node, info)
+                    for lid, _d in ids:
+                        acquires.setdefault(lid, node)
+                        for h in held:
+                            if h != lid:
+                                self._add_edge(h, lid, node, info,
+                                               "`with` block")
+                    inner = held + tuple(lid for lid, _d in ids
+                                         if lid not in held)
+                    for item in node.items:
+                        visit(item.context_expr, held)
+                    for stmt in node.body:
+                        visit(stmt, inner)
+                    return
+                if isinstance(node, ast.Call):
+                    desc = self._blocking_desc(node, info)
+                    if desc is not None:
+                        blocks.setdefault(desc, node)
+                    tail = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+                    if tail == "acquire" and isinstance(node.func,
+                                                       ast.Attribute):
+                        recv = dotted_name(node.func.value)
+                        if recv and _lockish(recv.rsplit(".", 1)[-1]):
+                            lid = self._lock_id(recv, info)
+                            acquires.setdefault(lid, node)
+                            for h in held:
+                                if h != lid:
+                                    self._add_edge(h, lid, node, info,
+                                                   "`.acquire()`")
+                    if held and (self._targets_at(node)
+                                 or desc is not None):
+                        calls.append((node, held))
+                elif isinstance(node, ast.Attribute) \
+                        and node in self._prop_targets and held:
+                    calls.append((node, held))
+                visit_children(node, held)
+
+            def visit_children(node: ast.AST, held: Tuple[str, ...]) -> None:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef, ast.Lambda)):
+                        continue
+                    visit(child, held)
+
+            body = info.node.body if isinstance(info.node, _FUNC_DEFS) \
+                else [info.node.body]
+            for stmt in body:
+                visit(stmt, ())
+            if acquires:
+                self._direct_acquires[info.node] = acquires
+            if blocks:
+                self._direct_blocks[info.node] = blocks
+            if calls:
+                self._calls_held[info.node] = calls
+
+    def _blocking_desc(self, node: ast.Call,
+                       info: FuncInfo) -> Optional[str]:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _BLOCKING_METHOD_TAILS:
+            return _BLOCKING_METHOD_TAILS[node.func.attr]
+        d = dotted_name(node.func) or ""
+        tail = d.rsplit(".", 1)[-1]
+        if tail in _BLOCKING_CALL_TAILS:
+            # `sleep` must be time.sleep or a bare sleep, not e.g.
+            # `backoff.sleep` helpers with their own discipline
+            if tail == "sleep" and "." in d and not d.startswith("time."):
+                return None
+            return _BLOCKING_CALL_TAILS[tail]
+        if isinstance(node.func, ast.Attribute):
+            recv = (dotted_name(node.func.value) or "").rsplit(".", 1)[-1]
+            low = recv.lower()
+            if tail == "join" and any(t in low for t in _THREADISH):
+                return "`.join()` on a thread"
+            if tail == "get" and (any(t in low for t in _QUEUEISH)
+                                  or low == "q"):
+                timed = any(kw.arg == "timeout" for kw in node.keywords) \
+                    or len(node.args) >= 2
+                if not timed:
+                    return "`queue.get()` with no timeout"
+        # dispatch of a directly jit-wrapped project function
+        for t in self._call_targets.get(node, ()):
+            tup = self.graph._traced.get(t.node)
+            if tup is not None and tup[1] is None and tup[2] == 0:
+                return "jit dispatch (traced `%s`)" % _fname(t)
+        return None
+
+    # -- propagation --------------------------------------------------------
+
+    def _propagate_summaries(self) -> None:
+        """Bottom-up may-acquire / may-block / may-release closure over
+        call edges, iterated to the graph's depth bound. ``via`` records
+        the first callee that leads to the fact, for witness chains."""
+        callees: Dict[ast.AST, List[FuncInfo]] = {}
+        for info in self._funcs:
+            outs: List[FuncInfo] = []
+            seen: Set[ast.AST] = set()
+            for node in _own_nodes(info.node):
+                for t in self._targets_at(node):
+                    if t.node not in seen:
+                        seen.add(t.node)
+                        outs.append(t)
+            callees[info.node] = outs
+
+        for info in self._funcs:
+            self._may_acquire[info.node] = {
+                lid: None for lid in self._direct_acquires.get(info.node, ())}
+            self._may_block[info.node] = {
+                d: None for d in self._direct_blocks.get(info.node, ())}
+            rel: Set[str] = set()
+            for node in _own_nodes(info.node):
+                if isinstance(node, ast.Call):
+                    for proto in PROTOCOLS:
+                        if self._proto_call(node, proto, "release") \
+                                or self._proto_call(node, proto, "transfer"):
+                            rel.add(proto.name)
+            self._may_release[info.node] = rel
+
+        for _round in range(self.graph.depth):
+            changed = False
+            for info in self._funcs:
+                acq = self._may_acquire[info.node]
+                blk = self._may_block[info.node]
+                rel = self._may_release[info.node]
+                for callee in callees[info.node]:
+                    for lid in self._may_acquire.get(callee.node, ()):
+                        if lid not in acq:
+                            acq[lid] = callee
+                            changed = True
+                    for d in self._may_block.get(callee.node, ()):
+                        if d not in blk:
+                            blk[d] = callee
+                            changed = True
+                    new_rel = self._may_release.get(callee.node, set()) - rel
+                    if new_rel:
+                        rel |= new_rel
+                        changed = True
+            if not changed:
+                break
+
+    def _chain(self, start: FuncInfo, key: str,
+               table: Dict[ast.AST, Dict[str, Optional[FuncInfo]]]
+               ) -> List[str]:
+        names = [_fname(start)]
+        cur = start
+        for _ in range(self.graph.depth):
+            via = table.get(cur.node, {}).get(key)
+            if via is None:
+                break
+            names.append(_fname(via))
+            cur = via
+        return names
+
+    def _build_call_edges(self) -> None:
+        """Acquisition-graph edges through calls: target (or callback)
+        transitively acquires a lock while another is lexically held."""
+        for info in self._funcs:
+            for node, held in self._calls_held.get(info.node, ()):
+                for t in self._targets_at(node):
+                    for lid, _via in sorted(
+                            self._may_acquire.get(t.node, {}).items()):
+                        for h in held:
+                            if h != lid:
+                                chain = self._chain(t, lid,
+                                                    self._may_acquire)
+                                self._add_edge(
+                                    h, lid, node, info,
+                                    "call into `%s`" % " -> ".join(chain))
+
+    def _add_edge(self, src: str, dst: str, node: ast.AST,
+                  info: FuncInfo, how: str) -> None:
+        if (src, dst) not in self.lock_edges:
+            self.lock_edges[(src, dst)] = (node, info, how)
+
+    def _propagate_entry_held(self) -> None:
+        """The held-lock context lattice: locks possibly held on entry to
+        each function, seeded at call sites inside ``with`` blocks and
+        closed over call edges (monotone; bounded by lock count)."""
+        for info in self._funcs:
+            self._entry_held.setdefault(info.node, set())
+        for _round in range(self.graph.depth):
+            changed = False
+            for info in self._funcs:
+                base = self._entry_held[info.node]
+                for node, held in self._calls_held.get(info.node, ()):
+                    out = base | set(held)
+                    for t in self._targets_at(node):
+                        tgt = self._entry_held.get(t.node)
+                        if tgt is None:
+                            continue
+                        new = out - tgt
+                        if new:
+                            tgt |= new
+                            changed = True
+                # calls NOT under a lexical lock still propagate the
+                # caller's entry context
+                if base:
+                    for node in _own_nodes(info.node):
+                        for t in self._targets_at(node):
+                            tgt = self._entry_held.get(t.node)
+                            if tgt is not None and not base <= tgt:
+                                tgt |= base
+                                changed = True
+            if not changed:
+                break
+
+    # -- lock-order-cycle ---------------------------------------------------
+
+    def _find_cycles(self) -> None:
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.lock_edges:
+            adj.setdefault(a, set()).add(b)
+        reported: Set[Tuple[str, ...]] = set()
+        for (a, b) in sorted(self.lock_edges):
+            path = self._path(adj, b, a)
+            if path is None:
+                continue
+            cyc = tuple(sorted(set([a, b] + path)))
+            if cyc in reported:
+                continue
+            reported.add(cyc)
+            n1, i1, how1 = self.lock_edges[(a, b)]
+            # `path` is the return route b -> ... -> a; the witness for
+            # the reverse direction is b's first hop along it
+            back = path[1] if len(path) > 1 else a
+            n2, i2, how2 = self.lock_edges[(b, back)]
+            msg = ("lock-order cycle: `%s` -> `%s` in `%s` (%s) but "
+                   "`%s` -> `%s` in `%s` (%s) — two threads acquiring in "
+                   "opposite orders deadlock on first interleave"
+                   % (a, b, _fname(i1), how1,
+                      b, back, _fname(i2), how2))
+            self.cycle_findings.setdefault(i1.relpath, []).append(
+                Rec(n1, msg))
+
+    @staticmethod
+    def _path(adj: Dict[str, Set[str]], src: str,
+              dst: str) -> Optional[List[str]]:
+        """Shortest src->dst node path (edge targets only), or None."""
+        from collections import deque
+        q = deque([(src, [])])
+        seen = {src}
+        while q:
+            cur, path = q.popleft()
+            if cur == dst:
+                return path + [cur] if path or src == dst else [cur]
+            for nxt in sorted(adj.get(cur, ())):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    q.append((nxt, path + [cur]))
+        return None
+
+    # -- blocking-under-lock ------------------------------------------------
+
+    def _find_blocking(self) -> None:
+        for info in self._funcs:
+            for node, held in self._calls_held.get(info.node, ()):
+                lock = held[-1]  # innermost guard
+                direct = self._blocking_desc(node, info) \
+                    if isinstance(node, ast.Call) else None
+                if direct is not None:
+                    msg = ("%s runs with `%s` held — every thread waiting "
+                           "on the lock stalls for the full device/host "
+                           "round trip" % (direct, lock))
+                    self.blocking_findings.setdefault(
+                        info.relpath, []).append(Rec(node, msg))
+                    continue
+                for t in self._targets_at(node):
+                    blk = self._may_block.get(t.node)
+                    if not blk:
+                        continue
+                    desc = sorted(blk)[0]
+                    chain = self._chain(t, desc, self._may_block)
+                    msg = ("%s is reachable with `%s` held (via `%s`) — "
+                           "a blocking call inside the critical section "
+                           "stalls every waiter"
+                           % (desc, lock, " -> ".join(chain)))
+                    self.blocking_findings.setdefault(
+                        info.relpath, []).append(Rec(node, msg))
+                    break  # one finding per call site
+
+    # -- cv-protocol --------------------------------------------------------
+
+    def _find_cv(self) -> None:
+        for info in self._funcs:
+            entry = self._entry_held.get(info.node, set())
+            for node in _own_nodes(info.node):
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Attribute):
+                    continue
+                recv = dotted_name(node.func.value)
+                if not recv or not _cvish(recv.rsplit(".", 1)[-1]):
+                    continue
+                tail = node.func.attr
+                if tail == "wait":
+                    self._check_wait(node, recv, info)
+                elif tail in ("notify", "notify_all"):
+                    lid = self._lock_id(recv, info)
+                    held = entry | set(self._lexical_held(node, info))
+                    if lid not in held:
+                        msg = ("`%s.%s()` without `%s` held — notify "
+                               "requires the CV's lock; an unlocked "
+                               "notify races the predicate check and "
+                               "loses wakeups" % (recv, tail, lid))
+                        self.cv_findings.setdefault(
+                            info.relpath, []).append(Rec(node, msg))
+
+    def _check_wait(self, node: ast.Call, recv: str, info: FuncInfo) -> None:
+        loop = None
+        cur = getattr(node, "tpulint_parent", None)
+        while cur is not None and not isinstance(cur, _FUNC_DEFS):
+            if isinstance(cur, ast.While):
+                loop = cur
+                break
+            cur = getattr(cur, "tpulint_parent", None)
+        if loop is None:
+            msg = ("bare `%s.wait()` outside a `while`-predicate loop — "
+                   "spurious wakeups and missed notifies make an "
+                   "unlooped wait return with the predicate false"
+                   % recv)
+            self.cv_findings.setdefault(info.relpath, []).append(
+                Rec(node, msg))
+            return
+        timed = bool(node.args) or any(kw.arg == "timeout"
+                                       for kw in node.keywords)
+        if timed:
+            return
+        toks: Set[str] = set()
+        for sub in ast.walk(loop.test):
+            if isinstance(sub, ast.Name):
+                toks.add(sub.id.lower())
+            elif isinstance(sub, ast.Attribute):
+                toks.add(sub.attr.lower())
+        if not any(any(s in t for s in _SHUTDOWNISH) for t in toks):
+            msg = ("untimed `%s.wait()` whose loop predicate observes no "
+                   "shutdown flag — close() cannot wake it and the "
+                   "owning thread never joins" % recv)
+            self.cv_findings.setdefault(info.relpath, []).append(
+                Rec(node, msg))
+
+    def _lexical_held(self, node: ast.AST, info: FuncInfo) -> List[str]:
+        held: List[str] = []
+        cur = getattr(node, "tpulint_parent", None)
+        while cur is not None and not isinstance(cur, _FUNC_DEFS):
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                held.extend(lid for lid, _d in
+                            self._with_lock_ids(cur, info))
+            cur = getattr(cur, "tpulint_parent", None)
+        return held
+
+    # -- resource-lifecycle -------------------------------------------------
+
+    def _proto_call(self, node: ast.Call, proto: Protocol,
+                    kind: str) -> bool:
+        tails = {"acquire": proto.acquire_tails,
+                 "release": proto.release_tails,
+                 "transfer": proto.transfer_tails}[kind]
+        if not isinstance(node.func, ast.Attribute) \
+                or node.func.attr not in tails:
+            return False
+        if not proto.receiver_tokens:
+            return True
+        recv = (dotted_name(node.func.value) or "").rsplit(".", 1)[-1]
+        low = recv.lower()
+        return any(t in low for t in proto.receiver_tokens)
+
+    def _find_lifecycle(self) -> None:
+        for info in self._funcs:
+            for proto in PROTOCOLS:
+                if not proto.acquire_tails:
+                    continue
+                if info.relpath in proto.impl_files:
+                    continue
+                self._check_protocol(info, proto)
+
+    def _check_protocol(self, info: FuncInfo, proto: Protocol) -> None:
+        acquires: List[ast.Call] = []
+        releases: List[ast.AST] = []
+        transfers: List[ast.AST] = []
+        for node in _own_nodes(info.node):
+            if isinstance(node, ast.Call):
+                if self._proto_call(node, proto, "acquire"):
+                    acquires.append(node)
+                elif self._proto_call(node, proto, "release") \
+                        or self._proto_call(node, proto, "transfer"):
+                    releases.append(node)
+                else:
+                    for t in self._call_targets.get(node, ()):
+                        if proto.name in self._may_release.get(t.node, ()):
+                            releases.append(node)
+                            break
+            elif isinstance(node, ast.Assign):
+                # `self._slots[slot] = req`: ownership moves into a
+                # container the object releases later (the sanctioned
+                # consuming last touch)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript) \
+                            and isinstance(tgt.value, ast.Attribute) \
+                            and isinstance(tgt.value.value, ast.Name) \
+                            and tgt.value.value.id == "self" \
+                            and not (isinstance(node.value, ast.Constant)
+                                     and node.value.value is None):
+                        transfers.append(node)
+        if not acquires:
+            return
+        for acq in acquires:
+            self._check_acquire(info, proto, acq, releases, transfers)
+
+    def _check_acquire(self, info: FuncInfo, proto: Protocol,
+                       acq: ast.Call, releases: List[ast.AST],
+                       transfers: List[ast.AST]) -> None:
+        if self._try_protected(acq, proto):
+            return
+        eff = self._effective_line(acq)
+        rel_after = sorted(n.lineno for n in releases + transfers
+                           if n.lineno >= eff)
+        if not rel_after:
+            if self._caller_protected(info, proto):
+                return
+            msg = ("`%s.%s()` acquires %s released on no path of `%s` — "
+                   "an exception or return here leaks the resource; "
+                   "release in `finally` or hand off through a declared "
+                   "transfer" % (self._recv_text(acq), acq.func.attr,
+                                 proto.what, _fname(info)))
+            self.lifecycle_findings.setdefault(info.relpath, []).append(
+                Rec(acq, msg))
+            return
+        first_rel = rel_after[0]
+        hazard = self._hazard_between(info, proto, eff, first_rel,
+                                      releases, transfers)
+        if hazard is None:
+            return
+        if self._caller_protected(info, proto):
+            return
+        msg = ("%s between `%s.%s()` and its release leaks %s on the "
+               "exception edge in `%s` — wrap the release in `finally` "
+               "or let a caller-side handler own the cleanup"
+               % (hazard, self._recv_text(acq), acq.func.attr,
+                  proto.what, _fname(info)))
+        self.lifecycle_findings.setdefault(info.relpath, []).append(
+            Rec(acq, msg))
+
+    @staticmethod
+    def _recv_text(acq: ast.Call) -> str:
+        return dotted_name(acq.func.value) or "<recv>"
+
+    @staticmethod
+    def _effective_line(acq: ast.Call) -> int:
+        """Guard polarity: in ``if not take(): return`` the resource is
+        live only after the If; in ``if take(): ...`` only inside the
+        body (approximated by the call line)."""
+        cur = getattr(acq, "tpulint_parent", None)
+        while cur is not None and not isinstance(cur, _FUNC_DEFS):
+            if isinstance(cur, ast.If) and _contains(cur.test, acq):
+                if isinstance(cur.test, ast.UnaryOp) \
+                        and isinstance(cur.test.op, ast.Not):
+                    return getattr(cur, "end_lineno", cur.lineno)
+                return acq.lineno
+            cur = getattr(cur, "tpulint_parent", None)
+        return acq.lineno
+
+    def _hazard_between(self, info: FuncInfo, proto: Protocol, eff: int,
+                        first_rel: int, releases: List[ast.AST],
+                        transfers: List[ast.AST]) -> Optional[str]:
+        """A raiser/early-exit strictly between the (effective) acquire
+        and the first release — the leak window."""
+        rel_lines = {n.lineno for n in releases + transfers}
+        for node in _own_nodes(info.node):
+            line = getattr(node, "lineno", None)
+            if line is None or not (eff < line < first_rel):
+                continue
+            if isinstance(node, (ast.Return, ast.Raise)) \
+                    and not self._in_try_with_cleanup(node, proto):
+                return "an early `%s`" % type(node).__name__.lower()
+            if isinstance(node, ast.Call) and line not in rel_lines \
+                    and not self._is_cleanup_call(node) \
+                    and not self._in_try_with_cleanup(node, proto):
+                return "a call that may raise"
+        return None
+
+    def _is_cleanup_call(self, node: ast.Call) -> bool:
+        """Release/transfer of ANY protocol — a handler's
+        evict-then-free sequence is cleanup, not a new hazard."""
+        for p in PROTOCOLS:
+            if self._proto_call(node, p, "release") \
+                    or self._proto_call(node, p, "transfer"):
+                return True
+        for t in self._call_targets.get(node, ()):
+            if self._may_release.get(t.node):
+                return True
+        return False
+
+    def _try_protected(self, node: ast.AST, proto: Protocol) -> bool:
+        """Acquire inside a try whose finally/handler (transitively)
+        releases the protocol."""
+        cur = getattr(node, "tpulint_parent", None)
+        prev = node
+        while cur is not None and not isinstance(cur, _FUNC_DEFS):
+            if isinstance(cur, ast.Try) and self._stmt_in(cur.body, prev):
+                if self._cleanup_releases(cur, proto):
+                    return True
+            prev = cur
+            cur = getattr(cur, "tpulint_parent", None)
+        return False
+
+    _in_try_with_cleanup = _try_protected
+
+    @staticmethod
+    def _stmt_in(body: Sequence[ast.AST], node: ast.AST) -> bool:
+        cur = node
+        while cur is not None:
+            if cur in body:
+                return True
+            cur = getattr(cur, "tpulint_parent", None)
+        return False
+
+    def _cleanup_releases(self, try_node: ast.Try, proto: Protocol) -> bool:
+        bodies = [try_node.finalbody] + [h.body for h in try_node.handlers]
+        for body in bodies:
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if self._proto_call(node, proto, "release") \
+                            or self._proto_call(node, proto, "transfer"):
+                        return True
+                    for t in self._call_targets.get(node, ()):
+                        if proto.name in self._may_release.get(t.node, ()):
+                            return True
+        return False
+
+    def _caller_protected(self, info: FuncInfo, proto: Protocol) -> bool:
+        """Every resolved call site of `info` sits in a try whose
+        handler/finally transitively releases the protocol — the
+        ``_admit`` catch-all-evict-then-free idiom."""
+        sites = self._callers.get(info.node)
+        if not sites:
+            return False
+        return all(self._try_protected(node, proto)
+                   for _caller, node in sites)
+
+
+def _contains(root: ast.AST, target: ast.AST) -> bool:
+    for node in ast.walk(root):
+        if node is target:
+            return True
+    return False
+
+
+def analyze(graph: ProjectGraph) -> LockAnalysis:
+    """The memoized entry point: one interpretation per ProjectGraph,
+    shared by the four concurrency passes (the shape-engine pattern)."""
+    ana = getattr(graph, "_tpulint_lock_analysis", None)
+    if ana is None:
+        ana = LockAnalysis(graph)
+        graph._tpulint_lock_analysis = ana
+    return ana

@@ -1,0 +1,48 @@
+"""blocking-under-lock: device round trips and unbounded waits inside
+critical sections.
+
+The decode engine's tick discipline is that ``_cv`` guards *bookkeeping
+only* — batch swaps, slot maps, queue state — and every device->host
+fetch, jit dispatch, sleep, and thread join happens outside it. One
+violation serializes the whole plane: a ``fetch_host()`` under the CV
+stalls submit(), close(), the SLO sampler, and every other waiter for a
+full device round trip, and under load that reads as a tail-latency
+cliff with no obvious owner.
+
+This pass checks the discipline statically. The concurrency interpreter
+(:mod:`tools.tpulint.locks`) knows which locks are lexically held at
+every call site, and propagates a may-block summary bottom-up through
+the call graph, so the flagged site is the lock-holding frame even when
+the blocking call is buried two helpers deep (the finding names the
+witness chain). Blocking operations: ``fetch_host`` / ``device_get`` /
+``.asnumpy()`` / ``.item()`` / ``.tolist()`` / ``.block_until_ready()``
+/ ``.wait_to_read()``, dispatch of a jit-wrapped project function,
+``time.sleep``, ``queue.get()`` with no timeout, and ``.join()`` on a
+thread-ish receiver (``str.join`` is not blocking and is never flagged).
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..core import FileContext, Finding, Pass, register
+from .. import locks
+
+
+@register
+class BlockingUnderLockPass(Pass):
+    name = "blocking-under-lock"
+    description = ("device->host syncs, jit dispatch, sleeps and unbounded "
+                   "waits reachable with a lock held — serializes every "
+                   "waiter on the critical section")
+    project = True
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        graph = ctx.project
+        if graph is None:
+            return
+        ana = locks.analyze(graph)
+        for rec in ana.blocking_findings.get(ctx.relpath, ()):
+            yield ctx.finding(rec.node, self.name, rec.message())

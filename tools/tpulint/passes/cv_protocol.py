@@ -1,0 +1,52 @@
+"""cv-protocol: condition-variable usage that races its own predicate.
+
+Three CV misuses that all present as rare hangs:
+
+- **bare wait** — ``cv.wait()`` outside a ``while``-predicate loop.
+  Spurious wakeups are allowed by every CV implementation and a notify
+  can land between the predicate check and the wait; an unlooped wait
+  returns with the predicate false and the caller proceeds on stale
+  state.
+- **unwakeable wait** — an *untimed* wait whose loop predicate observes
+  no shutdown flag. ``close()`` has no way to wake the thread, so the
+  owning ``join()`` blocks forever — the worker-leak shape the elastic
+  plane's shutdown paths are designed against. A timeout bounds the
+  hang; a ``_closed``-style flag in the predicate (re-checked on every
+  wakeup) ends it.
+- **unlocked notify** — ``cv.notify()`` / ``notify_all()`` without the
+  CV's lock held. CPython raises for a genuinely unheld notify, but the
+  static check also catches the subtler version: notify under the
+  *wrong* lock, which races the waiter's predicate check and loses
+  wakeups. Held-ness is judged on the lexical ``with`` stack plus the
+  held-lock entry lattice, so a notify helper called under the CV is
+  clean.
+
+Receivers are matched by CV-ish name tokens (``cond``/``cv``), the same
+naming-convention contract the v2 races pass uses for lock-ish names.
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..core import FileContext, Finding, Pass, register
+from .. import locks
+
+
+@register
+class CvProtocolPass(Pass):
+    name = "cv-protocol"
+    description = ("condition-variable protocol violations: bare wait "
+                   "outside a while-loop, untimed wait no shutdown flag "
+                   "can wake, notify without the CV's lock")
+    project = True
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        graph = ctx.project
+        if graph is None:
+            return
+        ana = locks.analyze(graph)
+        for rec in ana.cv_findings.get(ctx.relpath, ()):
+            yield ctx.finding(rec.node, self.name, rec.message())

@@ -1,0 +1,46 @@
+"""lock-order-cycle: static deadlock detection over the acquisition graph.
+
+The serving stack's locks form a documented one-way hierarchy — the
+decode engine's CV may reach into tenant and breaker locks (the
+weighted-fair admission callback runs under it), tenant/breaker locks
+may reach into telemetry, and nothing points back. This pass proves
+that hierarchy instead of trusting it: the concurrency interpreter
+(:mod:`tools.tpulint.locks`) resolves every ``with <lock>:`` /
+``.acquire()`` site to a per-class lock identity and adds an edge
+``A -> B`` whenever B is taken while A is held — directly, through a
+bounded-depth call chain, or through a callback reference passed as an
+argument. A cycle between any two lock classes is a *static deadlock*:
+two threads acquiring in opposite orders need only interleave once, and
+the resulting hang is the exact shape the flight recorder can only
+autopsy after the fact.
+
+The finding carries both witness directions (function + how each
+forward edge is realized). Same-class self-edges are never reported:
+two *instances* of one lock class (``t1._lock`` then ``t2._lock``)
+are ordered by the caller, not by class identity.
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..core import FileContext, Finding, Pass, register
+from .. import locks
+
+
+@register
+class LockOrderCyclePass(Pass):
+    name = "lock-order-cycle"
+    description = ("cycles in the whole-program lock-acquisition graph — "
+                   "two threads acquiring in opposite orders deadlock")
+    project = True
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        graph = ctx.project
+        if graph is None:
+            return
+        ana = locks.analyze(graph)
+        for rec in ana.cycle_findings.get(ctx.relpath, ()):
+            yield ctx.finding(rec.node, self.name, rec.message())

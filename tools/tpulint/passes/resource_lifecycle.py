@@ -1,0 +1,59 @@
+"""resource-lifecycle: paired acquire/release checking for the serving
+stack's owned resources.
+
+The PR-13/14 resources all follow the same discipline — KV pages
+(``reserve``/``admit_prefix`` vs ``free``, CoW-refcounted), tenant page
+budgets (``charge_pages``/``release_pages``), token buckets
+(``take_tokens``/``refund_tokens``), breaker probe leases (``allow()``
+vs ``on_success``/``on_failure``) — and the leak shape is always the
+same: an exception edge or early return between the acquire and its
+release. A leaked page is capacity gone until restart; a leaked probe
+lease wedges a breaker in half-open; a leaked token charge starves the
+tenant that paid it. The protocol table lives in
+:data:`tools.tpulint.locks.PROTOCOLS`, so follow-on planes (fleet page
+export, disaggregated prefill) register their hand-offs as first-class
+transfers rather than teaching this pass new idioms.
+
+The checker is path-sensitive where it matters: guard polarity
+(``if not take_tokens(): return`` acquires only after the guard),
+``try``/``finally``-or-handler protection (a cleanup that transitively
+releases — ``_release_slot`` frees pages AND budget — protects the whole
+window), and the ``donation_prep`` idiom that *a consuming call is the
+sanctioned last touch*: declared transfer tails, a store into a ``self``
+container (``self._slots[slot] = req`` moves ownership to the object),
+and caller protection (every resolved call site sits under a catch-all
+that evicts-then-frees). Protocol implementation files are exempt —
+they are the audited internals, with ``MXNET_KVCACHE_AUDIT=1`` as the
+runtime twin re-proving the refcount invariant per tick.
+
+Deliberate hand-offs across function boundaries that the analysis
+cannot prove (admission guards that charge on behalf of the engine) are
+carried as justified baseline entries, not silenced — same policy as
+shared-state-race.
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..core import FileContext, Finding, Pass, register
+from .. import locks
+
+
+@register
+class ResourceLifecyclePass(Pass):
+    name = "resource-lifecycle"
+    description = ("acquired resources (KV pages, budget charges, probe "
+                   "leases) leaked on exception edges or early returns — "
+                   "no finally, no owner transfer")
+    project = True
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        graph = ctx.project
+        if graph is None:
+            return
+        ana = locks.analyze(graph)
+        for rec in ana.lifecycle_findings.get(ctx.relpath, ()):
+            yield ctx.finding(rec.node, self.name, rec.message())
