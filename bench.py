@@ -50,6 +50,16 @@ ELASTIC = os.environ.get("BENCH_ELASTIC", "") not in ("", "0")
 # with zero completions), a page budget is exceeded, or the
 # steady-state-recompile gauge moves
 TENANT = os.environ.get("BENCH_TENANT", "") not in ("", "0")
+# BENCH_FLEET=1: replica-fleet decode soak — the shared-prefix workload
+# through a FleetRouter at 1 replica (baseline) then 3 replicas, with a
+# replica kill mid-soak (every in-flight request must re-route and
+# complete exactly once), a rolling weight swap across the fleet, and a
+# synthetic QueueDepthBurn driving one autoscale-up decision; fleet
+# tokens/s, per-replica occupancy and the fleet prefix-hit ratio ride
+# the line; rc 8 if any request is lost or double-completed, a tenant
+# starves a window, the fleet hit ratio drops below 0.9x the
+# single-replica ratio, or any replica recompiles in steady state
+FLEET = os.environ.get("BENCH_FLEET", "") not in ("", "0")
 # p=0.2 because the fused-step protocol performs only ~a dozen accounted
 # transfers per run (one barrier fetch per timed phase): a mild rate would
 # usually inject nothing and "prove" resilience vacuously
@@ -1073,6 +1083,271 @@ def _tenant_bench():
     return 7 if gate_err else 0
 
 
+def _fleet_bench():
+    """BENCH_FLEET=1 mode: the replica-fleet soak behind the router.
+
+    The shared-prefix workload (K system prompts, unique tails, two
+    tenants) first runs through a 1-replica FleetRouter to anchor the
+    single-engine prefix-hit ratio, then through a fleet of 3 — same
+    router surface, prefix-affinity placement. Mid-soak the busiest
+    replica is killed (every in-flight request must re-route through the
+    router and complete exactly once) and, after it rebuilds, the fleet
+    takes a rolling weight swap one replica at a time. After the soak a
+    synthetic QueueDepthBurn drives one autoscale-up decision through
+    the SLO engine. Gates (rc 8): zero lost or double-completed
+    requests, no starved tenant window, fleet hit ratio >= 0.9x the
+    single-replica ratio, and zero steady-state recompiles on every
+    replica. Fleet tokens/s, per-replica occupancy, hit ratios and
+    resubmit/kill/scale counts ride the JSON line."""
+    deadline = float(os.environ.get("MXNET_BENCH_DEADLINE_S",
+                                    "300" if QUICK else "1500"))
+    printed = threading.Event()
+    part = {"phase": "backend-init", "tokens_s": None,
+            "fleet_hit_ratio": None, "single_hit_ratio": None,
+            "resubmits": None, "steady_state_recompiles": None}
+
+    def line(value, error=None, extra=None):
+        out = {
+            "metric": "replica-fleet decode tokens/s (3 replicas, "
+                      "prefix-affinity router, kill + rolling swap "
+                      "mid-soak, TinyDecoder)",
+            "value": value, "unit": "tokens/s", "vs_baseline": None,
+            "extra": dict(part, **(extra or {})),
+        }
+        if error:
+            out["error"] = error
+        print(json.dumps(_attach_telemetry(out)))
+        sys.stdout.flush()
+
+    def watchdog():
+        time.sleep(deadline)
+        if not printed.is_set():
+            line(part["tokens_s"],
+                 error="deadline %.0fs hit during phase %r (accelerator "
+                       "tunnel stall suspected)" % (deadline, part["phase"]))
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    devices = _acquire_backend()
+    _install_blackbox()
+    import numpy as np
+
+    from mxnet_tpu import serving, telemetry
+    from mxnet_tpu.serving.fleet import FleetRouter
+    from mxnet_tpu.telemetry import slo as _slo
+
+    _maybe_enable_chaos()
+
+    if QUICK:
+        slots, max_seq, run_s, win_s, replicas = 2, 96, 6.0, 1.5, 3
+        model = serving.TinyDecoder(vocab_size=64, num_layers=2,
+                                    num_heads=4, head_dim=8)
+        interval, max_new = 0.05, 8
+    else:
+        slots, max_seq, run_s, win_s, replicas = 4, 256, 45.0, 5.0, 3
+        model = serving.TinyDecoder(vocab_size=1024, num_layers=4,
+                                    num_heads=8, head_dim=64)
+        interval, max_new = 0.02, 16
+    params = model.init_params(0)
+    params_b = model.init_params(1)
+
+    def factory(name):
+        return serving.DecodeEngine(
+            model, params, num_slots=slots, max_seq_len=max_seq,
+            prefill_buckets=(8, 16, 64), page_size=8, prefix_cache=True,
+            timeout_ms=0, name=name)
+
+    rng = np.random.RandomState(0)
+    prefixes = [rng.randint(1, model.vocab_size, 32).astype(np.int32)
+                for _ in range(4)]
+    prompts = [np.concatenate([prefixes[i % 4],
+                               rng.randint(1, model.vocab_size, 4)
+                               .astype(np.int32)]) for i in range(128)]
+
+    # -- phase 1: single replica anchors the prefix-hit ratio ----------
+    part["phase"] = "single-replica-baseline"
+    fl1 = FleetRouter(factory, replicas=1, name="bench-fleet1")
+    fl1.warmup()
+    base_futs = [fl1.submit(p, max_new) for p in prompts[:48]]
+    for f in base_futs:
+        f.result(timeout=300)
+    single_hit = fl1.stats()["prefix_hit_ratio"]
+    fl1.close(drain=True, timeout=300)
+    part["single_hit_ratio"] = round(single_hit, 4)
+
+    # -- phase 2: the fleet soak ---------------------------------------
+    part["phase"] = "fleet-warmup"
+    fl = FleetRouter(factory, replicas=replicas, name="bench-fleet",
+                     max_replicas=replicas + 1)
+    fl.warmup()
+    fl.register_variant("rollout", params_b)
+
+    futs_lock = threading.Lock()
+    futs = []
+    completions = {"gold": [], "bronze": []}
+    sheds = {"gold": 0, "bronze": 0}
+    errors = []
+    t0 = time.perf_counter()
+    stop_at = t0 + run_s
+
+    def on_done(tid):
+        def cb(f):
+            if f.exception() is None:
+                completions[tid].append(time.perf_counter())
+            else:
+                errors.append("%s: %r" % (tid, f.exception()))
+        return cb
+
+    def client(tid, offset):
+        i = offset
+        while time.perf_counter() < stop_at:
+            try:
+                f = fl.submit(prompts[i % len(prompts)], max_new,
+                              tenant=tid)
+                f.add_done_callback(on_done(tid))
+                with futs_lock:
+                    futs.append(f)
+            except serving.QueueFullError:
+                sheds[tid] += 1
+            except serving.EngineUnavailableError:
+                sheds[tid] += 1
+            i += 2
+            time.sleep(interval)
+
+    part["phase"] = "fleet-soak"
+    threads = [threading.Thread(target=client, args=("gold", 0)),
+               threading.Thread(target=client, args=("bronze", 1))]
+    for t in threads:
+        t.start()
+
+    # kill the busiest replica a third of the way in: in-flight work
+    # re-routes through the router and completes exactly once
+    time.sleep(run_s / 3.0)
+    part["phase"] = "replica-kill"
+    victim = max(fl.debug_state()["replicas"].items(),
+                 key=lambda kv: kv[1]["inflight"])[0]
+    fl.kill_replica(victim)
+    for _ in range(600):
+        if fl.debug_state()["replicas"][victim]["state"] == "live":
+            break
+        time.sleep(0.05)
+    restarted = fl.debug_state()["replicas"][victim]["state"] == "live"
+
+    # rolling weight swap across the (rebuilt) fleet, still under load
+    part["phase"] = "rolling-swap"
+    swapped = fl.rolling_swap(variant="rollout", timeout=300)
+    part["phase"] = "fleet-soak-post-swap"
+    for t in threads:
+        t.join()
+
+    # synthetic QueueDepthBurn: the autoscaler must fire one scale-up
+    part["phase"] = "autoscale-drill"
+    rep0 = next(iter(fl.debug_state()["replicas"]))
+    _slo.note_bound("queue_depth", rep0, 10)
+    g = telemetry.gauge("mxnet_serving_queue_depth", labels=("server",))
+    g.set(9.5, server=rep0)
+    scale_event = fl.autoscale_tick()
+    g.set(0.0, server=rep0)
+
+    part["phase"] = "drain"
+    # settle every outstanding future, then snapshot stats BEFORE close:
+    # close() removes the replicas, and with them the per-replica prefix
+    # counters the affinity gate reads
+    settle_by = time.monotonic() + 300
+    for f in futs:
+        try:
+            f.result(timeout=max(0.0, settle_by - time.monotonic()))
+        except Exception:
+            pass
+    stats = fl.stats()
+    fl.close(drain=True, timeout=300)
+    elapsed = time.perf_counter() - t0
+
+    # exactly-once accounting: every submitted future resolved, and the
+    # router's completed count equals the clients' observed successes
+    lost = [f for f in futs if not f.done()]
+    n_ok = sum(len(ts) for ts in completions.values())
+    n_err = len(errors)
+    router = stats["router"]
+    dup = router["completed"] != n_ok
+
+    n_win = max(1, int((stop_at - t0) // win_s))
+    starved = []
+    for w in range(n_win):
+        lo, hi = t0 + w * win_s, t0 + (w + 1) * win_s
+        in_win = {tid: sum(1 for t in ts if lo <= t < hi)
+                  for tid, ts in completions.items()}
+        if max(in_win.values()) > 0 and min(in_win.values()) == 0:
+            starved.append(w)
+
+    per_replica = {
+        name: {
+            "slot_occupancy": round(s.get("slot_occupancy", 0.0), 4),
+            "completed": s.get("completed"),
+            "steady_state_recompiles": s.get("steady_state_recompiles"),
+            "active_variant": s.get("active_variant"),
+        } for name, s in stats["replicas"].items()
+        if "error" not in s}
+    recompiles = sum(r["steady_state_recompiles"] or 0
+                     for r in per_replica.values())
+    fleet_hit = stats["prefix_hit_ratio"]
+    tokens_s = stats["tokens_generated"] / elapsed
+    part.update({
+        "phase": "done", "tokens_s": round(tokens_s, 2),
+        "fleet_hit_ratio": round(fleet_hit, 4),
+        "resubmits": router["resubmitted"],
+        "steady_state_recompiles": recompiles,
+    })
+
+    gate_err = None
+    if lost:
+        gate_err = ("%d submitted request(s) never resolved (gate: a "
+                    "replica kill loses nothing)" % len(lost))
+    elif dup:
+        gate_err = ("router completed %d but clients observed %d "
+                    "successes (gate: exactly-once completion)"
+                    % (router["completed"], n_ok))
+    elif starved:
+        gate_err = ("tenant starved: zero completions in window(s) %s "
+                    "while the other tenant completed work" % starved)
+    elif single_hit > 0 and fleet_hit < 0.9 * single_hit:
+        gate_err = ("fleet prefix-hit ratio %.3f fell below 0.9x the "
+                    "single-replica ratio %.3f (gate: affinity "
+                    "placement)" % (fleet_hit, single_hit))
+    elif recompiles:
+        gate_err = ("fleet recompiled %d time(s) in steady state across "
+                    "kill + rolling swap (gate: 0)" % recompiles)
+    elif not restarted:
+        gate_err = "killed replica %s never rebuilt" % victim
+    elif scale_event is None or scale_event.get("action") != "up":
+        gate_err = ("autoscaler did not scale up on a synthetic "
+                    "QueueDepthBurn (event: %r)" % (scale_event,))
+    elif errors:
+        gate_err = "; ".join(errors[:3])
+    extra = {
+        "replicas": replicas,
+        "per_replica": per_replica,
+        "submitted": router["submitted"],
+        "completed": router["completed"],
+        "shed": dict(sheds),
+        "client_errors": n_err,
+        "killed_replica": victim,
+        "replica_restarted": restarted,
+        "rolling_swapped": swapped,
+        "autoscale_event": scale_event,
+        "windows": n_win, "starved_windows": starved,
+        "slots_per_replica": slots, "run_s": round(elapsed, 2),
+        "device": str(devices[0]),
+        "baseline": "single-replica prefix-hit ratio %.3f anchors the "
+                    "affinity gate; the lifecycle gates (nothing lost, "
+                    "nothing duplicated, zero recompiles) ARE the "
+                    "result" % single_hit,
+    }
+    printed.set()
+    line(round(tokens_s, 2), error=gate_err, extra=extra)
+    return 8 if gate_err else 0
+
+
 def _zero_bench():
     """BENCH_ZERO=1 mode: replicated vs ZeRO-1/2 at the same model/batch.
 
@@ -1435,6 +1710,8 @@ def _install_blackbox():
 
 
 def main():
+    if FLEET:
+        return _fleet_bench()
     if ELASTIC:
         return _elastic_bench()
     if ZERO:

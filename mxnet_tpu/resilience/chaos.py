@@ -58,8 +58,11 @@ Registered sites (grep ``maybe_fail`` for ground truth):
 ``serving.engine``, ``serving.decode``, ``serving.decode.prefill``,
 ``serving.decode.tenant.<id>`` (one site per tenant — scope a schedule to
 ONE tenant's requests with e.g. ``site=serving.decode.tenant.A`` to prove
-tenant isolation; see docs/resilience.md), ``ckpt.commit``,
-``zoo.download``.
+tenant isolation; see docs/resilience.md),
+``serving.fleet.replica.<i>`` (one site per fleet replica — a fault
+there kills the whole replica at routing time and must cost zero
+requests: the router re-routes its in-flight set and restarts it),
+``ckpt.commit``, ``zoo.download``.
 
 Injected faults raise :class:`FaultInjected` — a
 :class:`~mxnet_tpu.resilience.policies.TransientError` — so they exercise

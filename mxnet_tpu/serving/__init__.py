@@ -27,6 +27,11 @@ Pieces
   continuous batching over fixed decode slots, one jitted step per tick,
   prefill through a bucket ladder, ragged paged-attention reads
   (:mod:`mxnet_tpu.ops.pallas_kernels`) — the LLM serving plane;
+* :mod:`~mxnet_tpu.serving.fleet`    — :class:`FleetRouter`: N decode
+  replicas behind the single-engine surface — prefix-affinity placement,
+  tenant-aware spillover, replica lifecycle (rolling swap, drain),
+  failure containment with exactly-once re-routing, and SLO-driven
+  autoscaling;
 * :mod:`~mxnet_tpu.serving.tenancy`  — the multi-tenant control plane
   both servers thread through: tenant registry (``MXNET_TENANTS``),
   weighted-fair queueing with priority classes, per-tenant circuit
@@ -56,6 +61,7 @@ from .batcher import (EngineUnavailableError, QueueFullError,
 from .buckets import bucket_ladder, pad_to_bucket, select_bucket
 from .decode import DecodeEngine, PagedDecodeModel, TinyDecoder
 from .engine import BlockEngine, Engine, StableHLOEngine
+from .fleet import FleetRouter
 from .kvcache import OutOfPagesError, PagedKVCache, PrefixMatch
 from .stats import ServingStats, TenantStats
 from .tenancy import (Tenant, TenantBreaker, TenantRegistry,
@@ -68,7 +74,7 @@ __all__ = [
     "ServingStats", "TenantStats",
     "bucket_ladder", "select_bucket", "pad_to_bucket",
     "serve_block", "serve_stablehlo",
-    "DecodeEngine", "PagedDecodeModel", "TinyDecoder",
+    "DecodeEngine", "PagedDecodeModel", "TinyDecoder", "FleetRouter",
     "PagedKVCache", "OutOfPagesError", "PrefixMatch",
     "Tenant", "TenantRegistry", "TenantBreaker",
     "TenantUnavailableError", "WeightedFairQueue",

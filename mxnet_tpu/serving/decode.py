@@ -713,6 +713,18 @@ class DecodeEngine:
             return -1
         return sum(sizes)
 
+    def queue_depth(self) -> int:
+        """Requests queued but not yet slotted — the cheap read behind
+        the fleet's ``/debug/state`` view (``stats()`` evaluates SLOs;
+        this doesn't)."""
+        with self._cv:
+            return self._wfq.total_queued()
+
+    def kvcache_stats(self) -> dict:
+        """The paged pool's counters alone (pages in use/free, prefix
+        hit ratio) — the cheap subset of :meth:`stats`."""
+        return self._cache.stats()
+
     def stats(self) -> dict:
         out = self._stats.snapshot()
         with self._cv:
@@ -758,10 +770,17 @@ class DecodeEngine:
         out["alerts"] = _slo.evaluate()
         return out
 
-    def close(self, drain: bool = True, timeout: Optional[float] = None):
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> int:
         """Stop intake; ``drain=True`` finishes every queued AND admitted
         sequence first, ``drain=False`` fails them with
-        :class:`ServerClosedError` now. Idempotent."""
+        :class:`ServerClosedError` now. Idempotent.
+
+        Returns the number of requests that *completed during the drain*
+        (0 for ``drain=False`` and for repeat closes) — the number a
+        zero-drop replica drain / rolling upgrade asserts against; also
+        published as ``mxnet_serving_drain_completed_total{server=}``."""
+        before = self._stats.completed
         with self._cv:
             self._closed = True
             dropped: List[_DecodeRequest] = []
@@ -778,6 +797,11 @@ class DecodeEngine:
             self._fail(req, exc)
         if self._thread is not threading.current_thread():
             self._thread.join(timeout)
+        if not drain:
+            return 0
+        drained = max(0, self._stats.completed - before)
+        self._stats.on_drain(drained)
+        return drained
 
     def __enter__(self):
         return self
@@ -788,6 +812,19 @@ class DecodeEngine:
     @property
     def closed(self) -> bool:
         return self._closed
+
+    @property
+    def name(self) -> str:
+        """The engine's server name — keys its stats series, breaker
+        site and kv-cache gauges (and the fleet router's replica map)."""
+        return self._name
+
+    @property
+    def page_size(self) -> int:
+        """Tokens per KV page — the chunk granularity of the prefix
+        cache's rolling hash (the fleet router hashes prompts at the
+        same granularity to route for affinity)."""
+        return self._cache.page_size
 
     @property
     def tenants(self) -> TenantRegistry:

@@ -78,6 +78,11 @@ _T_CHUNKS = telemetry.counter(
     "interleaves these with decode ticks so TTFT stops tracking the "
     "longest prompt in the queue)",
     labels=("server",))
+_T_DRAIN = telemetry.counter(
+    "mxnet_serving_drain_completed_total",
+    "requests finished during a graceful close(drain=True) — the number "
+    "a zero-drop drain/rolling-upgrade asserts against",
+    labels=("server",))
 
 
 def _percentile_rows(out: Dict, pairs) -> None:
@@ -211,6 +216,13 @@ class ServingStats:
         with self._lock:
             self.errors += 1
         _T_REQS.inc(server=self.name, event="error")
+
+    def on_drain(self, n: int):
+        """``n`` requests completed between ``close(drain=True)`` and the
+        worker's exit — drain_replica()/rolling upgrades assert zero
+        drops against this instead of inferring them from traces."""
+        if n > 0:
+            _T_DRAIN.inc(n, server=self.name)
 
     def on_isolation_retry(self):
         with self._lock:

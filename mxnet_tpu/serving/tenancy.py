@@ -67,7 +67,8 @@ from .stats import TenantStats
 
 __all__ = ["Tenant", "TenantRegistry", "TenantBreaker",
            "TenantUnavailableError", "WeightedFairQueue", "parse_tenants",
-           "PRIORITY_CLASSES", "DEFAULT_TENANT", "SHARED_TENANT"]
+           "aggregate_snapshots", "PRIORITY_CLASSES", "DEFAULT_TENANT",
+           "SHARED_TENANT"]
 
 #: The tenant untagged ``submit()`` calls ride.
 DEFAULT_TENANT = "default"
@@ -553,6 +554,50 @@ class TenantRegistry:
 
     def snapshot(self) -> Dict[str, Dict]:
         return {t.tenant_id: t.snapshot() for t in self.tenants()}
+
+
+# counter-like per-tenant snapshot fields that sum across replicas; gauges
+# (queued, pages_in_use, slots_active, ...) also sum — each replica holds
+# its own share of the tenant's fleet-wide footprint
+_ADDITIVE_SNAPSHOT_FIELDS = (
+    "submitted", "completed", "shed", "shed_breaker", "timeouts", "errors",
+    "deferred_pages", "deferred_rate", "queued", "queue_depth",
+    "slots_active", "pages_in_use", "pages_in_use_now", "pages_in_use_max",
+    "pages_cached")
+
+
+def aggregate_snapshots(snapshots: List[Dict[str, Dict]]) -> Dict[str, Dict]:
+    """Merge per-replica :meth:`TenantRegistry.snapshot` dicts into one
+    fleet-wide per-tenant view (``FleetRouter.stats()["tenants"]``).
+
+    Counters and footprint gauges sum across replicas; latency
+    percentiles take the worst replica's value (a fleet p99 cannot be
+    recomputed from per-replica percentiles, and for an SLO read the
+    conservative bound is the honest one — the ``*_count`` fields say
+    how much traffic stands behind each); config fields (weight,
+    priority, budgets) and breaker state come from the first replica
+    that carries the tenant — every replica is built from the same spec.
+    """
+    out: Dict[str, Dict] = {}
+    for snap in snapshots:
+        for tenant_id, row in (snap or {}).items():
+            agg = out.get(tenant_id)
+            if agg is None:
+                out[tenant_id] = dict(row)
+                continue
+            for key, val in row.items():
+                if key in _ADDITIVE_SNAPSHOT_FIELDS \
+                        or key.endswith("_count"):
+                    agg[key] = agg.get(key, 0) + val
+                elif key.endswith("_ms") and isinstance(val, (int, float)):
+                    agg[key] = max(agg.get(key, 0.0), val)
+                elif key == "breaker":
+                    # surface the worst replica-local verdict: one open
+                    # breaker anywhere is fleet-visible
+                    order = {"closed": 0, "half_open": 1, "open": 2}
+                    if order.get(val, 0) > order.get(agg.get(key), 0):
+                        agg[key] = val
+    return out
 
 
 class WeightedFairQueue:

@@ -15,7 +15,12 @@ path                      payload
                           k8s probe reads the status code: 200 ok, 503
                           degraded)
 ``/debug/state``          JSON: full registry snapshot + flight-recorder
-                          tail + active alerts (the live black box)
+                          tail + active alerts (the live black box) +
+                          any views upper layers registered via
+                          :func:`register_debug_view` (the serving fleet
+                          publishes a ``fleet`` key: per-replica breaker
+                          state, queue depth, pages in use, last scale
+                          event)
 ``/debug/trace/<id>``     one request trace's typed event chain
                           (:func:`~mxnet_tpu.telemetry.tracing.get_trace`)
 ``/debug/traces``         retained trace ids
@@ -40,7 +45,7 @@ import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Dict, Optional
 
 from ..base import get_env
 from . import exporters as _exporters
@@ -48,9 +53,44 @@ from . import flightrec as _flightrec
 from . import slo as _slo
 from . import tracing as _tracing
 
-__all__ = ["start_httpd", "stop_httpd", "httpd_address"]
+__all__ = ["start_httpd", "stop_httpd", "httpd_address",
+           "register_debug_view", "unregister_debug_view"]
 
 _LOG = logging.getLogger(__name__)
+
+# extra top-level keys on /debug/state, registered by upper layers the
+# telemetry package must not import (the serving fleet registers its
+# per-replica view here) — each provider is a zero-arg callable returning
+# a JSON-serializable document, evaluated per request and exception-
+# isolated so a broken provider degrades to an error string, never a 500
+_VIEWS_LOCK = threading.Lock()
+_DEBUG_VIEWS: Dict[str, Callable[[], object]] = {}
+
+
+def register_debug_view(name: str, provider: Callable[[], object]) -> None:
+    """Attach ``provider()``'s result as the ``name`` key of every
+    ``/debug/state`` reply (last registration per name wins)."""
+    with _VIEWS_LOCK:
+        _DEBUG_VIEWS[str(name)] = provider
+
+
+def unregister_debug_view(name: str) -> None:
+    with _VIEWS_LOCK:
+        _DEBUG_VIEWS.pop(str(name), None)
+
+
+def _debug_views() -> Dict[str, object]:
+    with _VIEWS_LOCK:
+        views = list(_DEBUG_VIEWS.items())
+    out: Dict[str, object] = {}
+    for name, provider in views:
+        try:
+            out[name] = provider()
+        except Exception as exc:  # noqa: BLE001 - a debug view must never
+            # take /debug/state down with it: the OTHER views are exactly
+            # what a post-mortem needs when one subsystem is wedged
+            out[name] = {"error": repr(exc)}
+    return out
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -91,12 +131,14 @@ class _Handler(BaseHTTPRequestHandler):
             doc = self._healthz()
             self._json(200 if doc["status"] == "ok" else 503, doc)
         elif path == "/debug/state":
-            self._json(200, {
+            doc = {
                 "snapshot": _exporters.snapshot(),
                 "flightrec": _flightrec.tail(200),
                 "flightrec_last_dump": _flightrec.last_dump_path(),
                 "alerts": _slo.active_alerts(),
-            })
+            }
+            doc.update(_debug_views())
+            self._json(200, doc)
         elif path == "/debug/traces":
             self._json(200, {"trace_ids": _tracing.trace_ids()})
         elif path.startswith("/debug/trace/"):

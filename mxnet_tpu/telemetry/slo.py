@@ -60,7 +60,7 @@ BURN = _registry.gauge(
 ALERTS = ("QueueDepthBurn", "TenantQueueBurn", "SlotOccupancyBurn",
           "PagesBurn", "TenantPagesOverBudget", "TenantBreakerOpen",
           "EngineBreakerOpen", "TTFTBurn", "PrefixHitCollapse",
-          "RecompileStorm")
+          "RecompileStorm", "FleetImbalanceBurn")
 
 
 def _rows(name: str) -> List[Dict[str, Any]]:
@@ -121,6 +121,17 @@ class SLOEngine:
             while dq and dq[0][0] < horizon:
                 dq.popleft()
 
+    def mean(self, series: str, instance: str,
+             window: Optional[float] = None) -> Optional[float]:
+        """Windowed mean of one sampled series (``window`` seconds,
+        default the fast window; ``None`` when the window holds no
+        samples). The fleet autoscaler's scale-down signal reads the
+        per-replica occupancy series through this instead of re-deriving
+        its own history."""
+        return self._mean(series, str(instance),
+                          self.fast_s if window is None else float(window),
+                          time.monotonic())
+
     def _mean(self, series: str, instance: str, window: float,
               now: float) -> Optional[float]:
         with self._lock:
@@ -155,7 +166,7 @@ class SLOEngine:
             "mxnet_decode_slot_occupancy", "mxnet_kvcache_pages_in_use",
             "mxnet_kvcache_pages_capacity", "mxnet_tenant_pages_in_use",
             "mxnet_tenant_breaker_state", "mxnet_breaker_state",
-            "mxnet_steady_state_recompiles")
+            "mxnet_steady_state_recompiles", "mxnet_fleet_load_imbalance")
         for name in watch_gauges:
             for row in _rows(name):
                 self._observe(name, _label_key(row["labels"]),
@@ -311,6 +322,29 @@ class SLOEngine:
                                "leading indicator for TTFTBurn: prompt "
                                "mix change, swap flush, or pool too "
                                "small")
+
+        # FleetImbalanceBurn: one replica absorbing the fleet's load.
+        # The router publishes max/mean in-flight over live replicas
+        # (1.0 = perfectly balanced); prefix affinity legitimately skews
+        # placement, so the thresholds tolerate a hot replica and fire
+        # only when the skew is extreme (fast) or sustained (slow) —
+        # the signal that the prefix->replica index collapsed onto one
+        # replica or a restart left a replica cold and unrouted.
+        for row in _rows("mxnet_fleet_load_imbalance"):
+            inst = _label_key(row["labels"])
+            m_fast = self._mean("mxnet_fleet_load_imbalance", inst,
+                                fast, now)
+            m_slow = self._mean("mxnet_fleet_load_imbalance", inst,
+                                slow, now)
+            if m_fast is not None and m_fast > 4.0:
+                self._burn(fired, "FleetImbalanceBurn", inst, m_fast, 4.0,
+                           "page", fast, "one replica is absorbing the "
+                           "fleet: check /debug/state fleet view for a "
+                           "dead/cold replica or an index collapse")
+            elif m_slow is not None and m_slow > 2.0:
+                self._burn(fired, "FleetImbalanceBurn", inst, m_slow, 2.0,
+                           "warn", slow, "sustained placement skew: "
+                           "rebalance the prefix index or add a replica")
 
         # RecompileStorm: the compile-once contract broke — any sample.
         # Keyed SOLELY off the steady-state gauge, which warmup anchors

@@ -414,6 +414,27 @@ def test_engine_queue_shed(tiny):
         assert eng.stats()["shed"] == shed
 
 
+def test_engine_close_drain_reports_completions(tiny):
+    # close(drain=True) returns how many requests finished DURING the
+    # drain — the number a zero-drop replica drain / rolling upgrade
+    # asserts against — and publishes it as the drain counter
+    eng = _engine(tiny, name="drain%d" % np.random.randint(1 << 30))
+    name = eng.name
+    futs = [eng.submit([1 + i], 5) for i in range(3)]
+    drained = eng.close(drain=True)
+    for f in futs:
+        assert len(f.result(timeout=5)) == 5
+    # everything not already finished at close() completed in the drain
+    assert 0 <= drained <= 3
+    assert eng.stats()["completed"] == 3
+    fam = telemetry.REGISTRY.get("mxnet_serving_drain_completed_total")
+    assert fam.value(server=name) == drained
+    assert eng.close() == 0  # repeat closes report nothing
+
+    eng2 = _engine(tiny)
+    assert eng2.close(drain=False) == 0  # fail-fast close drains nothing
+
+
 def test_engine_queue_deadline_expires(tiny):
     with _engine(tiny, num_slots=1) as eng:
         eng.warmup()

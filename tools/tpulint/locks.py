@@ -198,6 +198,14 @@ PROTOCOLS: Tuple[Protocol, ...] = (
              acquire_tails=(), release_tails=(),
              transfer_tails=(), receiver_tokens=(),
              impl_files=("mxnet_tpu/telemetry/flightrec.py",)),
+    Protocol("replica-lease", "fleet replica routing lease",
+             acquire_tails=("acquire_lease",),
+             release_tails=("release_lease",),
+             # a re-route moves the lease WITH the request to the next
+             # replica: a consuming last touch, not a leak
+             transfer_tails=("transfer_lease",),
+             receiver_tokens=("replica", "rep"),
+             impl_files=("mxnet_tpu/serving/fleet.py",)),
 )
 
 
