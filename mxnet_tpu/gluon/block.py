@@ -23,7 +23,7 @@ from collections import OrderedDict
 import jax
 import numpy as np
 
-from .. import _fused, _global, autograd
+from .. import _fused, _global, autograd, telemetry
 from ..base import MXNetError
 from ..context import Context, cpu, current_context
 from ..ndarray import ndarray as nd_mod
@@ -400,8 +400,9 @@ class _TrainPair(object):
         self._fwd_jit = jax.jit(fwd)
 
     def forward(self, diff_pvals, const_pvals, rng, arg_datas):
-        outs, aux, res = self._fwd_jit(diff_pvals, const_pvals, rng,
-                                       list(arg_datas))
+        outs, aux, res = telemetry.jit_call(
+            "gluon.hybrid_forward", self._fwd_jit, diff_pvals, const_pvals,
+            rng, list(arg_datas))
         single = self._cell["single"]
         outs_t = (outs,) if single else tuple(outs)
         return outs_t, aux, res, single

@@ -104,6 +104,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import devprof as _devprof
 from ..telemetry import flightrec as _flightrec
 from ..telemetry import slo as _slo
 from ..telemetry import tracing as _tracing
@@ -867,6 +868,12 @@ class DecodeEngine:
                 time.sleep(0.005)
                 continue
             try:
+                # devprof tick scope: the sampling decision is drawn once
+                # for the whole tick so a timed tick's prefill/step/host-
+                # gap breakdown is coherent; one global read when off
+                tick_t0 = time.perf_counter()
+                tick_timed = _devprof.tick_begin()
+                toks_before = self._tokens_total
                 self._admit()
                 prefilling = [(i, r) for i, r in enumerate(self._slots)
                               if r is not None and r.prefilling]
@@ -890,9 +897,18 @@ class DecodeEngine:
                 elif not prefilling:
                     # every queued tenant deferred (pages/rate/breaker)
                     # with nothing in flight: yield instead of spinning
+                    if tick_timed:
+                        _devprof.tick_end()
                     time.sleep(0.001)
                     continue
+                if tick_timed:
+                    _devprof.note_decode_tick(
+                        self._name,
+                        (time.perf_counter() - tick_t0) * 1e3,
+                        self._tokens_total - toks_before)
             except Exception as exc:  # noqa: BLE001 - engine must survive
+                _devprof.tick_end()  # don't leak the tick scope into the
+                # eviction/recovery path's dispatches
                 # belt-and-braces (the PR-2 batcher discipline): NO
                 # exception may kill the engine thread — that would hang
                 # every in-flight and queued future forever. Evict

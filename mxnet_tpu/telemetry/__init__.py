@@ -33,6 +33,15 @@ piece                 what it gives you
 :mod:`.httpd`         stdlib introspection daemon: ``/metrics``,
                       ``/healthz``, ``/debug/state``,
                       ``/debug/trace/<id>`` (``MXNET_METRICS_PORT``)
+:mod:`.devprof`       device-time attribution: sampled per-site
+                      ``block_until_ready`` timing through ``jit_call``,
+                      decode-tick / train-step host-gap breakdowns, MFU
+                      and tokens-per-device-second gauges, HBM watermark
+                      timeline, chrome-trace device lane
+                      (``MXNET_DEVPROF_SAMPLE``-gated)
+:mod:`.regress`       bench-regression sentinel: per-(metric, config)
+                      trajectories over BENCH_*.json + emitter JSONL,
+                      median+MAD verdicts stamped as ``perf_verdict``
 ====================  =====================================================
 
 Publishers wired in-framework: ``serving.ServingStats``, ``profiler.
@@ -49,6 +58,7 @@ from __future__ import annotations
 
 from . import accounting, exporters, registry, spans
 from . import flightrec, httpd, slo, tracing
+from . import devprof, regress
 from .accounting import (CKPT_BYTES, CKPT_CORRUPTION, CKPT_RESTORE_MS,
                          CKPT_SAVE_MS, COMPILE_CACHE_HITS,
                          COMPILE_CACHE_MISSES,
@@ -83,7 +93,7 @@ __all__ = [
     "PREEMPTIONS", "CKPT_CORRUPTION", "ELASTIC_GOODPUT", "ELASTIC_RESTARTS",
     "render_prometheus", "snapshot", "Emitter", "start_emitter",
     "stop_emitter",
-    "tracing", "flightrec", "slo", "httpd",
+    "tracing", "flightrec", "slo", "httpd", "devprof", "regress",
     "start_trace", "get_trace", "start_httpd", "stop_httpd",
 ]
 

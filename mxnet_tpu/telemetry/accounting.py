@@ -166,6 +166,12 @@ def jit_cache_size(jitted) -> int:
         return -1
 
 
+#: devprof's dispatch hook (``(site, t0, out) -> None``), installed by
+#: :mod:`~mxnet_tpu.telemetry.devprof` only while its sampling rate is
+#: positive. ``None`` (the default) keeps the steady-state jit_call cost
+#: at ONE module-global pointer check — the tracing-plane discipline.
+_DEVPROF_HOOK = None
+
 _CHAOS = None
 
 
@@ -202,9 +208,11 @@ def jit_call(site: str, jitted, *args, **kwargs):
     before = jit_cache_size(jitted)
     t0 = time.perf_counter()
     out = jitted(*args, **kwargs)
+    grew = False
     if before >= 0:
         after = jit_cache_size(jitted)
         if after > before:
+            grew = True
             RECOMPILES.inc(after - before, site=site)
             COMPILE_SECONDS.inc(time.perf_counter() - t0, site=site)
             # black box: a steady-state recompile at a serving site is a
@@ -214,6 +222,11 @@ def jit_call(site: str, jitted, *args, **kwargs):
             flightrec.record("recompile", site=site,
                              count=after - before,
                              seconds=round(time.perf_counter() - t0, 4))
+    hook = _DEVPROF_HOOK
+    if hook is not None and not grew:
+        # recompiling dispatches stay out of the device-time histograms:
+        # their wall time is compile cost, attributed just above
+        hook(site, t0, out)
     return out
 
 

@@ -128,6 +128,18 @@ class Emitter(threading.Thread):
     def emit_once(self) -> bool:
         """Append one snapshot line; False when the sink is unwritable."""
         try:
+            # HBM watermark rides the emit cadence: non-bench runs get a
+            # device-memory timeline in the JSONL tail and the flight-
+            # recorder ring, not one number at bench-line boundaries.
+            # Lazy import (devprof loads after exporters); the probe
+            # itself is guarded inside hbm_watermark — a stat-less
+            # backend must not cost the snapshot line.
+            try:
+                from . import devprof as _devprof
+            except ImportError:
+                _devprof = None
+            if _devprof is not None:
+                _devprof.hbm_watermark("emitter")
             line = json.dumps(snapshot(self._registry))
             with open(self.path, "a") as f:
                 f.write(line + "\n")

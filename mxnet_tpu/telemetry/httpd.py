@@ -24,6 +24,10 @@ path                      payload
 ``/debug/trace/<id>``     one request trace's typed event chain
                           (:func:`~mxnet_tpu.telemetry.tracing.get_trace`)
 ``/debug/traces``         retained trace ids
+``/debug/<view>``         any single registered debug view standalone —
+                          ``/debug/perf`` is devprof's device-time
+                          attribution summary + the latest bench-sentinel
+                          verdicts; ``/debug/fleet`` the serving fleet's
 ========================  ==================================================
 
 Security: the endpoint is **unauthenticated introspection** — metrics,
@@ -147,11 +151,30 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(404, {"error": "unknown or evicted trace id"})
             else:
                 self._json(200, trace)
+        elif path.startswith("/debug/"):
+            # any registered debug view standalone: /debug/perf serves
+            # devprof's attribution summary + sentinel verdicts without
+            # the full /debug/state payload around it (same exception
+            # isolation — the provider's error renders, never a 500)
+            name = path[len("/debug/"):]
+            with _VIEWS_LOCK:
+                provider = _DEBUG_VIEWS.get(name)
+            if provider is None:
+                with _VIEWS_LOCK:
+                    known = sorted(_DEBUG_VIEWS)
+                self._json(404, {"error": "unknown debug view",
+                                 "views": known})
+            else:
+                try:
+                    self._json(200, provider())
+                except Exception as exc:  # noqa: BLE001 - see _debug_views
+                    self._json(200, {"error": repr(exc)})
         else:
             self._json(404, {"error": "unknown path",
                              "paths": ["/metrics", "/healthz",
                                        "/debug/state", "/debug/traces",
-                                       "/debug/trace/<id>"]})
+                                       "/debug/trace/<id>",
+                                       "/debug/<view>"]})
 
     @staticmethod
     def _healthz() -> dict:

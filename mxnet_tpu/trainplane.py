@@ -49,6 +49,7 @@ same step spans the slice (GSPMD inserts the ICI collectives).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Dict, Optional
 
 import jax
@@ -56,6 +57,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import autograd, telemetry
+from .telemetry import devprof as _devprof
 from . import optimizer as opt_mod
 from .base import get_env
 from .context import cpu
@@ -666,6 +668,17 @@ class TrainPlane(_PlaneBase):
             self._activate(data_nd, label_nd, batch_size)
         self.step_count += 1
         if self._plane == "graph":
+            # devprof step scope: one coherent sampling decision for the
+            # whole step so its device/host_gap split is honest. Eager
+            # plane is deliberately unscoped — it dispatches op-by-op
+            # outside jit_call, so there is no device time to attribute.
+            if _devprof.tick_begin():
+                t0 = time.perf_counter()
+                try:
+                    return self._graph_step(data_nd, label_nd, batch_size)
+                finally:
+                    _devprof.note_train_step(
+                        (time.perf_counter() - t0) * 1e3)
             return self._graph_step(data_nd, label_nd, batch_size)
         return self._eager_step(data_nd, label_nd, batch_size)
 
@@ -901,6 +914,15 @@ class _ModulePlane(_PlaneBase):
     def step(self, batch):
         """One whole-graph training step for a DataBatch; fills the
         executor's outputs so ``update_metric`` reads them as usual."""
+        if _devprof.tick_begin():
+            t0 = time.perf_counter()
+            try:
+                return self._step(batch)
+            finally:
+                _devprof.note_train_step((time.perf_counter() - t0) * 1e3)
+        return self._step(batch)
+
+    def _step(self, batch):
         m = self._m
         exec_ = self._exec
         opt = m._optimizer

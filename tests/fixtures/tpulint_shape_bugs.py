@@ -15,6 +15,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import telemetry  # dispatches ride jit_call: attributed idiom
+
 
 # -- bug 1: ⊤-shaped operand into a jit dispatch -----------------------------
 # `rows` accumulates host data in a python loop; np.stack gives the batch
@@ -34,7 +36,9 @@ def collate_and_step(host_batches):
     for b in host_batches:
         rows.append(np.asarray(b, np.float32))
     batch = np.stack(rows)
-    return _STEP(batch)  # BUG: ⊤ leading dim — recompile per batch size
+    # BUG: ⊤ leading dim — recompile per batch size (attribution via
+    # jit_call does not absolve the data-dependent shape).
+    return telemetry.jit_call("fixture.collate_step", _STEP, batch)
 
 
 # -- bug 2: off-tile Pallas block --------------------------------------------
@@ -47,13 +51,14 @@ def _copy_kernel(x_ref, o_ref):
 
 
 def off_tile_copy(x):
-    return pl.pallas_call(
+    kernel = pl.pallas_call(
         _copy_kernel,
         grid=(4,),
         in_specs=[pl.BlockSpec((8, 100), lambda i: (i, 0))],  # BUG: 100 lanes
         out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
-    )(x)
+    )
+    return telemetry.jit_call("fixture.off_tile_copy", kernel, x)
 
 
 # -- bug 3: undefined mesh axis ----------------------------------------------

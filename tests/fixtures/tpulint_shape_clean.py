@@ -17,6 +17,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import telemetry  # dispatches ride jit_call: attributed idiom
 from ..base import get_env
 from ..serving.buckets import select_bucket
 
@@ -51,18 +52,21 @@ class CleanEngine:
         # the warmed decode step: knob-shaped packed operands
         s = self.num_slots
         packed = np.zeros((5, s), np.int32)
-        self._step(jnp.asarray(packed), None)
+        telemetry.jit_call("fixture.decode_step", self._step,
+                           jnp.asarray(packed), None)
         # one pre-compile per rung: bounded, never ⊤
         for rung in self._ladder:
             pre = np.zeros((3, rung), np.int32)
-            self._prefill_jit(jnp.asarray(pre), None)
+            telemetry.jit_call("fixture.prefill", self._prefill_jit,
+                               jnp.asarray(pre), None)
 
     def prefill(self, prompt):
         p = int(np.asarray(prompt, np.int32).size)
         rung = select_bucket(p, self._ladder)
         pre = np.zeros((3, rung), np.int32)  # padded to the rung
-        return self._prefill_jit(jnp.asarray(pre),
-                                 jnp.asarray(p, jnp.int32))
+        return telemetry.jit_call("fixture.prefill", self._prefill_jit,
+                                  jnp.asarray(pre),
+                                  jnp.asarray(p, jnp.int32))
 
 
 # -- a tile-aligned Pallas kernel with scalar prefetch -----------------------
@@ -86,11 +90,12 @@ def clean_pallas(x, table):
                                lambda i, j, tbl: (i, j)),
         scratch_shapes=[pltpu.VMEM((_SUBLANES, LANES), jnp.float32)],
     )
-    return pl.pallas_call(
+    kernel = pl.pallas_call(
         _scale_kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((32, 256), jnp.float32),
-    )(table, x)
+    )
+    return telemetry.jit_call("fixture.clean_pallas", kernel, table, x)
 
 
 # -- sharding over axes the mesh defines -------------------------------------
@@ -108,5 +113,6 @@ def shard_batch(devices, batch, params):
                    out_shardings=(sharded, repl),
                    donate_argnums=(0,))  # donated layout matches an output
     with mesh:
-        return step(jax.device_put(batch, sharded),
-                    jax.device_put(params, repl))
+        return telemetry.jit_call("fixture.shard_step", step,
+                                  jax.device_put(batch, sharded),
+                                  jax.device_put(params, repl))

@@ -1,0 +1,339 @@
+"""devprof — per-site device-time attribution + host-gap accounting.
+
+Covers the ISSUE-18 tentpole surface: the jit_call hook's off path
+(one pointer check, no hook installed), full-sample attribution into
+the per-site histograms/slices, the recompile exclusion, tick-scoped
+coherent sampling driving the decode/train host-gap breakdowns, the
+four-site decode-engine integration (prefix cache on, unchunked), the
+chrome-trace device lane merged onto the request-hop timeline (with
+the empty-sample and telemetry-off paths lock-free), the Emitter's HBM
+watermark ride-along, and the /debug/perf view document.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import serving, telemetry
+from mxnet_tpu.resilience import chaos
+from mxnet_tpu.telemetry import (accounting, devprof, exporters, flightrec,
+                                 httpd, registry, tracing)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    chaos.disable()
+    devprof.set_sample(None)
+    devprof.reset()
+    tracing.set_sample(None)
+    tracing.clear()
+    flightrec.clear()
+    registry.REGISTRY.clear_data()
+    yield
+    chaos.disable()
+    devprof.set_sample(None)
+    devprof.reset()
+    tracing.set_sample(None)
+    tracing.clear()
+    flightrec.clear()
+    registry.REGISTRY.clear_data()
+    telemetry.set_enabled(True)
+
+
+@jax.jit
+def _double(x):
+    return x * 2
+
+
+def _warm(site="t.site"):
+    """One attributed call that compiles (excluded) so later calls are
+    steady-state dispatches."""
+    return telemetry.jit_call(site, _double, jnp.ones((4,)))
+
+
+# ---------------------------------------------------------------------------
+# hook install / off path
+# ---------------------------------------------------------------------------
+
+def test_inactive_means_no_hook_and_no_series():
+    # default (env knob unset, no override): the off path is literally
+    # `accounting._DEVPROF_HOOK is None` — nothing else runs per dispatch
+    assert not devprof.active()
+    assert accounting._DEVPROF_HOOK is None
+    _warm()
+    _warm()
+    assert devprof.DEVICE_TIME_MS.series() == []
+    assert devprof.chrome_events(1) == []
+
+
+def test_set_sample_installs_and_uninstalls_hook():
+    devprof.set_sample(1.0)
+    assert devprof.active()
+    assert accounting._DEVPROF_HOOK is devprof._on_dispatch
+    devprof.set_sample(0.0)
+    assert not devprof.active()
+    assert accounting._DEVPROF_HOOK is None
+    devprof.set_sample(None)  # back to the env knob (unset -> off)
+    assert accounting._DEVPROF_HOOK is None
+
+
+def test_env_knob_activates(monkeypatch):
+    monkeypatch.setenv("MXNET_DEVPROF_SAMPLE", "0.25")
+    devprof.refresh()
+    assert devprof.active()
+    assert devprof.sample_rate() == 0.25
+
+
+# ---------------------------------------------------------------------------
+# full-sample attribution
+# ---------------------------------------------------------------------------
+
+def test_sampled_dispatch_lands_in_histogram_and_slices():
+    _warm()  # compile OUTSIDE sampling so the steady call is clean
+    devprof.set_sample(1.0)
+    telemetry.jit_call("t.site", _double, jnp.ones((4,)))
+    telemetry.jit_call("t.site", _double, jnp.ones((4,)))
+    rows = devprof.DEVICE_TIME_MS.series()
+    assert len(rows) == 1
+    assert rows[0]["labels"]["site"] == "t.site"
+    assert rows[0]["count"] == 2
+    secs = devprof.DEVICE_SECONDS.series()
+    assert secs[0]["value"] >= 0
+    evs = devprof.chrome_events(7)
+    assert evs[0]["ph"] == "M" and evs[0]["tid"] == 0
+    assert evs[0]["args"]["name"] == "device (devprof sampled)"
+    slices = [e for e in evs if e["ph"] == "X"]
+    assert [e["name"] for e in slices] == ["t.site", "t.site"]
+    assert all(e["cat"] == "device" and e["tid"] == 0 for e in slices)
+
+
+def test_recompiling_dispatch_is_excluded():
+    # the FIRST call through a fresh jit traces+compiles: its wall time
+    # is compile cost (COMPILE_SECONDS), not device time — the histogram
+    # must only see the steady-state dispatch
+    @jax.jit
+    def fresh(x):
+        return x + 1
+
+    devprof.set_sample(1.0)
+    telemetry.jit_call("t.fresh", fresh, jnp.ones((4,)))  # compiles
+    rows = devprof.DEVICE_TIME_MS.series()
+    assert rows == [] or rows[0]["count"] == 0
+    telemetry.jit_call("t.fresh", fresh, jnp.ones((4,)))  # steady
+    rows = devprof.DEVICE_TIME_MS.series()
+    assert rows[0]["count"] == 1
+
+
+def test_summary_ranks_sites_by_device_time():
+    _warm("t.a")
+    _warm("t.b")
+    devprof.set_sample(1.0)
+    for _ in range(3):
+        telemetry.jit_call("t.a", _double, jnp.ones((4,)))
+    telemetry.jit_call("t.b", _double, jnp.ones((4,)))
+    doc = devprof.summary(top_n=10)
+    assert doc["active"] and doc["sample"] == 1.0
+    assert doc["site_count"] == 2
+    by_site = {s["site"]: s for s in doc["sites"]}
+    assert by_site["t.a"]["dispatches_sampled"] == 3
+    assert by_site["t.b"]["dispatches_sampled"] == 1
+    assert all(s["p50_ms"] <= s["p99_ms"] for s in doc["sites"])
+
+
+# ---------------------------------------------------------------------------
+# tick scopes: coherent sampling + host-gap split
+# ---------------------------------------------------------------------------
+
+def test_decode_tick_breakdown_and_gauges():
+    _warm("serving.decode_prefill")
+    _warm("serving.decode_step")
+    devprof.set_sample(1.0)
+    assert devprof.tick_begin()
+    telemetry.jit_call("serving.decode_prefill", _double, jnp.ones((4,)))
+    telemetry.jit_call("serving.decode_step", _double, jnp.ones((4,)))
+    acc = devprof.tick_device_ms()
+    assert set(acc) == {"serving.decode_prefill", "serving.decode_step"}
+    devprof.note_decode_tick("srv", wall_ms=100.0, tokens=5)
+    phases = {r["labels"]["phase"]: r
+              for r in devprof.DECODE_TICK_MS.series()}
+    assert {"prefill", "step", "host_gap"} <= set(phases)
+    ratio = devprof.HOST_GAP_RATIO.series()
+    assert ratio[0]["labels"]["plane"] == "decode"
+    assert 0.0 <= ratio[0]["value"] <= 1.0
+    tok = devprof.TOKENS_PER_DEVICE_S.series()
+    assert tok[0]["labels"]["server"] == "srv" and tok[0]["value"] > 0
+    planes = devprof.summary()["planes"]
+    assert planes["decode"]["tokens"] == 5
+    assert planes["decode"]["wall_ms"] == 100.0
+
+
+def test_tick_scope_forces_and_clears():
+    devprof.set_sample(1.0)
+    assert devprof.tick_begin()
+    devprof.tick_end()
+    # after tick_end the scope must not leak into later dispatches
+    assert devprof.tick_device_ms() == {}
+    devprof.set_sample(0.0)
+    assert devprof.tick_begin() is False  # inactive: one global read
+
+
+def test_train_step_split_and_mfu():
+    _warm("train.step")
+    devprof.set_sample(1.0)
+    devprof.declare_flops(1e9, 1e12)
+    for _ in range(2):
+        assert devprof.tick_begin()
+        telemetry.jit_call("train.step", _double, jnp.ones((4,)))
+        devprof.note_train_step(wall_ms=50.0)
+    phases = {r["labels"]["phase"]: r
+              for r in devprof.TRAIN_STEP_MS.series()}
+    assert phases["device"]["count"] == 2
+    assert phases["host_gap"]["count"] == 2
+    mfu = devprof.MFU.series()
+    assert mfu[0]["labels"]["plane"] == "train" and mfu[0]["value"] > 0
+    doc = devprof.summary()["planes"]["train"]
+    assert doc["steps"] == 2 and doc["mfu"] > 0
+
+
+# ---------------------------------------------------------------------------
+# decode-engine integration: all four sites attributed
+# ---------------------------------------------------------------------------
+
+def test_engine_soak_attributes_all_four_decode_sites():
+    # prefix_cache on + unchunked prefill exercises every decode-plane
+    # dispatch site: bucketed prefill, the chunk lane (cache-miss tail
+    # fill), CoW divergence off shared pages, and the batched step
+    model = serving.TinyDecoder(vocab_size=32, num_layers=2, num_heads=4,
+                                head_dim=8, num_kv_heads=2)
+    params = model.init_params(0)
+    devprof.set_sample(1.0)
+    rng = np.random.RandomState(3)
+    shared = rng.randint(1, 32, 12).astype(np.int32)
+    with serving.DecodeEngine(model, params, num_slots=3, max_seq_len=48,
+                              prefill_buckets=(8, 16), timeout_ms=0,
+                              prefix_cache=True, prefill_chunk=0,
+                              name="dp%d" % rng.randint(1 << 30)) as eng:
+        eng.warmup()
+        futs = [eng.submit(shared, 4) for _ in range(4)]
+        futs += [eng.submit(rng.randint(1, 32, 5).astype(np.int32), 4)
+                 for _ in range(3)]
+        for f in futs:
+            f.result(timeout=120)
+    sites = {r["labels"]["site"]
+             for r in devprof.DEVICE_TIME_MS.series() if r["count"]}
+    assert {"serving.decode_prefill", "serving.decode_prefill_chunk",
+            "serving.decode_cow", "serving.decode_step"} <= sites
+    planes = devprof.summary()["planes"]
+    assert planes["decode"]["tokens"] == 7 * 4
+    assert 0.0 <= planes["decode"]["host_gap_ratio"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# chrome device lane
+# ---------------------------------------------------------------------------
+
+def test_chrome_merge_device_lane_aligns_with_hops(tmp_path):
+    model = serving.TinyDecoder(vocab_size=32, num_layers=1, num_heads=2,
+                                head_dim=4)
+    params = model.init_params(0)
+    eng = serving.DecodeEngine(model, params, num_slots=2, max_seq_len=64,
+                               prefill_buckets=(8,), timeout_ms=0,
+                               name="dpc%d" % np.random.randint(1 << 30))
+    with eng:
+        eng.warmup()
+        tracing.set_sample(1.0)
+        devprof.set_sample(1.0)
+        eng.submit([1, 2, 3], 4).result(timeout=120)
+    path = str(tmp_path / "trace.json")
+    doc = tracing.export_chrome(path)
+    dev = [e for e in doc["traceEvents"] if e.get("cat") == "device"]
+    hops = [e for e in doc["traceEvents"] if e.get("cat") == "trace"]
+    assert dev and hops
+    assert all(e["tid"] == 0 for e in dev)
+    metas = [e for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["tid"] == 0]
+    assert metas[0]["args"]["name"] == "device (devprof sampled)"
+    # both lanes ride the same perf_counter-microsecond timeline: the
+    # request's device slices land inside its hop window
+    lo = min(e["ts"] for e in hops)
+    hi = max(e["ts"] + e.get("dur", 0) for e in hops)
+    inside = [e for e in dev
+              if lo <= e["ts"] and e["ts"] + e["dur"] <= hi + 1e3]
+    assert inside, "no device slice within the request hop window"
+    assert json.load(open(path))["traceEvents"]
+
+
+def test_chrome_empty_sample_has_no_device_lane(tmp_path):
+    t = tracing.start_trace("p", "s", "t", sample=1.0)
+    tracing.event(t, "enqueue")
+    tracing.finish(t, "complete")
+    doc = tracing.export_chrome(str(tmp_path / "t.json"))
+    assert [e for e in doc["traceEvents"]
+            if e.get("cat") == "device"] == []
+    assert all(not (e.get("ph") == "M" and e.get("tid") == 0)
+               for e in doc["traceEvents"])
+
+
+def test_telemetry_off_is_lock_free_no_op():
+    devprof.set_sample(1.0)
+    telemetry.set_enabled(False)
+    try:
+        # jit_call returns before the hook: no slices, no series
+        _warm()
+        _warm()
+        assert devprof.DEVICE_TIME_MS.series() == []
+        assert devprof.chrome_events(1) == []
+        doc = tracing.export_chrome()
+        assert [e for e in doc["traceEvents"]
+                if e.get("cat") == "device"] == []
+    finally:
+        telemetry.set_enabled(True)
+
+
+# ---------------------------------------------------------------------------
+# HBM watermark + /debug/perf
+# ---------------------------------------------------------------------------
+
+def test_hbm_watermark_records_flightrec(monkeypatch):
+    monkeypatch.setattr(accounting, "sample_hbm",
+                        lambda devices=None: {0: (1024, 4096)})
+    stats = devprof.hbm_watermark("test")
+    assert stats == {0: (1024, 4096)}
+    evs = [e for e in flightrec.tail(0) if e["kind"] == "hbm.watermark"]
+    assert evs and evs[-1]["source"] == "test"
+    assert evs[-1]["devices"]["0"] == {"in_use": 1024, "peak": 4096}
+
+
+def test_hbm_watermark_survives_probe_failure(monkeypatch):
+    def boom(devices=None):
+        raise RuntimeError("no stats on this backend")
+
+    monkeypatch.setattr(accounting, "sample_hbm", boom)
+    assert devprof.hbm_watermark("test") == {}
+
+
+def test_emitter_rides_hbm_watermark(tmp_path, monkeypatch):
+    monkeypatch.setattr(accounting, "sample_hbm",
+                        lambda devices=None: {0: (7, 9)})
+    path = str(tmp_path / "emit.jsonl")
+    em = exporters.Emitter(60.0, path)
+    assert em.emit_once()
+    lines = open(path).read().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0])["metrics"] is not None
+    evs = [e for e in flightrec.tail(0) if e["kind"] == "hbm.watermark"]
+    assert evs and evs[-1]["source"] == "emitter"
+
+
+def test_perf_debug_view_registered_and_renders():
+    _warm("t.view")
+    devprof.set_sample(1.0)
+    telemetry.jit_call("t.view", _double, jnp.ones((4,)))
+    doc = devprof._perf_view()
+    assert doc["devprof"]["active"]
+    assert any(s["site"] == "t.view" for s in doc["devprof"]["sites"])
+    assert isinstance(doc["perf_verdicts"], list)
+    with httpd._VIEWS_LOCK:
+        assert "perf" in httpd._DEBUG_VIEWS

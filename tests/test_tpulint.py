@@ -3509,3 +3509,90 @@ def test_pallas_check_posonly_lambda_params_counted():
             )(x)
     """, "pallas-kernel-check")
     assert found == []
+
+
+# ---------------------------------------------------------------------------
+# unattributed-dispatch (the ISSUE-18 perf-attribution gate)
+# ---------------------------------------------------------------------------
+
+def test_unattributed_dispatch_pass_registered():
+    assert "unattributed-dispatch" in core.all_passes()
+
+
+def test_unattributed_dispatch_flags_direct_and_resilience_not_wrapped():
+    src = """
+        import jax
+        from mxnet_tpu import resilience, telemetry
+
+        _STEP = jax.jit(lambda x: x * 2)
+
+        def attributed(x):
+            return telemetry.jit_call("plane.step", _STEP, x)
+
+        def bare(x):
+            return _STEP(x)
+
+        def retried(x):
+            # retries the dispatch but attributes nothing
+            return resilience.call("plane.step", _STEP, x)
+    """
+    found = lint(src, "unattributed-dispatch")
+    assert len(found) == 2
+    msgs = "\n".join(f.message for f in found)
+    assert "telemetry.jit_call" in msgs  # the fix is named in the message
+    assert "resilience.call" in msgs
+    # outside mxnet_tpu/ the pass does not apply
+    assert lint(src, "unattributed-dispatch", relpath="tools/x.py") == []
+
+
+def test_unattributed_dispatch_decorated_call_by_name():
+    found = lint("""
+        import jax
+
+        @jax.jit
+        def _kernel(x):
+            return x + 1
+
+        def run(x):
+            return _kernel(x)
+    """, "unattributed-dispatch")
+    assert len(found) == 1
+    assert "@jit-decorated" in found[0].message
+
+
+def test_unattributed_dispatch_wrapped_sites_are_clean():
+    assert lint("""
+        import jax
+        from mxnet_tpu import telemetry
+
+        _STEP = jax.jit(lambda x: x * 2)
+
+        def a(x):
+            return telemetry.jit_call("plane.a", _STEP, x)
+
+        def b(x):
+            return telemetry.jit_call("plane.b", _STEP, x, donate=True)
+    """, "unattributed-dispatch") == []
+
+
+def test_unattributed_dispatch_repo_gate_clean_and_justified():
+    # the serving/train planes dispatch ONLY through telemetry.jit_call;
+    # the sanctioned bypasses (warmup laps, fused-optimizer internals,
+    # kernel-module plumbing under already-wrapped engine sites) ride
+    # the baseline WITH a justification each
+    files = collect_files(["mxnet_tpu"], root=REPO)
+    findings = [f for f in lint_files(files, root=REPO,
+                                      passes=["unattributed-dispatch"])]
+    baseline = load_baseline(DEFAULT_BASELINE)
+    assert apply_baseline(findings, baseline) == []
+    justs = core.load_justifications(DEFAULT_BASELINE)
+    for f in findings:
+        assert justs.get(f.baseline_key()), \
+            "unattributed-dispatch baseline entries must carry a " \
+            "justification: %s" % f.baseline_key()
+    # the decode engine's steady-state loop itself is fully attributed:
+    # its only baselined survivor is the warmup lap
+    decode = [f for f in findings if "serving/decode" in f.path]
+    assert all("warmup" in (justs.get(f.baseline_key()) or "").lower()
+               or "warm" in (justs.get(f.baseline_key()) or "").lower()
+               for f in decode)

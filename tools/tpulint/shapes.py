@@ -300,6 +300,24 @@ class JitRisk:
                    self.origin or "a data-dependent size"))
 
 
+class DispatchSite:
+    """One jit/pallas dispatch site the interpreter saw — wrapped (routed
+    through ``telemetry.jit_call``, so its recompiles and sampled device
+    time are attributed) or not. The unattributed-dispatch pass consumes
+    the unwrapped ones; JitRisk above stays the recompile-risk view of
+    the same sites."""
+
+    __slots__ = ("node", "relpath", "fn_label", "wrapped", "via")
+
+    def __init__(self, node: ast.AST, relpath: str, fn_label: str,
+                 wrapped: bool, via: str):
+        self.node = node
+        self.relpath = relpath
+        self.fn_label = fn_label
+        self.wrapped = wrapped
+        self.via = via  # "jit_call" | "resilience.call" | "direct" | "decorated"
+
+
 # ---------------------------------------------------------------------------
 # const-expression helpers shared with the pallas pass
 # ---------------------------------------------------------------------------
@@ -456,12 +474,14 @@ class ShapeAnalysis:
     def __init__(self, graph):
         self.graph = graph
         self.jit_risks: Dict[str, List[JitRisk]] = {}
+        self.dispatch_sites: Dict[str, List[DispatchSite]] = {}
         self.module_envs: Dict[str, Dict[str, AbsValue]] = {}
         self._param_summaries: Dict[object, Dict[str, AbsValue]] = {}
         self._return_summaries: Dict[object, AbsValue] = {}
         self._attr_tables: Dict[Tuple[str, str], Dict[str, AbsValue]] = {}
         self._jitted_defs: Set[ast.AST] = set()
         self._risks_by_fn: Dict[object, List[JitRisk]] = {}
+        self._sites_by_fn: Dict[object, List[DispatchSite]] = {}
         self._run()
 
     # -- summaries ----------------------------------------------------------
@@ -568,6 +588,23 @@ class ShapeAnalysis:
                                            getattr(r.node, "col_offset", 0)))
         self.jit_risks = risks
 
+        # dispatch sites mirror the risks plumbing, deduped per location
+        # (one call node can be recorded for several resolved targets)
+        sites: Dict[str, List[DispatchSite]] = {}
+        seen: Set[Tuple[str, int, int, bool]] = set()
+        for info, sitems in self._sites_by_fn.items():
+            for s in sitems:
+                key = (s.relpath, getattr(s.node, "lineno", 0),
+                       getattr(s.node, "col_offset", 0), s.wrapped)
+                if key in seen:
+                    continue
+                seen.add(key)
+                sites.setdefault(s.relpath, []).append(s)
+        for rel in sites:
+            sites[rel].sort(key=lambda s: (getattr(s.node, "lineno", 0),
+                                           getattr(s.node, "col_offset", 0)))
+        self.dispatch_sites = sites
+
     def _eval_function(self, info) -> bool:
         graph = self.graph
         env: Dict[str, AbsValue] = dict(self.module_envs.get(info.module, {}))
@@ -582,6 +619,7 @@ class ShapeAnalysis:
         body = node.body if isinstance(node.body, list) else [node.body]
         ev.exec_body(body)
         self._risks_by_fn[info] = ev.risks
+        self._sites_by_fn[info] = ev.sites
         return ev.changed
 
 
@@ -610,6 +648,7 @@ class _FuncEval:
         self.graph = ana.graph
         self.minfo = ana.graph.modules.get(info.module)
         self.risks: List[JitRisk] = []
+        self.sites: List[DispatchSite] = []
         self.changed = False
         #: one entry per enclosing loop: True when its trip count is
         #: bounded (iter over a literal/ladder/knob-range), False for
@@ -997,8 +1036,16 @@ class _FuncEval:
                 and len(node.args) >= 2:
             fn_val = args[1]
             if fn_val.tag == "jit" or self._is_jitted_ref(node.args[1]):
+                # only telemetry.jit_call ATTRIBUTES the dispatch
+                # (recompile accounting + sampled device time); a bare
+                # resilience.call around a jitted fn retries it but
+                # leaves it invisible to the perf plane
+                wrapped = tail in _JIT_CALL_WRAPPERS
                 self._record_jit_site(node, node.args[1], node.args[2:],
-                                      args[2:], node.keywords)
+                                      args[2:], node.keywords,
+                                      wrapped=wrapped,
+                                      via="jit_call" if wrapped
+                                      else "resilience.call")
             return UNKNOWN
 
         # direct call of a compiled callable: `self._step(...)`,
@@ -1181,13 +1228,13 @@ class _FuncEval:
             # decorated-jitted function called by name: a dispatch site
             if self._is_jitted_ref(node.func):
                 self._record_jit_site(node, node.func, node.args, args,
-                                      node.keywords)
+                                      node.keywords, via="decorated")
             return UNKNOWN
         result = UNKNOWN
         for target in targets:
             if target.node in self.ana._jitted_defs:
                 self._record_jit_site(node, node.func, node.args, args,
-                                      node.keywords)
+                                      node.keywords, via="decorated")
             t_args = target.node.args if hasattr(target.node, "args") \
                 else None
             if t_args is not None:
@@ -1214,8 +1261,15 @@ class _FuncEval:
     def _record_jit_site(self, call: ast.Call, fn_expr: ast.AST,
                          operand_nodes: Sequence[ast.AST],
                          operand_vals: Sequence[AbsValue],
-                         keywords: Sequence[ast.keyword] = ()) -> None:
+                         keywords: Sequence[ast.keyword] = (),
+                         wrapped: bool = False,
+                         via: str = "direct") -> None:
         label = dotted_name(fn_expr) or "jit(...)"
+        # every dispatch site is recorded (wrapped or not) for the
+        # unattributed-dispatch pass; the ⊤-operand filter below only
+        # gates the recompile-RISK records
+        self.sites.append(DispatchSite(call, self.info.relpath, label,
+                                       wrapped, via))
         pairs: List[Tuple[object, ast.AST, AbsValue]] = [
             (i, onode, oval) for i, (onode, oval)
             in enumerate(zip(operand_nodes, operand_vals))]
