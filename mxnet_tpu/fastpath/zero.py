@@ -47,6 +47,7 @@ back to plain per-parameter states whenever anything outside the plane
 """
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -56,6 +57,8 @@ import numpy as np
 from .. import telemetry
 from ..base import get_env
 from . import bucketing
+
+_LOG = logging.getLogger(__name__)
 
 __all__ = ["level", "ZeroPlane", "ShardedState", "eligible_reason",
            "note_fallback", "plane_of", "materialize_updater",
@@ -226,6 +229,25 @@ class ZeroPlane(object):
         self._home = None          # device the eager caller's arrays live on
         self._update_jits: Dict[Any, Any] = {}
         self._expand_jit = None
+        # register the packed-bucket worst case with the HBM pressure
+        # governor: per bucket, every state leaf flattens to the padded
+        # bucket length — the bytes this plan will pin per device before
+        # sharding divides them. Exception-guarded: the governor is
+        # observability, the plan must build regardless.
+        try:
+            from ..resilience import hbm as _hbm
+
+            nbytes = 0
+            for b, positions in enumerate(self.plan.buckets):
+                _, padded = self.plan.bucket_layout(b)
+                for leaf in jax.tree_util.tree_leaves(
+                        states[positions[0]]):
+                    nbytes += int(padded) * int(
+                        np.dtype(leaf.dtype).itemsize)
+            _hbm.register_bound("fastpath.zero.buckets", nbytes)
+        except Exception:  # noqa: BLE001 - the bound is advisory; the
+            # plane works without a governor registration
+            _LOG.debug("hbm bound registration failed", exc_info=True)
 
     # -- shardings ------------------------------------------------------
     def _shard(self):

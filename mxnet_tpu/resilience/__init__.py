@@ -19,6 +19,13 @@ piece                 what it gives you
 :mod:`.chaos`         deterministic seeded fault injection at named sites
                       (``MXNET_CHAOS="seed=7,site=kvstore.*,p=0.1"``);
                       free when disabled; ``mxnet_faults_injected_total``
+:mod:`.hbm`           :class:`PressureGovernor` — hysteresis-latched HBM
+                      pressure tiers (green/yellow/orange/red) over
+                      watermarks + plane-registered bounds, the
+                      degradation ladder the decode admission path
+                      consults, and OOM classification/survival
+                      (``classify``/``oom_survival``);
+                      ``mxnet_hbm_pressure_tier`` / ``mxnet_hbm_oom_total``
 ====================  =====================================================
 
 Hardened call sites (site label → module): ``transfer.fetch_host`` /
@@ -38,17 +45,20 @@ from typing import Dict, Optional
 
 from . import breaker as breaker_mod
 from . import chaos
+from . import hbm
 from . import policies
 from .breaker import CircuitBreaker, CircuitOpenError, breaker
 from .chaos import (ChaosAction, DropShard, FaultInjected, Killed,
-                    TornWrite, maybe_fail)
+                    OOMInjected, TornWrite, maybe_fail)
+from .hbm import PressureGovernor, classify, governor, oom_survival
 from .policies import DEFAULT_RETRY_ON, Deadline, RetryPolicy, TransientError
 
 __all__ = [
     "RetryPolicy", "Deadline", "TransientError", "DEFAULT_RETRY_ON",
     "CircuitBreaker", "CircuitOpenError", "breaker",
     "chaos", "FaultInjected", "ChaosAction", "Killed", "TornWrite",
-    "DropShard", "maybe_fail",
+    "DropShard", "OOMInjected", "maybe_fail",
+    "hbm", "PressureGovernor", "classify", "governor", "oom_survival",
     "call", "default_policy", "reset_default_policy", "snapshot",
 ]
 
@@ -115,4 +125,5 @@ def snapshot() -> Dict:
         "faults_injected": faults,
         "breakers": breakers,
         "chaos": chaos.summary(),
+        "hbm": hbm.governor().healthz_view(),
     }

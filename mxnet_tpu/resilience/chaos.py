@@ -42,7 +42,20 @@ action    what an injection does (default ``fault``):
             about fsync), proving restore's content-hash fallback;
           * ``drop-shard`` — raise :class:`DropShard`: the shard writer
             skips that shard's file entirely (post-commit loss), proving
-            the missing-file fallback.
+            the missing-file fallback;
+          * ``oom`` — raise :class:`OOMInjected`: a synthetic
+            ``RESOURCE_EXHAUSTED`` allocator failure that
+            :func:`~mxnet_tpu.resilience.hbm.classify` recognizes, so an
+            injected OOM takes the *identical* survival path as a real
+            one (eviction + governor red latch on the decode plane,
+            diagnostic dump + fallback on the train planes). Subclasses
+            :class:`FaultInjected` but is exempted from retry by the
+            retry policy's OOM guard — retrying a failed allocation
+            against a full device is not recovery. Aim it at the
+            dispatch/transfer/page-write sites: ``serving.decode``
+            (mid-tick), ``serving.decode.prefill`` (page writes),
+            ``jit.compile`` (any jitted dispatch, incl. train steps),
+            ``transfer.fetch_host``.
 ========  ==================================================================
 
 Determinism contract: each (rule, site) pair draws from its own
@@ -80,8 +93,9 @@ from ..base import MXNetError, get_env
 from .policies import TransientError
 
 __all__ = ["FaultInjected", "ChaosAction", "Killed", "TornWrite",
-           "DropShard", "maybe_fail", "configure", "disable", "active",
-           "parse_spec", "injected_counts", "summary", "ENABLED"]
+           "DropShard", "OOMInjected", "maybe_fail", "configure",
+           "disable", "active", "parse_spec", "injected_counts",
+           "summary", "ENABLED"]
 
 
 class FaultInjected(TransientError):
@@ -128,8 +142,28 @@ class DropShard(ChaosAction):
     action = "drop-shard"
 
 
+class OOMInjected(FaultInjected):
+    """Simulated allocator exhaustion (``action=oom``): the message
+    carries the literal ``RESOURCE_EXHAUSTED`` status text a real XLA
+    OOM would, and ``hbm.classify`` recognizes the type directly —
+    injected and real OOM share one survival code path. A
+    :class:`FaultInjected` by inheritance (the issue contract), but the
+    retry policy's OOM guard refuses to retry it: allocation failures
+    are cured by freeing memory, not by calling again."""
+
+    def __init__(self, site: str, call_index: int):
+        # deliberately bypass FaultInjected.__init__'s message
+        TransientError.__init__(
+            self, "chaos: injected oom at %s (call #%d): "
+            "RESOURCE_EXHAUSTED: out of memory (synthetic)"
+            % (site, call_index))
+        self.site = site
+        self.call_index = call_index
+
+
 _ACTIONS = {"fault": None, "kill": Killed, "torn-write": TornWrite,
-            "torn": TornWrite, "drop-shard": DropShard, "drop": DropShard}
+            "torn": TornWrite, "drop-shard": DropShard, "drop": DropShard,
+            "oom": OOMInjected}
 
 
 #: THE disabled-path switch: ``maybe_fail`` reads this module global and

@@ -18,7 +18,11 @@ tests can set them after import). Every retry event lands in telemetry as
 * ``retry``     — one backoff sleep is about to happen;
 * ``recovered`` — the call succeeded after at least one retry;
 * ``exhausted`` — attempts/budget/deadline ran out; the last error is
-  re-raised unchanged (callers keep their exception types).
+  re-raised unchanged (callers keep their exception types);
+* ``oom``       — the failure classified as out-of-memory
+  (``hbm.classify``): surfaced immediately without a single retry, even
+  when transient-typed — re-dispatching an allocation against a full
+  device is not recovery; the owning plane's survival path handles it.
 
 Nothing here is chaos-specific: :mod:`.chaos` raises
 :class:`~mxnet_tpu.resilience.chaos.FaultInjected` (a
@@ -84,6 +88,19 @@ class Deadline:
         return "Deadline(%.3fs remaining)" % self.remaining()
 
 
+def _is_oom(exc: BaseException) -> bool:
+    """True when ``exc`` classifies as an out-of-memory failure (lazy
+    import: :mod:`.hbm` sits above this module in the package graph).
+    OOMs are the one transient-typed class the policy refuses to retry
+    — see the ``outcome="oom"`` branch in :meth:`RetryPolicy.call`."""
+    try:
+        from . import hbm
+
+        return hbm.classify(exc) is not None
+    except Exception:  # noqa: BLE001 - the guard must never turn a
+        return False   # retryable failure into a policy crash
+
+
 _RETRIES = None
 
 
@@ -100,7 +117,7 @@ def retries_counter():
         _RETRIES = telemetry.counter(
             "mxnet_retries_total",
             "retry-policy events per call site "
-            "(outcome: retry/recovered/exhausted)",
+            "(outcome: retry/recovered/exhausted/oom)",
             labels=("site", "outcome"))
     return _RETRIES
 
@@ -181,7 +198,16 @@ class RetryPolicy:
             attempt += 1
             try:
                 out = fn(*args, **kwargs)
-            except self.retry_on:
+            except self.retry_on as exc:
+                if _is_oom(exc):
+                    # a classified OOM is transient-shaped (OOMInjected
+                    # subclasses TransientError) but NOT retry-curable:
+                    # the device is full, and re-dispatching the same
+                    # allocation burns the backoff budget against a wall.
+                    # Surface it immediately to the owning plane's
+                    # survival path (hbm.oom_survival).
+                    retries_counter().inc(site=site, outcome="oom")
+                    raise
                 if attempt >= self.max_attempts:
                     retries_counter().inc(site=site, outcome="exhausted")
                     raise

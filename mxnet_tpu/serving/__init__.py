@@ -64,8 +64,9 @@ from .engine import BlockEngine, Engine, StableHLOEngine
 from .fleet import FleetRouter
 from .kvcache import OutOfPagesError, PagedKVCache, PrefixMatch
 from .stats import ServingStats, TenantStats
-from .tenancy import (Tenant, TenantBreaker, TenantRegistry,
-                      TenantUnavailableError, WeightedFairQueue)
+from .tenancy import (PRIORITY_CLASSES, Tenant, TenantBreaker,
+                      TenantRegistry, TenantUnavailableError,
+                      WeightedFairQueue)
 
 __all__ = [
     "Engine", "BlockEngine", "StableHLOEngine",
@@ -77,7 +78,7 @@ __all__ = [
     "DecodeEngine", "PagedDecodeModel", "TinyDecoder", "FleetRouter",
     "PagedKVCache", "OutOfPagesError", "PrefixMatch",
     "Tenant", "TenantRegistry", "TenantBreaker",
-    "TenantUnavailableError", "WeightedFairQueue",
+    "TenantUnavailableError", "WeightedFairQueue", "PRIORITY_CLASSES",
 ]
 
 

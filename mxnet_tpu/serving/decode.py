@@ -110,13 +110,15 @@ from ..telemetry import slo as _slo
 from ..telemetry import tracing as _tracing
 from ..base import MXNetError, fetch_host, get_env
 from ..resilience import CircuitBreaker, chaos
+from ..resilience import hbm as _hbm
 from .batcher import (EngineUnavailableError, QueueFullError,
                       RequestTimeoutError, ServerClosedError)
 from .buckets import select_bucket
 from .kvcache import OutOfPagesError, PagedKVCache, PrefixMatch, write_kv
 from .stats import ServingStats
-from .tenancy import (SHARED_TENANT, Tenant, TenantRegistry,
-                      TenantUnavailableError, WeightedFairQueue)
+from .tenancy import (PRIORITY_CLASSES, SHARED_TENANT, Tenant,
+                      TenantRegistry, TenantUnavailableError,
+                      WeightedFairQueue)
 
 __all__ = ["DecodeEngine", "PagedDecodeModel", "TinyDecoder"]
 
@@ -354,6 +356,28 @@ class DecodeEngine:
         # the SLO engine's burn ratios divide by bounds the registry
         # cannot carry — register this engine's queue capacity
         _slo.note_bound("queue_depth", name, self._queue_depth)
+        # HBM pressure governor: register this engine's worst-case byte
+        # bounds and consult the degradation ladder at admission (see
+        # _admit/_admit_guard). The KV pool is statically allocated, so
+        # its bound is a constant; pending prefill is a callable bound —
+        # every queued request may reserve up to max_seq_len of pages
+        # (total_queued() reads one int, safe from any thread).
+        self._governor = _hbm.governor()
+        pool_bytes = int(self._cache.k_pool.nbytes
+                         + self._cache.v_pool.nbytes)
+        self._governor.register_bound("serving.%s.kv_pool" % name,
+                                      pool_bytes)
+        page_bytes = pool_bytes // max(1, self._cache.num_pages)
+        worst_pages = self._cache.pages_for(self.max_seq_len)
+        self._governor.register_bound(
+            "serving.%s.pending_prefill" % name,
+            lambda: self._wfq.total_queued() * worst_pages * page_bytes)
+        #: post-OOM governed re-admission cap (admit FEWER sequences at
+        #: the same static slot shapes); None = ungoverned. Worker-only.
+        self._governed_limit: Optional[int] = None
+        #: the tier _admit observed this pass; _admit_guard (same worker
+        #: pass, under _cv) reads it for the orange batch-defer rung
+        self._tick_tier = "green"
         self._params_sig = _tree_sig(params)
         self._pending_swaps: List[tuple] = []
         self._variants = {}
@@ -747,8 +771,15 @@ class DecodeEngine:
                 "weight_swaps": self._swaps,
                 "active_variant": self._active_variant,
             })
+            governed = self._governed_limit
         out["tenants"] = self._tenants.snapshot()
         out["kvcache"] = self._cache.stats()
+        # the governor's verdict rides every stats snapshot (the fleet's
+        # replica rows and /debug/state read it from here)
+        hv = self._governor.healthz_view()
+        hv["governed_limit"] = governed
+        hv["pressure_sheds"] = self._cache.pressure_sheds
+        out["hbm"] = hv
         out["prefix_cache_enabled"] = self._prefix_cache
         if self._prefix_cache:
             out["prefix_hit_ratio"] = out["kvcache"]["prefix_hit_ratio"]
@@ -798,6 +829,13 @@ class DecodeEngine:
             self._fail(req, exc)
         if self._thread is not threading.current_thread():
             self._thread.join(timeout)
+        # the governor outlives the engine (process-global): replace the
+        # live-state bounds with zeros so a closed engine neither skews
+        # pressure nor stays pinned through the pending-prefill closure
+        self._governor.register_bound(
+            "serving.%s.kv_pool" % self._name, 0)
+        self._governor.register_bound(
+            "serving.%s.pending_prefill" % self._name, 0)
         if not drain:
             return 0
         drained = max(0, self._stats.completed - before)
@@ -1039,6 +1077,16 @@ class DecodeEngine:
         if tenant.breaker.state == "open":
             _tracing.event(req.trace, "defer", reason="breaker")
             return False
+        # orange-tier ladder rung: batch-class tenants defer while the
+        # governor reports pressure — a deferral, not a shed (the
+        # request stays queued and admits when the tier recedes), and
+        # it NEVER touches interactive/standard heads: anti-head-of-line
+        # means the batch head's turn simply passes to them
+        if self._tick_tier in ("orange", "red") \
+                and tenant.priority >= PRIORITY_CLASSES["batch"]:
+            tenant.stats.on_defer("pressure")
+            _tracing.event(req.trace, "defer", reason="pressure")
+            return False
         total = int(req.prompt.size) + req.max_new
         # the admission walk: map-able shared prefix pages reduce both
         # the global reservation AND the tenant's charge — reserve()
@@ -1084,7 +1132,45 @@ class DecodeEngine:
         return True
 
     def _admit(self):
+        # the governor's degradation ladder, consulted once per
+        # admission pass (observe() is pure host arithmetic over the
+        # bound registry — tick-rate cheap):
+        #   yellow+  shed cached-LRU ref-0 prefix pages proactively
+        #   orange   shrink the admission quantum to 1/pass and defer
+        #            batch-class tenants (_admit_guard, never interactive)
+        #   red      stop new admissions entirely; in-flight sequences
+        #            keep decoding — completion is what drains pressure
+        tier = self._governor.observe(source="decode.admit")
+        with self._cv:
+            # _cv guards both governor fields: _admit_guard reads
+            # _tick_tier under the pop's lock, stats() reads
+            # _governed_limit from caller threads
+            # the only reader, _admit_guard, is a callback invoked through
+            # _wfq.pop() inside this same worker's `with self._cv` block —
+            # lock-guarded on both sides, just through an indirection the
+            # analyzer cannot follow
+            self._tick_tier = tier  # tpulint: disable=shared-state-race
+            if self._governed_limit is not None and tier == "green" \
+                    and not self._governor.latched:
+                self._governed_limit = None
+            governed = self._governed_limit
+        if tier != "green":
+            shed = self._cache.shed_cached()
+            if shed:
+                self._governor.note_shed(shed, self._cache.name)
+                _T_EVENTS.inc(server=self._name, event="pressure_shed")
+        if tier == "red":
+            return
+        limit = self.num_slots
+        if governed is not None:
+            # post-OOM governed re-admission: fewer sequences, same
+            # static slot shapes, until the governor recovers green
+            limit = min(limit, governed)
+        quantum = 1 if tier == "orange" else self.num_slots
+        admitted = 0
         while True:
+            if sum(1 for r in self._slots if r is not None) >= limit:
+                return
             slot = next((i for i, r in enumerate(self._slots)
                          if r is None), None)
             if slot is None:
@@ -1106,14 +1192,45 @@ class DecodeEngine:
                 tenant.on_request_failure()
                 self._stats.on_error()
                 self._fail(req, exc)
-                if self._pools_dead():
-                    # ...unless the failed execution consumed the donated
-                    # pools: every live sequence's KV died with them, so
-                    # evict them all onto fresh pools (empty `active`
-                    # still re-zeroes — reset_pools runs either way)
+                if self._on_oom("serving.decode.prefill", exc) \
+                        or self._pools_dead():
+                    # ...unless the failure classified as an OOM (an
+                    # allocation died — every pool byte is suspect, and
+                    # the governor just latched red) or the failed
+                    # execution consumed the donated pools: every live
+                    # sequence's KV died with them, so evict them all
+                    # onto fresh pools (empty `active` still re-zeroes —
+                    # reset_pools runs either way)
                     self._evict([(i, r) for i, r
                                  in enumerate(self._slots)
                                  if r is not None], exc)
+                    return
+            admitted += 1
+            if admitted >= quantum:
+                # orange's shrunk admission quantum: one admission per
+                # pass keeps new prefill load trickling while pressure
+                # is worked off
+                return
+
+    def _on_oom(self, plane: str, exc: BaseException) -> bool:
+        """OOM classification at a failure site: False (untouched) for a
+        non-OOM exception. A classified OOM — real ``RESOURCE_EXHAUSTED``
+        out of XLA or the chaos harness's ``action=oom`` — runs the
+        shared survival routine (``hbm.oom_survival``: diagnostic into
+        the flight recorder, governor latched red, per-plane counter)
+        and arms governed re-admission: after the caller's full
+        eviction, ``_admit`` re-admits at half the sequence count that
+        was in flight (``MXNET_HBM_RED_ADMIT`` overrides) until the
+        governor recovers green. Slot shapes never change — fewer
+        sequences, same jit signatures, zero recompiles."""
+        if not _hbm.oom_survival(plane, exc, dump=False):
+            return False
+        active = sum(1 for r in self._slots if r is not None)
+        with self._cv:
+            self._governed_limit = self._governor.governed_admit(
+                max(1, active))
+        _T_EVENTS.inc(server=self._name, event="oom")
+        return True
 
     def _prefill(self, req: _DecodeRequest, slot: int):
         # tenant-scoped chaos site, OUTSIDE the retry policy: a fault
@@ -1295,7 +1412,8 @@ class DecodeEngine:
             req.tenant.on_request_failure()
             self._stats.on_error()
             self._fail(req, exc)
-            if self._pools_dead():
+            if self._on_oom("serving.decode.prefill", exc) \
+                    or self._pools_dead():
                 self._evict([(i, r) for i, r in enumerate(self._slots)
                              if r is not None], exc)
             return
@@ -1431,6 +1549,11 @@ class DecodeEngine:
             # tick like a failed step instead of killing the worker.
             toks = fetch_host([sampled])[0]
         except Exception as exc:  # noqa: BLE001 - evict, don't die
+            # OOM first: a classified RESOURCE_EXHAUSTED (or injected
+            # action=oom) additionally latches the governor red and arms
+            # governed re-admission before the same full-eviction path
+            # below reclaims every page
+            self._on_oom("serving.decode", exc)
             self._breaker.on_failure()
             # the pool re-zero kills EVERY in-flight sequence's KV —
             # chunked-prefilling slots included, not just this tick's

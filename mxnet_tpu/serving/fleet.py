@@ -917,6 +917,14 @@ class FleetRouter:
             }
             rows = [(r, len(r.inflight), r.routed, r.deaths, r.state)
                     for r in reps]
+        # the pressure governor is process-global (one HBM), so the
+        # fleet view carries one tier, not a per-replica copy
+        try:
+            from ..resilience import hbm as _hbm
+
+            doc["hbm"] = _hbm.governor().healthz_view()
+        except Exception:  # noqa: BLE001 - debug view stays up
+            doc["hbm"] = None
         for rep, inflight, routed, deaths, state in rows:
             row = {"state": state, "breaker": rep.breaker.state,
                    "inflight": inflight, "routed": routed,

@@ -89,6 +89,12 @@ _T_PREFIX_MISSES = telemetry.counter(
     "mxnet_kvcache_prefix_misses_total",
     "admissions that found no cached prefix",
     labels=("cache",))
+_T_PRESSURE_SHEDS = telemetry.counter(
+    "mxnet_kvcache_pressure_sheds_total",
+    "cached-LRU (refcount-0) prefix pages proactively returned to the "
+    "free list by the HBM pressure governor's yellow-tier ladder rung "
+    "(shed_cached) — warm capacity traded for headroom",
+    labels=("cache",))
 
 
 class OutOfPagesError(MXNetError):
@@ -231,6 +237,7 @@ class PagedKVCache:
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.prefix_tokens_matched = 0
+        self.pressure_sheds = 0
         # bumped on every table mutation (reserve/free): the decode
         # engine keys its cached DEVICE copy of the page table on it, so
         # steady decode ticks skip the host->device put entirely
@@ -538,6 +545,29 @@ class PagedKVCache:
             if not kids:
                 del self._children[entry.parent]
         self._page_entry.pop(entry.page, None)
+
+    def shed_cached(self, n: Optional[int] = None) -> int:
+        """Proactively reclaim up to ``n`` (``None`` = all) cached-LRU
+        refcount-0 pages to the free list, oldest-first — the governor's
+        *yellow*-tier ladder rung. Distinct from demand reclaim inside
+        ``_take_page`` (which takes cached pages only when a reservation
+        needs them): shedding trades warm prefix capacity for free-list
+        headroom *before* anything asks, so an admission under pressure
+        never has to choose between deferring and evicting. Touches only
+        pages no live sequence references — sequences in flight are
+        unaffected. Returns the number of pages shed and counts them in
+        ``mxnet_kvcache_pressure_sheds_total{cache=}``."""
+        shed = 0
+        while self._cached and (n is None or shed < n):
+            page, entry = self._cached.popitem(last=False)
+            self._index_remove(entry)
+            self._free.append(page)
+            shed += 1
+        if shed:
+            self.pressure_sheds += shed
+            _T_PRESSURE_SHEDS.inc(shed, cache=self.name)
+            self._publish()
+        return shed
 
     def clear_prefix_index(self) -> None:
         """Drop EVERY index entry and return cached (refcount-0) pages

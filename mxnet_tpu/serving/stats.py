@@ -353,6 +353,7 @@ class TenantStats:
         self.errors = 0
         self.deferred_pages = 0
         self.deferred_rate = 0
+        self.deferred_pressure = 0
         self._queue_depth = 0
         self._slots = 0
         self._pages = 0
@@ -394,12 +395,16 @@ class TenantStats:
         _T_TEN_REQS.inc(event="error", **self._labels())
 
     def on_defer(self, kind: str):
-        """One admission-guard deferral (``pages`` or ``rate``). Counts
-        *defer events* — the admission loop may defer the same head
-        request many times before it finally fits."""
+        """One admission-guard deferral (``pages``, ``rate`` or
+        ``pressure`` — the last is the HBM governor's orange-tier
+        batch-class rung). Counts *defer events* — the admission loop
+        may defer the same head request many times before it finally
+        fits."""
         with self._lock:
             if kind == "pages":
                 self.deferred_pages += 1
+            elif kind == "pressure":
+                self.deferred_pressure += 1
             else:
                 self.deferred_rate += 1
         _T_TEN_REQS.inc(event="deferred_" + kind, **self._labels())
@@ -454,6 +459,7 @@ class TenantStats:
                 "errors": self.errors,
                 "deferred_pages": self.deferred_pages,
                 "deferred_rate": self.deferred_rate,
+                "deferred_pressure": self.deferred_pressure,
             }
         _percentile_rows(out, (("latency", lat), ("ttft", ttft),
                                ("tpot", tpot)))

@@ -21,7 +21,11 @@ This module makes the sharing *deliberate*:
   to arrival order. A tenant that cannot be admitted right now (page
   budget, rate budget, open breaker) is *deferred* — skipped without
   blocking the tenants behind it, which is exactly the head-of-line
-  coupling the FIFO had;
+  coupling the FIFO had. The HBM pressure governor
+  (:mod:`mxnet_tpu.resilience.hbm`) adds one more deferral rung: under
+  ``orange``/``red`` tiers the engine defers ``batch``-class tenants
+  (``deferred_pressure`` in the stats snapshot) while ``interactive``
+  traffic keeps flowing — degradation never inverts priority;
 * **per-tenant circuit breakers** — :class:`TenantBreaker` counts a
   tenant's own request failures in a sliding window and sheds *that
   tenant alone* (:class:`TenantUnavailableError`) while the engine-level

@@ -326,6 +326,16 @@ def hbm_watermark(source: str = "devprof") -> Dict[int, tuple]:
             "hbm.watermark", source=source,
             devices={str(d): {"in_use": u, "peak": p}
                      for d, (u, p) in stats.items()})
+        # feed the pressure governor: real device usage joins the
+        # plane-registered bounds in its tier computation (lazy import —
+        # telemetry loads before resilience; guarded like everything
+        # else on this sampling path)
+        try:
+            from ..resilience import hbm as _hbm
+
+            _hbm.governor().observe_device(stats, source=source)
+        except Exception:  # noqa: BLE001 - never break the sampler
+            _LOG.debug("hbm governor feed failed", exc_info=True)
     return stats
 
 

@@ -12,6 +12,9 @@ published by the planes the framework already instruments:
   deadline evictions and weight swaps,
 * chaos faults, recompiles, serving fallback demotions,
 * checkpoint commits, preemptions, elastic stalls,
+* HBM pressure-tier edges (``hbm.pressure``) and classified-OOM
+  survival diagnostics (``hbm.oom``, carrying the governor's full
+  per-plane memory breakdown — the OOM post-mortem artifact),
 * bench backend-init steps.
 
 On a death signal — watchdog stall, SIGTERM, the decode engine-thread

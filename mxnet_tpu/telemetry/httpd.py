@@ -189,10 +189,26 @@ class _Handler(BaseHTTPRequestHandler):
         paging = [a for a in alerts if a["level"] == "page"]
         open_breakers = {s: st for s, st in breakers.items()
                          if st == "open"}
-        status = "ok" if not paging and not open_breakers else "degraded"
-        return {"status": status, "breakers": breakers,
-                "alerts": alerts,
-                "open_breakers": sorted(open_breakers)}
+        pressure = None
+        try:
+            from ..resilience import hbm as _hbm
+
+            pressure = _hbm.governor().healthz_view()
+        except Exception:  # noqa: BLE001 - resilience may not be loaded
+            _LOG.debug("hbm governor unavailable", exc_info=True)
+        # Governor red == new admissions stopped: the load balancer
+        # must route around this replica even if no SLO alert has
+        # sampled the tier gauge yet this cadence.
+        red = bool(pressure) and (pressure.get("tier") == "red"
+                                  or pressure.get("latched"))
+        status = ("ok" if not paging and not open_breakers and not red
+                  else "degraded")
+        doc = {"status": status, "breakers": breakers,
+               "alerts": alerts,
+               "open_breakers": sorted(open_breakers)}
+        if pressure is not None:
+            doc["pressure"] = pressure
+        return doc
 
 
 _LOCK = threading.Lock()

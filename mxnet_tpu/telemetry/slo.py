@@ -60,7 +60,7 @@ BURN = _registry.gauge(
 ALERTS = ("QueueDepthBurn", "TenantQueueBurn", "SlotOccupancyBurn",
           "PagesBurn", "TenantPagesOverBudget", "TenantBreakerOpen",
           "EngineBreakerOpen", "TTFTBurn", "PrefixHitCollapse",
-          "RecompileStorm", "FleetImbalanceBurn")
+          "RecompileStorm", "FleetImbalanceBurn", "HBMPressureBurn")
 
 
 def _rows(name: str) -> List[Dict[str, Any]]:
@@ -166,7 +166,8 @@ class SLOEngine:
             "mxnet_decode_slot_occupancy", "mxnet_kvcache_pages_in_use",
             "mxnet_kvcache_pages_capacity", "mxnet_tenant_pages_in_use",
             "mxnet_tenant_breaker_state", "mxnet_breaker_state",
-            "mxnet_steady_state_recompiles", "mxnet_fleet_load_imbalance")
+            "mxnet_steady_state_recompiles", "mxnet_fleet_load_imbalance",
+            "mxnet_hbm_pressure_tier")
         for name in watch_gauges:
             for row in _rows(name):
                 self._observe(name, _label_key(row["labels"]),
@@ -360,6 +361,28 @@ class SLOEngine:
                            "page", 0.0, "rollback trigger for the last "
                            "deploy/swap")
 
+        # HBMPressureBurn: the pressure governor's tier gauge (0=green ..
+        # 3=red). Red pages on ANY sample — red means admissions are
+        # stopped and /healthz is 503ing, so the on-call learns NOW, not
+        # after a sustained window. Orange only warns, and only when it
+        # is the fast-window norm rather than a single shed-and-recover
+        # blip the ladder already absorbed.
+        for row in _rows("mxnet_hbm_pressure_tier"):
+            inst = _label_key(row["labels"])
+            if row["value"] >= 3.0:
+                self._burn(fired, "HBMPressureBurn", inst, row["value"],
+                           3.0, "page", 0.0, "governor is red: new "
+                           "admissions stopped; see /debug/state hbm view "
+                           "and docs/resilience.md memory-pressure runbook")
+            else:
+                m_fast = self._mean("mxnet_hbm_pressure_tier", inst,
+                                    fast, now)
+                if m_fast is not None and m_fast >= 2.0:
+                    self._burn(fired, "HBMPressureBurn", inst, m_fast, 2.0,
+                               "warn", fast, "sustained orange: admission "
+                               "quanta shrunk and batch tenants deferred; "
+                               "shed load or raise MXNET_HBM_CAPACITY_BYTES")
+
         fired.sort(key=lambda a: (a["level"] != "page", -a["burn"]))
         self._publish(fired)
         return fired
@@ -420,6 +443,13 @@ class SLOEngine:
                 out.append("tenant pages %s > budget %s at %r but "
                            "TenantPagesOverBudget did not fire"
                            % (row["value"], budget, inst))
+        # HBMPressureBurn pages <=> the tier gauge reads red right now
+        hbm_rows = [(r, _label_key(r["labels"]))
+                    for r in _rows("mxnet_hbm_pressure_tier")]
+        red = [inst for r, inst in hbm_rows if r["value"] >= 3.0]
+        if red and "HBMPressureBurn" not in fired_alerts:
+            out.append("hbm pressure tier is red at %s but "
+                       "HBMPressureBurn did not fire" % red)
         # EngineBreakerOpen <=> a serving breaker gauge reads open
         open_sites = [
             _label_key(r["labels"])

@@ -595,6 +595,13 @@ class TrainPlane(_PlaneBase):
                 # sharded trace failing must not kill training; the
                 # replicated step below reuses the SAME prologue scalars
                 # (counters already advanced — no double count)
+                from .resilience import hbm as hbm_mod
+
+                # a ZeRO-step OOM commits the structured HBM diagnostic
+                # (bucket-bytes bound included) to a flightrec dump and
+                # latches the governor BEFORE the fallback — no-op for
+                # every non-OOM trace failure
+                hbm_mod.oom_survival("fastpath.zero", exc, dump=True)
                 zero_mod.note_fallback("trace: %s" % type(exc).__name__)
                 zero_mod.materialize_updater(updater)
                 self._zero_broken = type(exc).__name__
@@ -675,12 +682,36 @@ class TrainPlane(_PlaneBase):
             if _devprof.tick_begin():
                 t0 = time.perf_counter()
                 try:
-                    return self._graph_step(data_nd, label_nd, batch_size)
+                    return self._graph_step_guarded(data_nd, label_nd,
+                                                    batch_size)
                 finally:
                     _devprof.note_train_step(
                         (time.perf_counter() - t0) * 1e3)
-            return self._graph_step(data_nd, label_nd, batch_size)
+            return self._graph_step_guarded(data_nd, label_nd, batch_size)
         return self._eager_step(data_nd, label_nd, batch_size)
+
+    def _graph_step_guarded(self, data_nd, label_nd, batch_size):
+        """Never-a-crash at the graph plane's own dispatch: a step
+        failure that classifies as OOM (real ``RESOURCE_EXHAUSTED`` or
+        chaos ``action=oom``) first lands the structured HBM diagnostic
+        — per-plane registered bounds + watermark history — in a
+        flight-recorder dump (``hbm.oom_survival``), then demotes to the
+        eager plane and runs the step there: training continues, the
+        post-mortem is on disk. Anything non-OOM still propagates —
+        a programming error must fail fast, not hide behind a fallback.
+        Best-effort caveat: a real OOM *mid-execution* may have consumed
+        donated param buffers (nothing can resurrect those); the
+        injected-OOM path raises before dispatch and always survives."""
+        from .resilience import hbm as hbm_mod
+
+        try:
+            return self._graph_step(data_nd, label_nd, batch_size)
+        except Exception as exc:  # noqa: BLE001 - OOM-only survival
+            if not hbm_mod.oom_survival("trainplane.step", exc,
+                                        dump=True):
+                raise
+            self._demote("oom: %s" % type(exc).__name__)
+            return self._eager_step(data_nd, label_nd, batch_size)
 
     @property
     def mesh(self):

@@ -1,0 +1,66 @@
+"""Seeded oom-masking bugs — fixture source for the tpulint pass tests.
+
+``tests/test_tpulint.py::test_oom_masking_*`` lints this file under a
+``mxnet_tpu/`` pseudo-path. Two seeded masks (a logged-and-defaulted
+dispatch catch, an XlaRuntimeError retry loop) must fire; the routed,
+re-raising and narrow handlers below them must not. Not imported at
+runtime — pure fixture source.
+"""
+import logging
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.resilience import hbm
+from mxnet_tpu.serving.utils import fetch_host
+
+_LOG = logging.getLogger(__name__)
+
+
+# -- bug 1: dispatch OOM logged and defaulted --------------------------------
+# the handler "handles" the failure locally: the governor never learns,
+# admission re-runs at the size that just blew up.
+
+def masked_step(fn, params, batch):
+    try:
+        return telemetry.jit_call("train.step", fn, params, batch)
+    except Exception as exc:  # BUG: OOM masked — no classify, no re-raise
+        _LOG.warning("step failed: %r", exc)
+        return None
+
+
+# -- bug 2: XlaRuntimeError swallowed around a transfer ----------------------
+
+def masked_fetch(arrays, XlaRuntimeError):
+    try:
+        return fetch_host(arrays)
+    except XlaRuntimeError:  # BUG: RESOURCE_EXHAUSTED retried blindly
+        return fetch_host(arrays)
+
+
+# -- clean: handler routes through the survival plane ------------------------
+
+def surviving_step(fn, params, batch):
+    try:
+        return telemetry.jit_call("train.step", fn, params, batch)
+    except Exception as exc:
+        if not hbm.oom_survival("train.step", exc):
+            raise
+        return None
+
+
+# -- clean: handler re-raises (an outer guarded layer classifies) ------------
+
+def reraising_step(fn, params, batch):
+    try:
+        return telemetry.jit_call("train.step", fn, params, batch)
+    except Exception as exc:
+        _LOG.warning("step failed: %r", exc)
+        raise
+
+
+# -- clean: narrow catch cannot see an OOM -----------------------------------
+
+def narrow_step(fn, params, batch):
+    try:
+        return telemetry.jit_call("train.step", fn, params, batch)
+    except KeyError:
+        return None

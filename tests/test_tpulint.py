@@ -377,6 +377,104 @@ def test_swallowed_error_suppressible():
 
 
 # ---------------------------------------------------------------------------
+# oom-masking
+# ---------------------------------------------------------------------------
+
+def test_oom_masking_positive_broad_and_xla():
+    found = lint("""
+        import telemetry
+
+        def step(fn, x, log):
+            try:
+                return telemetry.jit_call("s", fn, x)
+            except Exception as exc:
+                log.warning("boom: %r", exc)
+                return None
+
+        def fetch(arrays, XlaRuntimeError):
+            try:
+                return fetch_host(arrays)
+            except XlaRuntimeError:
+                return None
+    """, "oom-masking")
+    assert len(found) == 2
+    assert all("hbm.classify" in f.message for f in found)
+
+
+def test_oom_masking_negative_routed_or_reraised():
+    assert not lint("""
+        import telemetry
+        from mxnet_tpu.resilience import hbm
+
+        def survives(fn, x):
+            try:
+                return telemetry.jit_call("s", fn, x)
+            except Exception as exc:
+                if not hbm.oom_survival("s", exc):
+                    raise
+                return None
+
+        def reraises(fn, x, log):
+            try:
+                return telemetry.jit_call("s", fn, x)
+            except Exception as exc:
+                log.warning("boom: %r", exc)
+                raise
+
+        def classifies(fn, x, log):
+            try:
+                return telemetry.jit_call("s", fn, x)
+            except Exception as exc:
+                kind = hbm.classify(exc)
+                log.warning("kind=%s", kind)
+                return None
+    """, "oom-masking")
+
+
+def test_oom_masking_needs_dispatch_in_try():
+    # a broad catch around host-only work is swallowed-error's beat, not
+    # an OOM mask — no dispatch/transfer call, no finding
+    assert not lint("""
+        def f(q, log):
+            try:
+                q.get()
+            except Exception as exc:
+                log.warning("boom: %r", exc)
+                return None
+    """, "oom-masking")
+
+
+def test_oom_masking_narrow_catch_and_scope():
+    src = """
+        import telemetry
+
+        def step(fn, x):
+            try:
+                return telemetry.jit_call("s", fn, x)
+            except KeyError:
+                return None
+    """
+    assert not lint(src, "oom-masking")
+    broad = src.replace("KeyError", "Exception")
+    assert lint(broad, "oom-masking", relpath="mxnet_tpu/x.py")
+    assert not lint(broad, "oom-masking", relpath="tools/x.py")
+
+
+OOM_BUGS = (REPO / "tests" / "fixtures" / "tpulint_oom_bugs.py").read_text()
+
+
+def test_oom_masking_seeded_fixture():
+    found = lint_source("mxnet_tpu/_oom_bugs.py", OOM_BUGS,
+                        passes=["oom-masking"])
+    lines = sorted(f.line for f in found)
+    assert len(found) == 2
+    # the two seeded masks fire; the routed/re-raising/narrow handlers
+    # below them stay clean
+    texts = [OOM_BUGS.splitlines()[ln - 1] for ln in lines]
+    assert all("BUG" in t for t in texts)
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,117 @@
+"""oom-masking: device-OOM swallowed without classification.
+
+An HBM out-of-memory surfaces out of XLA as ``XlaRuntimeError``
+(``RESOURCE_EXHAUSTED``) at a jit dispatch or a device<->host transfer.
+A handler that catches those sites broadly and "handles" the error
+locally — logs it, returns a default, retries — *masks* the OOM: the
+pressure governor never latches red, admission keeps running at the
+size that just blew up, and the next dispatch OOMs again, forever.
+The survival plane only works if every catch around a dispatch/transfer
+site routes the exception through :func:`mxnet_tpu.resilience.hbm.classify`
+(or :func:`~mxnet_tpu.resilience.hbm.oom_survival` / the engine's
+``_on_oom`` wrapper) or re-raises so an outer guarded layer can.
+
+Flagged: an ``except`` clause in ``mxnet_tpu/`` that
+
+* catches broadly (bare, ``Exception``/``BaseException``, or anything
+  named ``*XlaRuntimeError``), AND
+* guards a ``try`` body that calls a dispatch/transfer site
+  (``jit_call``, ``fetch_host``, ``asnumpy``, ``device_put``,
+  ``device_get``, ``block_until_ready``), AND
+* whose handler neither re-raises (any ``raise``) nor calls
+  ``classify`` / ``oom_survival`` / ``_on_oom`` / ``oom_sentinel``.
+
+Handlers that re-raise conditionally still pass — routing the *decision*
+is the point, not unconditionality. Sites with a justified local catch
+(e.g. a debug endpoint that must answer) carry a
+``# tpulint: disable=oom-masking`` or ride the baseline.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, Pass, dotted_name, register
+
+#: calls that can surface a device RESOURCE_EXHAUSTED
+_DISPATCH = {"jit_call", "fetch_host", "asnumpy", "device_put",
+             "device_get", "block_until_ready"}
+
+#: handler calls that count as routing the error through the OOM plane
+_ROUTES = {"classify", "oom_survival", "_on_oom", "oom_sentinel"}
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _last_part(name) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _catches_oom(type_node) -> bool:
+    """Bare except, broad Exception, or an XlaRuntimeError spelling."""
+    if type_node is None:
+        return True
+    name = dotted_name(type_node)
+    if name is not None:
+        last = _last_part(name)
+        return last in _BROAD or last.endswith("XlaRuntimeError")
+    if isinstance(type_node, ast.Tuple):
+        return any(_catches_oom(elt) for elt in type_node.elts)
+    return False
+
+
+def _calls_in(nodes):
+    for stmt in nodes:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                yield node
+
+
+def _guards_dispatch(try_body) -> bool:
+    for call in _calls_in(try_body):
+        if _last_part(dotted_name(call.func)) in _DISPATCH:
+            return True
+    return False
+
+
+def _handler_routes(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call) \
+                    and _last_part(dotted_name(node.func)) in _ROUTES:
+                return True
+    return False
+
+
+@register
+class OOMMaskingPass(Pass):
+    name = "oom-masking"
+    description = ("broad catch around a jit dispatch/transfer site whose "
+                   "handler neither classifies the OOM nor re-raises")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("mxnet_tpu/")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            if not _guards_dispatch(node.body):
+                continue
+            for handler in node.handlers:
+                if not _catches_oom(handler.type):
+                    continue
+                if _handler_routes(handler):
+                    continue
+                what = "bare `except:`" if handler.type is None else \
+                    "`except %s:`" % (dotted_name(handler.type)
+                                      or "<broad tuple>")
+                yield ctx.finding(
+                    handler, self.name,
+                    "%s guards a jit dispatch/transfer site but the "
+                    "handler neither routes through hbm.classify()/"
+                    "oom_survival() nor re-raises — a device OOM is "
+                    "masked here and the pressure governor never "
+                    "learns" % what)
