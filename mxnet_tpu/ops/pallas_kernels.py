@@ -513,6 +513,200 @@ def paged_attention(q, k_pool, v_pool, page_table, seq_lens, q_pos=None,
                                      seq_lens, q_pos=q_pos, scale=scale)
 
 
+def _paged_spec_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
+                       m_scr, l_scr, acc_scr, *, page_size, max_pages,
+                       groups, width, hp, scale):
+    """One (slot, page) cell of multi-query ragged paged attention — the
+    speculative verify tick: each slot carries ``width`` = K+1 query rows
+    (last committed token + up to K draft tokens) instead of one.
+
+    q_ref: (1, width*Hp, D) with row layout ``row = w*Hp + h`` (each
+    query's heads contiguous, so the per-kv-head slices of the decode
+    kernel still work per w); k_ref/v_ref: (1, page_size, KH, D);
+    o_ref: (1, width*Hp, D). Scratch m/l: (width*Hp, LANES), acc:
+    (width*Hp, D). sl_ref is (S*width,): per-ROW seq_lens — query w of
+    slot s sits at position sl[s*width+w]-1 and sees everything below
+    it, so the ragged mask alone encodes causality between draft rows
+    (no q_pos operand needed; a padded row carries seq_len 0 and emits
+    zeros exactly like an inactive slot in the single-query kernel).
+    """
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32)            # (width*Hp, D)
+    k = k_ref[0].astype(jnp.float32)            # (page_size, KH, D)
+    v = v_ref[0].astype(jnp.float32)
+    whp = q.shape[0]
+    kh = k.shape[1]
+
+    # scores (width*Hp, page_size): within each w block, head h attends
+    # kv head h // groups — width*kh small unrolled 2D matmuls.
+    scores = jnp.zeros((whp, page_size), jnp.float32)
+    for w in range(width):
+        for khi in range(kh):
+            row0 = w * hp + khi * groups
+            qh = lax.dynamic_slice_in_dim(q, row0, groups, 0)
+            sk = jax.lax.dot_general(qh, k[:, khi, :],
+                                     (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            scores = lax.dynamic_update_slice_in_dim(scores, sk, row0, 0)
+    scores = scores * scale
+
+    # per-row ragged mask: row w's length is sl[s*width + w]. The w of a
+    # row is its index // hp — build the (whp, 1) length column by an
+    # unrolled select over the width scalar-prefetch entries.
+    row_w = lax.broadcasted_iota(jnp.int32, (whp, 1), 0) // hp
+    sl_rows = jnp.zeros((whp, 1), jnp.int32)
+    for w in range(width):
+        sl_rows = jnp.where(row_w == w, sl_ref[s * width + w], sl_rows)
+    pos = j * page_size + lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+    valid = pos < sl_rows
+    scores = jnp.where(valid, scores, _NEG_BIG)
+
+    m_prev = m_scr[:, :1]
+    l_prev = l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new)
+    l_new = alpha * l_prev + p.sum(axis=-1, keepdims=True)
+    pv = jnp.zeros_like(acc_scr[...])
+    for w in range(width):
+        for khi in range(kh):
+            row0 = w * hp + khi * groups
+            ph = lax.dynamic_slice_in_dim(p, row0, groups, 0)
+            av = jax.lax.dot_general(ph, v[:, khi, :],
+                                     (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            pv = lax.dynamic_update_slice_in_dim(pv, av, row0, 0)
+    acc_scr[...] = acc_scr[...] * alpha + pv
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == max_pages - 1)
+    def _finish():
+        # same fully-masked-row gate as the single-query kernel: a padded
+        # draft row (seq_len 0) is the row's OWN output — emit zeros.
+        seen = m_scr[:, :1] > _NEG_BIG * 0.5
+        o = jnp.where(seen,
+                      acc_scr[...] / jnp.maximum(l_scr[:, :1], 1e-30), 0.0)
+        o_ref[0] = o.astype(o_ref.dtype)
+
+
+def ragged_spec_attention(q, k_pool, v_pool, page_table, seq_lens,
+                          scale=None, interpret=None):
+    """Multi-query ragged paged attention — the speculative verify step.
+
+    q: (S, W, H, D) — W = K+1 query rows per slot (committed token +
+    drafts, in position order); page_table: (S, max_pages) — ONE row per
+    slot, shared by its W queries (speculation widens queries, not KV
+    residency); seq_lens: (S*W,) int32, PER ROW: row w of slot s has
+    seq_len = its absolute position + 1, so each draft row attends the
+    committed prefix plus the earlier draft rows already written below
+    it, and a padded/inactive row carries 0 and returns zeros.
+
+    Shapes are static in (S, W, max_pages, page_size): speculation depth
+    and per-slot acceptance vary the seq_lens DATA only — membership
+    churn, rejection, ragged drafts never recompile. Returns (S, W, H, D).
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    s_slots, width, n_heads, d = q.shape
+    n_pages_pool, page_size, n_kv, _ = k_pool.shape
+    if n_heads % n_kv:
+        raise ValueError("ragged_spec_attention: %d heads not divisible "
+                         "by %d kv heads" % (n_heads, n_kv))
+    if seq_lens.shape[0] != s_slots * width:
+        raise ValueError("ragged_spec_attention: seq_lens %s != S*W = %d"
+                         % (seq_lens.shape, s_slots * width))
+    groups = n_heads // n_kv
+    max_pages = page_table.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = _interpret()
+
+    hp = _pad_up(n_heads, _PACK_ROWS)
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, hp - n_heads), (0, 0)))
+    qp = qp.reshape(s_slots, width * hp, d)
+    kernel = functools.partial(
+        _paged_spec_kernel, page_size=page_size, max_pages=max_pages,
+        groups=groups, width=width, hp=hp, scale=float(scale))
+    pt_flat = page_table.astype(jnp.int32).ravel()
+    sl = seq_lens.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_slots, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, width * hp, d), lambda s, j, pt, sl: (s, 0, 0)),
+            pl.BlockSpec((1, page_size, n_kv, d),
+                         lambda s, j, pt, sl:
+                         (pt[s * max_pages + j], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, n_kv, d),
+                         lambda s, j, pt, sl:
+                         (pt[s * max_pages + j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, width * hp, d),
+                               lambda s, j, pt, sl: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((width * hp, LANES), jnp.float32),
+            pltpu.VMEM((width * hp, LANES), jnp.float32),
+            pltpu.VMEM((width * hp, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((s_slots, width * hp, d), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(pt_flat, sl, qp, k_pool, v_pool)
+    return out.reshape(s_slots, width, hp, d)[:, :, :n_heads]
+
+
+def paged_spec_attention_reference(q, k_pool, v_pool, page_table, seq_lens,
+                                   scale=None):
+    """Dense oracle for the multi-query verify step: each of a slot's W
+    query rows is treated as its own single-query slot sharing the slot's
+    page-table row — the chunked-prefill broadcast-row trick, with the
+    per-row seq_lens carrying causality. q: (S*W, H, D), page_table:
+    (S, max_pages), seq_lens: (S*W,). Returns (S*W, H, D)."""
+    s_rows = q.shape[0]
+    width = s_rows // page_table.shape[0]
+    pt = jnp.repeat(page_table.astype(jnp.int32), width, axis=0)
+    return paged_attention_reference(q, k_pool, v_pool, pt, seq_lens,
+                                     scale=scale)
+
+
+def paged_spec_attention(q, k_pool, v_pool, page_table, seq_lens,
+                         scale=None):
+    """Dispatcher for the widened (speculative) decode step: q is the
+    flattened (S*W, H, D) query block — W derived from the page-table row
+    count at trace time, so the engine's model code needs no signature
+    change. Pallas kernel on TPU (same tiling bar as `paged_attention`),
+    dense reference elsewhere."""
+    s_slots = page_table.shape[0]
+    width = q.shape[0] // s_slots
+    page_size = k_pool.shape[1]
+    d = k_pool.shape[3]
+    if jax.default_backend() == "tpu" and page_size % 8 == 0 \
+            and d % LANES == 0:
+        out = ragged_spec_attention(
+            q.reshape(s_slots, width, q.shape[1], q.shape[2]),
+            k_pool, v_pool, page_table, seq_lens, scale=scale,
+            interpret=False)
+        return out.reshape(q.shape)
+    return paged_spec_attention_reference(q, k_pool, v_pool, page_table,
+                                          seq_lens, scale=scale)
+
+
 def _register_flash_attention_op():
     """Expose the kernel through the op registry:
     ``_contrib_flash_attention(query, key, value)`` on (B, H, S, D)."""

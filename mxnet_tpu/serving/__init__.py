@@ -32,6 +32,12 @@ Pieces
   tenant-aware spillover, replica lifecycle (rolling swap, drain),
   failure containment with exactly-once re-routing, and SLO-driven
   autoscaling;
+* :mod:`~mxnet_tpu.serving.speculative` — draft proposers for
+  speculative decoding: the model-free prompt-lookup (n-gram) draft and
+  a pluggable registry (``MXNET_DECODE_SPEC_DRAFT``); the engine
+  verifies k+1 positions per slot in ONE widened ragged tick, greedy
+  rejection keeps output bit-exact, and the static K+1 width keeps the
+  steady state recompile-free;
 * :mod:`~mxnet_tpu.serving.tenancy`  — the multi-tenant control plane
   both servers thread through: tenant registry (``MXNET_TENANTS``),
   weighted-fair queueing with priority classes, per-tenant circuit
@@ -63,6 +69,8 @@ from .decode import DecodeEngine, PagedDecodeModel, TinyDecoder
 from .engine import BlockEngine, Engine, StableHLOEngine
 from .fleet import FleetRouter
 from .kvcache import OutOfPagesError, PagedKVCache, PrefixMatch
+from .speculative import (DraftProposer, ModelDraft, PromptLookupDraft,
+                          available_drafts, make_draft, register_draft)
 from .stats import ServingStats, TenantStats
 from .tenancy import (PRIORITY_CLASSES, Tenant, TenantBreaker,
                       TenantRegistry, TenantUnavailableError,
@@ -77,6 +85,8 @@ __all__ = [
     "serve_block", "serve_stablehlo",
     "DecodeEngine", "PagedDecodeModel", "TinyDecoder", "FleetRouter",
     "PagedKVCache", "OutOfPagesError", "PrefixMatch",
+    "DraftProposer", "PromptLookupDraft", "ModelDraft",
+    "register_draft", "make_draft", "available_drafts",
     "Tenant", "TenantRegistry", "TenantBreaker",
     "TenantUnavailableError", "WeightedFairQueue", "PRIORITY_CLASSES",
 ]

@@ -173,12 +173,20 @@ class PagedDecodeModel:
 
     def decode(self, params, tokens, positions, k_pool, v_pool,
                page_tables, seq_lens, write_pages, write_offsets):
-        """One token per slot: ``tokens``/``positions``/``write_*`` are
-        ``(S,)``, ``page_tables`` ``(S, max_pages)``, ``seq_lens`` ``(S,)``
-        INCLUDING the token being written (it attends to itself).
-        Inactive slots carry ``seq_len 0`` and the null write page; their
-        logits are garbage the engine ignores. Returns
-        ``(logits (S, vocab), k_pool, v_pool)``."""
+        """One query ROW per table row: ``tokens``/``positions``/
+        ``write_*``/``seq_lens`` are ``(S*W,)`` where ``page_tables`` is
+        ``(S, max_pages)`` — W is a static per-slot query width the model
+        derives at trace time (``tokens.shape[0] // page_tables.shape[0]``).
+        The classic decode tick is W=1: one token per slot. The
+        speculative verify tick is W=K+1: slot s's rows sit at
+        ``s*W .. s*W+W-1`` in position order (committed token, then
+        draft tokens), sharing the slot's page-table row. ``seq_lens``
+        is per ROW and INCLUDES the row's own token (it attends to
+        itself and every position below — which covers the earlier draft
+        rows, written before attention reads). Inactive/padded rows
+        carry ``seq_len 0`` and the null write page; their logits are
+        garbage the engine ignores. Returns
+        ``(logits (S*W, vocab), k_pool, v_pool)``."""
         raise NotImplementedError
 
     def prefill(self, params, tokens, length, k_pool, v_pool,
@@ -281,7 +289,9 @@ class DecodeEngine:
                  breaker_reset_s: Optional[float] = None,
                  dtype="float32", tenants=None,
                  prefix_cache: Optional[bool] = None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 spec_k: Optional[int] = None,
+                 spec_draft=None):
         import jax
         import jax.numpy as jnp
 
@@ -311,6 +321,8 @@ class DecodeEngine:
             prefill_chunk = get_env("MXNET_DECODE_PREFILL_CHUNK",
                                     _DEFAULT_PREFILL_CHUNK, int,
                                     cache=False)
+        if spec_k is None:
+            spec_k = get_env("MXNET_DECODE_SPEC_K", 0, int, cache=False)
         self.num_slots = max(1, int(num_slots))
         self.max_seq_len = int(max_seq_len)
         self._queue_depth = max(1, int(queue_depth))
@@ -318,6 +330,24 @@ class DecodeEngine:
         self._ring_len = max(0, int(ring_prefill_len))
         self._prefix_cache = bool(prefix_cache)
         self._chunk = max(0, min(int(prefill_chunk), self.max_seq_len))
+        # speculative decoding: the step carries a STATIC width of
+        # spec_k+1 query rows per slot (committed token + up to k draft
+        # rows). k=0 keeps the classic 1-row tick bit-for-bit (the
+        # packed operand is then (5, S) exactly as before). The width is
+        # a compile-time constant — per-tick draft depth, acceptance and
+        # per-tenant caps vary only the DATA inside it.
+        self._spec_k = max(0, int(spec_k))
+        self._spec_w = self._spec_k + 1
+        if self._spec_k == 0:
+            self._draft = None
+        elif spec_draft is not None and not isinstance(spec_draft, str):
+            self._draft = spec_draft   # a DraftProposer instance
+        else:
+            from .speculative import make_draft
+            if spec_draft is None:
+                spec_draft = get_env("MXNET_DECODE_SPEC_DRAFT",
+                                     "prompt_lookup", str, cache=False)
+            self._draft = make_draft(spec_draft, model, params)
         self._ladder = self._prefill_ladder(prefill_buckets)
         # the chunk jit's statically-shaped rungs: chunked prefill uses
         # ONE rung (the chunk size); with chunking off the prefix-cache
@@ -387,9 +417,10 @@ class DecodeEngine:
 
         donate = self._donate_argnums()
 
-        # the tick's five (S,) int32 operands (tokens, positions,
-        # seq_lens, write pages, write offsets) travel as ONE packed
-        # (5, S) array — one host->device put per tick instead of five;
+        # the tick's five (S*W,) int32 operands (tokens, positions,
+        # seq_lens, write pages, write offsets; W = spec_k+1 query rows
+        # per slot, 1 when speculation is off) travel as ONE packed
+        # (5, S*W) array — one host->device put per tick instead of five;
         # the page table rides a version-keyed device cache (below), so a
         # steady tick pays exactly one put + one fetch
         def _step_fn(params, packed, k_pool, v_pool, page_tables):
@@ -454,6 +485,13 @@ class DecodeEngine:
         self._evictions = 0
         self._occ_sum = 0.0
         self._ticks = 0
+        # speculation accounting (worker-confined): draft tokens
+        # proposed/accepted, and the accepted-per-tick numerator/
+        # denominator over SPECULATING slot-ticks only
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_new = 0         # tokens committed by speculating slots
+        self._spec_slot_ticks = 0  # slot-ticks where a draft was in play
         self._cow_copies = 0   # written/read under _cv only
         self._admit_seq = 0    # admission order among prefilling slots
         self._rr_last = 0      # round-robin cursor over that order
@@ -690,8 +728,10 @@ class DecodeEngine:
         with self._cv:
             # snapshot: a live swap_params() may rebind between rungs
             params = self._params
-        packed = np.zeros((5, s), np.int32)
-        packed[3], packed[4] = self._cache.null_write_slots(s)
+        # the step's packed operand carries W = spec_k+1 rows per slot;
+        # warming at that width anchors the widened tick too
+        packed = np.zeros((5, s * self._spec_w), np.int32)
+        packed[3], packed[4] = self._cache.null_write_slots(s * self._spec_w)
         sampled, kp, vp = self._step(
             params, jnp.asarray(packed), self._cache.k_pool,
             self._cache.v_pool, self._device_page_table())
@@ -770,6 +810,22 @@ class DecodeEngine:
                 "breaker": self._breaker.state,
                 "weight_swaps": self._swaps,
                 "active_variant": self._active_variant,
+                "speculative": {
+                    "k": self._spec_k,
+                    "draft": (getattr(self._draft, "name", None)
+                              if self._draft is not None else None),
+                    "proposed_tokens": self._spec_proposed,
+                    "accepted_tokens": self._spec_accepted,
+                    "acceptance_rate": (self._spec_accepted /
+                                        self._spec_proposed
+                                        if self._spec_proposed else 0.0),
+                    # tokens committed per SPECULATING slot-tick — the
+                    # >1.0 gate of the BENCH_DECODE soak (1.0 = drafts
+                    # never helped; k+1 = every draft accepted)
+                    "accepted_per_tick": (self._spec_new /
+                                          self._spec_slot_ticks
+                                          if self._spec_slot_ticks else 0.0),
+                },
             })
             governed = self._governed_limit
         out["tenants"] = self._tenants.snapshot()
@@ -1500,22 +1556,42 @@ class DecodeEngine:
 
         jnp = self._jnp
         s = self.num_slots
+        w = self._spec_w
         ps = self._cache.page_size
         # rows: tokens, positions, seq_lens, write pages, write offsets —
-        # ONE packed put per tick. Inactive slots keep seq_len 0 and the
-        # null write page (row 3 stays 0); their offsets cycle the page
-        # so scatter indices stay in range.
-        packed = np.zeros((5, s), np.int32)
-        packed[4] = np.arange(s) % ps
+        # ONE packed put per tick, W = spec_k+1 query rows per slot (slot
+        # s owns rows s*W .. s*W+W-1: row 0 the committed token, rows
+        # 1..k its draft guesses at the next positions). W is static —
+        # draft depth, acceptance and per-tenant caps vary only the data,
+        # so speculation can never retrace the step. Inactive slots and
+        # unused draft rows keep seq_len 0 and the null write page (row 3
+        # stays 0); their offsets cycle the page so scatter indices stay
+        # in range.
+        packed = np.zeros((5, s * w), np.int32)
+        packed[4] = np.arange(s * w) % ps
+        drafts: dict = {}
+        pages_before = self._cache.pages_in_use if self._cache.audit else 0
         for slot, req in active:
             pos = int(req.prompt.size) + len(req.tokens) - 1
-            packed[0, slot] = req.tokens[-1]
-            packed[1, slot] = pos
-            packed[2, slot] = pos + 1  # the new token attends to itself
-            # single-token write destination; admission's worst-case
-            # reserve() guarantees pos is covered, so index directly
-            packed[3, slot] = self._cache.page_table[slot, pos // ps]
-            packed[4, slot] = pos % ps
+            base = slot * w
+            draft = (self._propose(req, slot, pos)
+                     if self._draft is not None else ())
+            drafts[slot] = draft
+            row_toks = [req.tokens[-1]]
+            row_toks.extend(int(t) for t in draft)
+            for j, row_tok in enumerate(row_toks):
+                # row j carries the token at absolute position pos+j and
+                # attends up to itself (per-row seq_len) — rows below it
+                # in the same tick write their KV before attention reads,
+                # so draft rows see each other causally. Admission's
+                # worst-case reserve() plus the _propose clamp guarantee
+                # pos+j is covered, so index the page table directly.
+                packed[0, base + j] = row_tok
+                packed[1, base + j] = pos + j
+                packed[2, base + j] = pos + j + 1
+                packed[3, base + j] = \
+                    self._cache.page_table[slot, (pos + j) // ps]
+                packed[4, base + j] = (pos + j) % ps
         # black box: the in-flight set BEFORE the step executes, so a
         # mid-tick death's dump names the failing tick's sequences and
         # their tenants (the post-mortem acceptance contract). One event
@@ -1565,21 +1641,65 @@ class DecodeEngine:
         tpots = []
         tenant_tpots: dict = {}
         tenant_slots: dict = {}
+        tenant_spec: dict = {}
+        total_new = 0
+        tick_proposed = 0
+        tick_accepted = 0
         for slot, req in active:
-            self._cache.seq_lens[slot] += 1
-            tok = int(toks[slot])
-            req.tokens.append(tok)
+            base = slot * w
+            draft = drafts.get(slot, ())
+            k_eff = len(draft)
+            # greedy rejection: accept the longest draft prefix that
+            # equals the model's own argmax chain — committed token j is
+            # the model's prediction from row j, and draft[j] rode row
+            # j+1, so draft[j] was a correct guess iff it equals
+            # toks[base+j]. The committed tokens are ALWAYS the model's
+            # outputs (never the draft's), so output == sequential
+            # greedy decode bit-for-bit whatever the draft proposed.
+            a = 0
+            while a < k_eff and int(draft[a]) == int(toks[base + a]):
+                a += 1
+            n_new = 0
+            for j in range(a + 1):
+                tok = int(toks[base + j])
+                req.tokens.append(tok)
+                n_new += 1
+                if self._finished(req, tok):
+                    break
+            # commit = advance seq_lens past the rows that verified;
+            # rejected rows' KV (positions >= the new seq_len) is the
+            # ROLLBACK: never committed, masked by the ragged attention
+            # bound, and overwritten by the next tick's rows — no page
+            # alloc/free happened mid-tick, so there is nothing else to
+            # unwind and no bystander is touched.
+            self._cache.seq_lens[slot] += n_new
+            accepted = min(a, n_new)
+            total_new += n_new
             ms = (now - req.last_t) * 1e3
             # every decode tick the sequence participates in is a hop of
-            # its (sampled) trace — the None path is one pointer check
+            # its (sampled) trace — the None path is one pointer check.
+            # A multi-token tick amortizes the wall interval over its
+            # commits so TPOT keeps meaning time-per-OUTPUT-token.
+            per_tok = ms / n_new
             _tracing.event(req.trace, "tick",
                            token_index=len(req.tokens),
-                           tpot_ms=round(ms, 3))
-            tpots.append(ms)
-            tenant_tpots.setdefault(req.tenant, []).append(ms)
+                           tpot_ms=round(per_tok, 3),
+                           **({"drafted": k_eff, "accepted": accepted}
+                              if self._spec_k else {}))
+            tpots.extend([per_tok] * n_new)
+            tenant_tpots.setdefault(req.tenant, []).extend(
+                [per_tok] * n_new)
             tenant_slots[req.tenant] = tenant_slots.get(req.tenant, 0) + 1
+            if k_eff:
+                self._spec_slot_ticks += 1
+                self._spec_new += n_new
+                tick_proposed += k_eff
+                tick_accepted += accepted
+                row = tenant_spec.setdefault(req.tenant, [0, 0])
+                row[0] += k_eff
+                row[1] += accepted
             req.last_t = now
-            if self._finished(req, tok):
+            if self._finished(req, int(req.tokens[-1])):
                 self._slots[slot] = None
                 tenant_slots[req.tenant] -= 1
                 self._complete(req, slot, now)
@@ -1590,8 +1710,14 @@ class DecodeEngine:
         for tenant, ms_batch in tenant_tpots.items():
             tenant.stats.on_output_tokens(ms_batch)
             tenant.stats.set_slots(tenant_slots.get(tenant, 0))
-        self._tokens_total += len(active)
-        _T_TOKENS.inc(len(active), server=self._name)
+        if tick_proposed or tick_accepted:
+            self._spec_proposed += tick_proposed
+            self._spec_accepted += tick_accepted
+            self._stats.on_spec(tick_proposed, tick_accepted)
+            for tenant, (p_cnt, a_cnt) in tenant_spec.items():
+                tenant.stats.on_spec(p_cnt, a_cnt)
+        self._tokens_total += total_new
+        _T_TOKENS.inc(total_new, server=self._name)
         self._ticks += 1
         occ = len(active) / float(s)
         self._occ_sum += occ
@@ -1602,6 +1728,71 @@ class DecodeEngine:
         # change, and the audit contract is "per tick"
         if self._cache.audit:
             self._cache.audit_check()
+            # the speculation-specific tick invariants: a verify tick
+            # allocates NOTHING (completions above can only free), and
+            # no speculating tenant stands over the page budget it was
+            # admitted under — the gauge-proven form of "k+1 writes fit
+            # the admission-time reservation".
+            if self._cache.pages_in_use > pages_before:
+                raise MXNetError(
+                    "kvcache %r audit: decode tick grew pages_in_use "
+                    "%d -> %d — a speculative write escaped its "
+                    "admission-time reservation" %
+                    (self._name, pages_before, self._cache.pages_in_use))
+            if self._spec_k:
+                for tenant in {req.tenant for _slot, req in active}:
+                    if tenant.page_budget is not None and \
+                            tenant.pages_in_use > tenant.page_budget:
+                        raise MXNetError(
+                            "tenant %r audit: pages_in_use %d exceeds "
+                            "page_budget %d after a speculative tick"
+                            % (tenant.tenant_id, tenant.pages_in_use,
+                               tenant.page_budget))
+
+    def _propose(self, req: _DecodeRequest, slot: int, pos: int):
+        """Draft tokens for one slot's verify tick, clamped so the tick
+        can NEVER outgrow what admission reserved:
+
+        * the engine k (the static width bound — more would change the
+          compiled shape);
+        * the tenant's ``spec_k`` cap, if set (can only lower);
+        * the request's remaining output budget (a tick commits at most
+          k+1 tokens; committing past ``max_new`` would over-generate);
+        * the slot's page reservation (every row writes KV at pos+j,
+          and ``write_slots`` hard-faults past the reserved run — the
+          PR-13 tenant page budget was charged for exactly that run at
+          admission, so staying inside it keeps the budget invariant
+          mid-tick with zero page traffic).
+        """
+        from .speculative import sanitize
+
+        k = self._spec_k
+        cap = req.tenant.spec_k
+        if cap is not None:
+            k = min(k, cap)
+        k = min(k, req.max_new - len(req.tokens) - 1)
+        k = min(k, self._cache.reserved_tokens(slot) - (pos + 1))
+        if k <= 0:
+            return ()
+        history = np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+        try:
+            proposed = self._draft.propose(history, k)
+        except Exception:  # noqa: BLE001 - a draft bug must not kill ticks
+            # drafts are hints: a failing proposer degrades this slot to
+            # the classic single-token tick instead of faulting the tick
+            # (which would evict every in-flight sequence)
+            return ()
+        return sanitize(proposed, k, self._model.vocab_size)
+
+    def set_tenant_spec_k(self, tenant_id: str, spec_k: Optional[int]):
+        """Set (or clear, with ``None``) one tenant's speculative draft
+        cap at runtime. Caps only LOWER the engine's ``spec_k`` — the
+        verify width K+1 is a compile-time shape — so a slow-accepting
+        tenant can be throttled to 0 without touching anyone's compiled
+        step. The fleet router forwards this to every replica."""
+        tenant = self._tenants.resolve(tenant_id)
+        tenant.spec_k = None if spec_k is None else max(0, int(spec_k))
 
     @staticmethod
     def _finished(req: _DecodeRequest, tok: int) -> bool:
@@ -1824,6 +2015,10 @@ class TinyDecoder(PagedDecodeModel):
         from ..ops import pallas_kernels
 
         s = tokens.shape[0]
+        # the per-slot query width (1 = classic tick, K+1 = speculative
+        # verify tick) falls out of trace-time shapes — the contract's
+        # operands widen, the signature doesn't
+        w = s // page_tables.shape[0]
         h, kh, d = self.num_heads, self.num_kv_heads, self.head_dim
         x = params["embed"][tokens] + self._pe(positions)
         for li, layer in enumerate(params["layers"]):
@@ -1833,9 +2028,14 @@ class TinyDecoder(PagedDecodeModel):
             v = (hx @ layer["wv"]).reshape(s, kh, d)
             k_pool, v_pool = write_kv(k_pool, v_pool, li, k, v,
                                       write_pages, write_offsets)
-            att = pallas_kernels.paged_attention(
-                q, k_pool[li], v_pool[li], page_tables, seq_lens,
-                scale=self.scale)
+            if w > 1:
+                att = pallas_kernels.paged_spec_attention(
+                    q, k_pool[li], v_pool[li], page_tables, seq_lens,
+                    scale=self.scale)
+            else:
+                att = pallas_kernels.paged_attention(
+                    q, k_pool[li], v_pool[li], page_tables, seq_lens,
+                    scale=self.scale)
             x = x + att.reshape(s, h * d) @ layer["wo"]
             x = x + self._mlp(self._norm(x, layer["ln2"]), layer)
         logits = self._norm(x, params["lnf"]) @ params["unembed"]

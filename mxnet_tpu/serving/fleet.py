@@ -286,6 +286,9 @@ class FleetRouter:
         # 0 = "whatever the fleet started with": scale-UP is opt-in
         self._max_replicas = int(max_replicas) if max_replicas else replicas
         self._variants: Dict[str, object] = {}
+        # per-tenant speculative draft caps, re-applied to every replica
+        # a restart or scale-up builds (mirrors the variant store)
+        self._spec_overrides: Dict[str, Optional[int]] = {}
         self._last_scale: Optional[dict] = None
         self._last_scale_t = -float("inf")
         self._restarts: List[threading.Thread] = []
@@ -324,10 +327,13 @@ class FleetRouter:
             index = self._next_index
             self._next_index += 1
             variants = list(self._variants.items())
+            spec_caps = list(self._spec_overrides.items())
         rname = "%s.r%d" % (self._name, index)
         engine = self._factory(rname)
         for vname, vparams in variants:
             engine.register_variant(vname, vparams)
+        for tid, cap in spec_caps:
+            engine.set_tenant_spec_k(tid, cap)
         breaker = CircuitBreaker(
             "serving.%s.replica.%d" % (self._name, index),
             failure_threshold=self._breaker_threshold,
@@ -712,10 +718,13 @@ class FleetRouter:
                 return
             rep.state = "restarting"
             variants = list(self._variants.items())
+            spec_caps = list(self._spec_overrides.items())
         try:
             engine = self._factory(rep.name)
             for vname, vparams in variants:
                 engine.register_variant(vname, vparams)
+            for tid, cap in spec_caps:
+                engine.set_tenant_spec_k(tid, cap)
             engine.warmup()
         except Exception as exc:  # noqa: BLE001 - a replica that cannot
             # be rebuilt stays failed; the rest of the fleet carries on
@@ -747,6 +756,20 @@ class FleetRouter:
             reps = [r for r in self._replicas if r.state == "live"]
         for rep in reps:
             rep.engine.register_variant(name, params)
+
+    def configure_speculation(self, tenant_id: str,
+                              spec_k: Optional[int]) -> None:
+        """Set (or clear, with ``None``) one tenant's speculative draft
+        cap fleet-wide: applied to every live replica now and re-applied
+        to every replica a restart or scale-up builds — the lever that
+        stops one slow-accepting tenant burning every replica's tick
+        budget on rejected verify rows. Caps only lower the engines'
+        compiled ``spec_k``; no replica recompiles."""
+        with self._lock:
+            self._spec_overrides[str(tenant_id)] = spec_k
+            reps = [r for r in self._replicas if r.state == "live"]
+        for rep in reps:
+            rep.engine.set_tenant_spec_k(tenant_id, spec_k)
 
     def rolling_swap(self, params=None, variant: Optional[str] = None,
                      timeout: Optional[float] = None) -> int:

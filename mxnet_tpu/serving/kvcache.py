@@ -294,6 +294,16 @@ class PagedKVCache:
         charge nobody twice."""
         return self._exclusive[int(slot)]
 
+    def reserved_tokens(self, slot: int) -> int:
+        """Token capacity of ``slot``'s reserved page run — the hard
+        ceiling :meth:`write_slots` enforces. The speculative decode
+        step clamps its per-tick draft depth so that all k+1 verify
+        rows land below this bound: admission reserved the worst case
+        (prompt + max_new) up front, so a speculating sequence can
+        never grow pages mid-tick and never exceeds the tenant page
+        budget it was charged at admission."""
+        return self._owned[int(slot)] * self.page_size
+
     def can_admit(self, n_tokens: int) -> bool:
         """Whether a full reservation for ``n_tokens`` fits right now."""
         return self.pages_for(n_tokens) <= self.pages_available
@@ -339,7 +349,13 @@ class PagedKVCache:
 
         The decode engine reserves a sequence's WORST CASE (prompt +
         max_new_tokens) at admission, so a sequence admitted can always
-        finish — no mid-flight eviction for lack of pages. Shared pages
+        finish — no mid-flight eviction for lack of pages. That same
+        admission-time worst case also bounds speculative decoding: a
+        tick that writes up to k+1 tokens still lands every row at a
+        position < prompt + max_new, i.e. inside this reservation (the
+        engine clamps the draft depth by :meth:`reserved_tokens`), so
+        pages-per-tick growth is ZERO after admission and a tenant's
+        page budget can't be exceeded mid-tick. Shared pages
         already mapped by :meth:`admit_prefix` count toward the cover,
         so only the non-shared tail is allocated. Raises
         :class:`OutOfPagesError` (leaving the slot unchanged) when the

@@ -83,6 +83,22 @@ _T_DRAIN = telemetry.counter(
     "requests finished during a graceful close(drain=True) — the number "
     "a zero-drop drain/rolling-upgrade asserts against",
     labels=("server",))
+_T_SPEC_PROPOSED = telemetry.counter(
+    "mxnet_spec_proposed_tokens_total",
+    "draft tokens proposed by the speculative decode plane (the verify "
+    "rows beyond each slot's committed token)",
+    labels=("server",))
+_T_SPEC_ACCEPTED = telemetry.counter(
+    "mxnet_spec_accepted_tokens_total",
+    "draft tokens accepted by greedy verification (committed to the "
+    "sequence; proposed - accepted = wasted verify rows)",
+    labels=("server",))
+_T_SPEC_RATE = telemetry.gauge(
+    "mxnet_spec_acceptance_rate",
+    "cumulative accepted/proposed draft-token ratio; tenant='_engine' "
+    "is the engine-wide row, other rows are per tenant — the signal a "
+    "per-tenant spec_k knob is tuned against",
+    labels=("server", "tenant"))
 
 
 def _percentile_rows(out: Dict, pairs) -> None:
@@ -121,6 +137,8 @@ class ServingStats:
         self.errors = 0
         self.batches = 0
         self.prefill_chunks = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self.padded_rows = 0
         self.served_rows = 0
         self.isolation_retries = 0
@@ -212,6 +230,24 @@ class ServingStats:
             self.prefill_chunks += 1
         _T_CHUNKS.inc(server=self.name)
 
+    def on_spec(self, proposed: int, accepted: int):
+        """One decode tick's speculative outcome, batched across slots:
+        ``proposed`` draft tokens went into the verify rows, ``accepted``
+        of them were committed. One lock acquisition per tick (this sits
+        on the decode hot path next to :meth:`on_output_tokens`)."""
+        if proposed <= 0 and accepted <= 0:
+            return
+        with self._lock:
+            self.spec_proposed += proposed
+            self.spec_accepted += accepted
+            rate = (self.spec_accepted / self.spec_proposed
+                    if self.spec_proposed else 0.0)
+        if proposed > 0:
+            _T_SPEC_PROPOSED.inc(proposed, server=self.name)
+        if accepted > 0:
+            _T_SPEC_ACCEPTED.inc(accepted, server=self.name)
+        _T_SPEC_RATE.set(rate, server=self.name, tenant="_engine")
+
     def on_error(self):
         with self._lock:
             self.errors += 1
@@ -268,6 +304,11 @@ class ServingStats:
                 "errors": self.errors,
                 "batches": self.batches,
                 "prefill_chunks": self.prefill_chunks,
+                "spec_proposed_tokens": self.spec_proposed,
+                "spec_accepted_tokens": self.spec_accepted,
+                "spec_acceptance_rate": (self.spec_accepted /
+                                         self.spec_proposed
+                                         if self.spec_proposed else 0.0),
                 "isolation_retries": self.isolation_retries,
                 "fallbacks": self.fallbacks,
                 "unavailable": self.unavailable,
@@ -354,6 +395,8 @@ class TenantStats:
         self.deferred_pages = 0
         self.deferred_rate = 0
         self.deferred_pressure = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self._queue_depth = 0
         self._slots = 0
         self._pages = 0
@@ -428,6 +471,20 @@ class TenantStats:
         _T_TEN_REQS.inc(event="completed", **self._labels())
         _T_TEN_LATENCY.observe(latency_ms, **self._labels())
 
+    def on_spec(self, proposed: int, accepted: int):
+        """This tenant's share of one tick's speculative outcome; keeps
+        the per-tenant ``mxnet_spec_acceptance_rate`` row fresh so one
+        slow-accepting tenant is visible (and tunable via its ``spec_k``)
+        without dividing fleet-level counters."""
+        if proposed <= 0 and accepted <= 0:
+            return
+        with self._lock:
+            self.spec_proposed += proposed
+            self.spec_accepted += accepted
+            rate = (self.spec_accepted / self.spec_proposed
+                    if self.spec_proposed else 0.0)
+        _T_SPEC_RATE.set(rate, **self._labels())
+
     def set_slots(self, n: int):
         with self._lock:
             self._slots = n
@@ -460,6 +517,11 @@ class TenantStats:
                 "deferred_pages": self.deferred_pages,
                 "deferred_rate": self.deferred_rate,
                 "deferred_pressure": self.deferred_pressure,
+                "spec_proposed_tokens": self.spec_proposed,
+                "spec_accepted_tokens": self.spec_accepted,
+                "spec_acceptance_rate": (self.spec_accepted /
+                                         self.spec_proposed
+                                         if self.spec_proposed else 0.0),
             }
         _percentile_rows(out, (("latency", lat), ("ttft", ttft),
                                ("tpot", tpot)))

@@ -282,12 +282,21 @@ class Tenant:
     def __init__(self, registry: "TenantRegistry", tenant_id: str,
                  weight: float, priority: int, queue_depth: int,
                  page_budget: Optional[int], rate: float, burst: float,
-                 breaker: TenantBreaker, stats: TenantStats):
+                 breaker: TenantBreaker, stats: TenantStats,
+                 spec_k: Optional[int] = None):
         self.tenant_id = tenant_id
         self.weight = max(0.01, float(weight))
         self.priority = int(priority)
         self.queue_depth = max(1, int(queue_depth))
         self.page_budget = page_budget if page_budget else None
+        # speculative draft-depth CAP for this tenant's slots: None =
+        # inherit the engine's MXNET_DECODE_SPEC_K. Can only LOWER the
+        # engine k (the verify width K+1 is a compile-time shape; a
+        # tenant asking for more would recompile the step) — the lever
+        # that stops one slow-accepting tenant burning a replica's tick
+        # budget on rejected verify rows. Mutable at runtime (the fleet's
+        # configure_speculation writes it); plain int read each tick.
+        self.spec_k = None if spec_k is None else max(0, int(spec_k))
         # the SLO engine divides the tenant burn/violation alerts by
         # these (instance key mirrors the registry's sorted-label key:
         # server/tenant)
@@ -380,6 +389,7 @@ class Tenant:
             "page_budget": self.page_budget,
             "pages_in_use": self.pages_in_use,
             "rate_tokens_s": self.rate,
+            "spec_k": self.spec_k,
             "breaker": self.breaker.state,
         })
         return out
@@ -425,6 +435,8 @@ def parse_tenants(spec: str) -> List[Dict]:
                     cfg["rate"] = float(val)
                 elif key == "burst":
                     cfg["burst"] = float(val)
+                elif key == "spec_k":
+                    cfg["spec_k"] = int(val)
                 else:
                     raise MXNetError("tenant spec: unknown key %r in %r"
                                      % (key, tok))
@@ -491,7 +503,8 @@ class TenantRegistry:
                  burst: Optional[float] = None,
                  breaker_threshold: Optional[int] = None,
                  breaker_window_s: Optional[float] = None,
-                 breaker_reset_s: Optional[float] = None) -> Tenant:
+                 breaker_reset_s: Optional[float] = None,
+                 spec_k: Optional[int] = None) -> Tenant:
         """Create (or return the existing) tenant. Like the telemetry
         get-or-create contract, kwargs only apply on first creation."""
         tenant_id = str(tenant_id)
@@ -528,7 +541,8 @@ class TenantRegistry:
                 rate=self._def_rate if rate is None else rate,
                 burst=self._def_burst if burst is None else burst,
                 breaker=TenantBreaker(self.server, tenant_id, **bkw),
-                stats=TenantStats(self.server, tenant_id))
+                stats=TenantStats(self.server, tenant_id),
+                spec_k=spec_k)
             self._tenants[tenant_id] = t
             self._order.append(tenant_id)
             return t
@@ -567,7 +581,7 @@ _ADDITIVE_SNAPSHOT_FIELDS = (
     "submitted", "completed", "shed", "shed_breaker", "timeouts", "errors",
     "deferred_pages", "deferred_rate", "queued", "queue_depth",
     "slots_active", "pages_in_use", "pages_in_use_now", "pages_in_use_max",
-    "pages_cached")
+    "pages_cached", "spec_proposed_tokens", "spec_accepted_tokens")
 
 
 def aggregate_snapshots(snapshots: List[Dict[str, Dict]]) -> Dict[str, Dict]:

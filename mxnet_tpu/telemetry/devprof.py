@@ -100,8 +100,10 @@ HOST_GAP_RATIO = _registry.gauge(
 
 TOKENS_PER_DEVICE_S = _registry.gauge(
     "mxnet_tokens_per_device_second",
-    "decode tokens generated per sampled device-second (timed ticks "
-    "only) — the device-normalized throughput the autotuner optimizes",
+    "ACCEPTED decode tokens committed per sampled device-second (timed "
+    "ticks only) — the device-normalized throughput the autotuner "
+    "optimizes; rejected speculative draft rows cost device time but "
+    "commit nothing, so they lower this gauge instead of inflating it",
     labels=("server",))
 
 MFU = _registry.gauge(
@@ -254,8 +256,12 @@ def _decode_phase(site: str) -> str:
 def note_decode_tick(server: str, wall_ms: float, tokens: int = 0) -> None:
     """Close a timed decode tick: split its sampled device time into
     prefill vs step, derive host_gap = wall - device, and refresh the
-    plane's ratio/throughput gauges. Also takes the periodic HBM
-    watermark (every MXNET_DEVPROF_HBM_TICKS timed ticks)."""
+    plane's ratio/throughput gauges. ``tokens`` is the tick's COMMITTED
+    output-token count (the engine passes its tokens_total delta, which
+    under speculative decoding counts accepted tokens only — never the
+    proposed draft rows), so tokens-per-device-second stays an honest
+    goodput number. Also takes the periodic HBM watermark (every
+    MXNET_DEVPROF_HBM_TICKS timed ticks)."""
     acc = tick_device_ms()
     tick_end()
     prefill = step = 0.0

@@ -2429,6 +2429,29 @@ def test_recompile_risk_bucket_ladder_and_knob_clean():
     assert found == []
 
 
+def test_recompile_risk_speculative_widened_step_clean():
+    # the ISSUE-20 widened decode tick: the packed operand is
+    # (5, slots * (spec_k + 1)) where BOTH factors are get_env knobs.
+    # The shape interpreter must resolve the arithmetic over two knob
+    # lattice values to `knob` (bounded: one compile per process), not
+    # widen to ⊤ and flag the jitted step as a recompile hazard.
+    found = lint("""
+        import jax
+        import numpy as np
+        from .base import get_env
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def spec_tick():
+            s = get_env("MXNET_DECODE_SLOTS", 8, int, cache=False)
+            k = get_env("MXNET_DECODE_SPEC_K", 0, int, cache=False)
+            return step(np.zeros((5, s * (k + 1)), np.int32))
+    """, "recompile-risk")
+    assert found == []
+
+
 def test_recompile_risk_warmup_rung_loop_clean():
     # one compile per rung of a knob-parsed ladder is the warmup
     # CONTRACT, not a hazard — bounded by construction
